@@ -1,0 +1,19 @@
+"""Core runtime: the TPU-native equivalent of the reference's ``fedml_core``."""
+
+from fedml_tpu.core import pytree  # noqa: F401
+from fedml_tpu.core.partition import (  # noqa: F401
+    homo_partition,
+    non_iid_partition_with_dirichlet_distribution,
+    record_data_stats,
+)
+from fedml_tpu.core.topology import (  # noqa: F401
+    SymmetricTopologyManager,
+    AsymmetricTopologyManager,
+)
+from fedml_tpu.core.robust import (  # noqa: F401
+    vectorize_weights,
+    norm_diff_clipping,
+    add_gaussian_noise,
+)
+from fedml_tpu.core.message import Message  # noqa: F401
+from fedml_tpu.core.trainer import ModelTrainer  # noqa: F401

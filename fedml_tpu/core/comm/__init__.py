@@ -1,0 +1,2 @@
+from fedml_tpu.core.comm.base import BaseCommunicationManager, Observer  # noqa: F401
+from fedml_tpu.core.comm.local import LocalCommNetwork, LocalCommManager  # noqa: F401

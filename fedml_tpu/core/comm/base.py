@@ -1,0 +1,40 @@
+"""Backend-neutral communication abstraction.
+
+Interface parity with reference ``fedml_core/distributed/communication/
+base_com_manager.py:7-27`` and ``observer.py:4-7``. Concrete backends:
+``local`` (in-process queues, replaces MPI-on-localhost for simulation and
+tests), ``mqtt`` (device bridge, optional), and the ICI data plane which needs
+no manager at all -- it is XLA collectives inside the jitted round step.
+"""
+
+from __future__ import annotations
+
+import abc
+
+
+class Observer(abc.ABC):
+    @abc.abstractmethod
+    def receive_message(self, msg_type, msg_params) -> None:
+        ...
+
+
+class BaseCommunicationManager(abc.ABC):
+    @abc.abstractmethod
+    def send_message(self, msg):
+        ...
+
+    @abc.abstractmethod
+    def add_observer(self, observer: Observer):
+        ...
+
+    @abc.abstractmethod
+    def remove_observer(self, observer: Observer):
+        ...
+
+    @abc.abstractmethod
+    def handle_receive_message(self):
+        ...
+
+    @abc.abstractmethod
+    def stop_receive_message(self):
+        ...

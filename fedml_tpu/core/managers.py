@@ -1,0 +1,58 @@
+"""Client/Server manager FSMs for the distributed paradigm.
+
+Parity with reference ``fedml_core/distributed/client/client_manager.py:12-64``
+and ``server/server_manager.py:11-57``: a handler registry keyed by message
+type, a blocking receive loop, and ``finish()``. The reference terminated via
+``MPI.COMM_WORLD.Abort()``; here ``finish()`` stops the receive loop cleanly.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from fedml_tpu.core.comm.base import Observer
+from fedml_tpu.core.message import Message
+
+
+class DistributedManager(Observer):
+    def __init__(self, args, comm_manager, rank=0, size=0):
+        self.args = args
+        self.size = size
+        self.rank = rank
+        self.com_manager = comm_manager
+        self.com_manager.add_observer(self)
+        self.message_handler_dict = {}
+
+    def run(self):
+        self.register_message_receive_handlers()
+        self.com_manager.handle_receive_message()
+
+    def get_sender_id(self):
+        return self.rank
+
+    def receive_message(self, msg_type, msg_params) -> None:
+        handler = self.message_handler_dict.get(str(msg_type))
+        if handler is None:
+            logging.warning("rank %d: no handler for message type %s", self.rank, msg_type)
+            return
+        handler(msg_params)
+
+    def send_message(self, message: Message):
+        self.com_manager.send_message(message)
+
+    def register_message_receive_handlers(self) -> None:
+        raise NotImplementedError
+
+    def register_message_receive_handler(self, msg_type, handler_callback_func):
+        self.message_handler_dict[str(msg_type)] = handler_callback_func
+
+    def finish(self):
+        self.com_manager.stop_receive_message()
+
+
+class ClientManager(DistributedManager):
+    """Base for per-client protocol FSMs."""
+
+
+class ServerManager(DistributedManager):
+    """Base for the rank-0 server protocol FSM."""

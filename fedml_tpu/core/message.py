@@ -1,0 +1,101 @@
+"""Typed message envelope for the host-side control plane.
+
+On TPU the *data plane* (weights, activations) never leaves the device mesh --
+aggregation is a psum, not a pickle. What remains host-side is the control
+plane the reference built its whole stack around: typed messages with a
+handler-dispatch table. This module keeps behavioral parity with reference
+``fedml_core/distributed/communication/message.py:5-74`` (reserved keys
+``msg_type``/``sender``/``receiver``, arbitrary payload, JSON codec) so the
+distributed-paradigm APIs and the MQTT device bridge translate 1:1.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+
+class Message:
+    MSG_ARG_KEY_TYPE = "msg_type"
+    MSG_ARG_KEY_SENDER = "sender"
+    MSG_ARG_KEY_RECEIVER = "receiver"
+
+    MSG_OPERATION_SEND = "send"
+    MSG_OPERATION_RECEIVE = "receive"
+    MSG_OPERATION_BROADCAST = "broadcast"
+    MSG_OPERATION_REDUCE = "reduce"
+
+    def __init__(self, type="default", sender_id=0, receiver_id=0):
+        self.type = str(type)
+        self.sender_id = sender_id
+        self.receiver_id = receiver_id
+        self.msg_params = {
+            Message.MSG_ARG_KEY_TYPE: type,
+            Message.MSG_ARG_KEY_SENDER: sender_id,
+            Message.MSG_ARG_KEY_RECEIVER: receiver_id,
+        }
+
+    def init(self, msg_params):
+        self.msg_params = msg_params
+
+    def init_from_json_string(self, json_string):
+        self.msg_params = json.loads(json_string)
+        self.type = str(self.msg_params[Message.MSG_ARG_KEY_TYPE])
+        self.sender_id = self.msg_params[Message.MSG_ARG_KEY_SENDER]
+        self.receiver_id = self.msg_params[Message.MSG_ARG_KEY_RECEIVER]
+
+    def get_sender_id(self):
+        return self.sender_id
+
+    def get_receiver_id(self):
+        return self.receiver_id
+
+    def add_params(self, key, value):
+        self.msg_params[key] = value
+
+    def get_params(self):
+        return self.msg_params
+
+    def add(self, key, value):
+        self.msg_params[key] = value
+
+    def get(self, key):
+        return self.msg_params.get(key)
+
+    def get_type(self):
+        return self.msg_params[Message.MSG_ARG_KEY_TYPE]
+
+    def to_string(self):
+        return self.msg_params
+
+    def to_json(self):
+        """JSON codec for broker transports; ndarray payloads become nested
+        lists (the reference's ``is_mobile`` tensor<->list codec,
+        ``fedml_api/distributed/fedavg/utils.py:5-14``)."""
+        return json.dumps(self.msg_params, default=_jsonify)
+
+    def __str__(self):
+        return f"Message(type={self.type}, sender={self.sender_id}, receiver={self.receiver_id})"
+
+
+def _jsonify(obj):
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if hasattr(obj, "tolist"):  # jax arrays / numpy scalars
+        return obj.tolist()
+    raise TypeError(f"not JSON-serializable: {type(obj)}")
+
+
+def params_to_lists(tree):
+    """Pytree of arrays -> pytree of nested Python lists (mobile/JSON codec)."""
+    import jax
+    return jax.tree.map(lambda x: np.asarray(x).tolist(), tree)
+
+
+def lists_to_params(tree, dtype=np.float32):
+    """Inverse codec: nested lists -> numpy arrays."""
+    import jax
+    return jax.tree.map(
+        lambda x: np.asarray(x, dtype=dtype),
+        tree, is_leaf=lambda x: isinstance(x, list))

@@ -1,0 +1,133 @@
+"""Non-IID data partitioners.
+
+Reproduces the sampling semantics of the reference's latent-Dirichlet
+partitioner (``fedml_core/non_iid_partition/noniid_partition.py:6-91``):
+per class, draw Dirichlet(alpha) proportions over clients, cap any client
+already holding ``N / client_num`` samples, split class indices by the
+cumulative proportions, and retry until every client has >= ``min_size``
+(10) samples. Runs on host numpy -- partitioning is control plane, not compute.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+DEFAULT_MIN_SAMPLES = 10
+
+
+def partition_class_samples_with_dirichlet_distribution(
+        N, alpha, client_num, idx_batch, idx_k, rng):
+    """Split one class's shuffled indices among clients by Dirichlet proportions.
+
+    Mirrors reference ``noniid_partition.py:76-91``: proportions for clients
+    that already reached the fair share ``N/client_num`` are zeroed before
+    normalization, which bounds the imbalance of the final partition.
+    """
+    rng.shuffle(idx_k)
+    proportions = rng.dirichlet(np.repeat(alpha, client_num))
+    proportions = np.array(
+        [p * (len(idx_j) < N / client_num) for p, idx_j in zip(proportions, idx_batch)])
+    total = proportions.sum()
+    if total > 0:
+        proportions = proportions / total
+    else:
+        # every client already reached the N/client_num cap (possible late in
+        # the class loop): fall back to uniform instead of emitting NaN cuts
+        proportions = np.full(client_num, 1.0 / client_num)
+    cuts = (np.cumsum(proportions) * len(idx_k)).astype(int)[:-1]
+    idx_batch = [idx_j + idx.tolist()
+                 for idx_j, idx in zip(idx_batch, np.split(idx_k, cuts))]
+    min_size = min(len(idx_j) for idx_j in idx_batch)
+    return idx_batch, min_size
+
+
+def non_iid_partition_with_dirichlet_distribution(
+        label_list, client_num, classes, alpha, task="classification",
+        seed=None, min_require_size=DEFAULT_MIN_SAMPLES):
+    """LDA partition of sample indices into ``client_num`` shards.
+
+    Returns ``{client_idx: np.ndarray of sample indices}``. ``task`` may be
+    ``"classification"`` (label_list is one label per sample) or
+    ``"segmentation"`` (label_list is a per-sample list of present classes,
+    reference ``noniid_partition.py:33-55``).
+    """
+    label_list = np.asarray(label_list, dtype=object) if task == "segmentation" \
+        else np.asarray(label_list)
+    rng = np.random.default_rng(seed)
+    net_dataidx_map = {}
+    min_size = 0
+    K = classes
+    N = len(label_list)
+
+    # The reference retries forever when client_num * min_require_size > N
+    # (``noniid_partition.py:22`` has no feasibility check) -- fail fast instead.
+    if client_num * min_require_size > N:
+        raise ValueError(
+            f"infeasible partition: {client_num} clients x min {min_require_size} "
+            f"samples > {N} total samples")
+
+    while min_size < min_require_size:
+        idx_batch = [[] for _ in range(client_num)]
+        if task == "segmentation":
+            # each sample is assigned once, keyed by the first class it
+            # contains (reference ``noniid_partition.py:48-60`` skips samples
+            # already claimed by an earlier class)
+            first_class = [min(cats) for cats in label_list]
+            for k in range(K):
+                idx_k = np.asarray(
+                    [i for i, fc in enumerate(first_class) if fc == k], dtype=np.int64)
+                if len(idx_k) == 0:
+                    continue
+                idx_batch, min_size = partition_class_samples_with_dirichlet_distribution(
+                    N, alpha, client_num, idx_batch, idx_k, rng)
+        else:
+            for k in range(K):
+                idx_k = np.where(label_list == k)[0]
+                idx_batch, min_size = partition_class_samples_with_dirichlet_distribution(
+                    N, alpha, client_num, idx_batch, idx_k, rng)
+
+    for j in range(client_num):
+        rng.shuffle(idx_batch[j])
+        net_dataidx_map[j] = np.asarray(idx_batch[j], dtype=np.int64)
+    return net_dataidx_map
+
+
+def homo_partition(n_samples, client_num, seed=None):
+    """IID partition: shuffle then equal split (reference ``cifar10/data_loader.py``
+    ``partition == "homo"`` branch)."""
+    rng = np.random.default_rng(seed)
+    idxs = rng.permutation(n_samples)
+    return {i: np.sort(part).astype(np.int64)
+            for i, part in enumerate(np.array_split(idxs, client_num))}
+
+
+def hetero_fix_partition(label_list, client_num, classes, seed=None):
+    """Deterministic shard-by-class partition ("hetero-fix"): sort by label and
+    deal contiguous shards round-robin, giving each client ~2 classes."""
+    label_list = np.asarray(label_list)
+    order = np.argsort(label_list, kind="stable")
+    shards = np.array_split(order, client_num * 2)
+    rng = np.random.default_rng(seed)
+    shard_ids = rng.permutation(len(shards))
+    out = {}
+    for j in range(client_num):
+        picked = [shards[s] for s in shard_ids[2 * j:2 * j + 2]]
+        out[j] = np.sort(np.concatenate(picked)).astype(np.int64)
+    return out
+
+
+def record_data_stats(label_list, net_dataidx_map, task="classification"):
+    """Per-client class histogram (reference ``noniid_partition.py`` logging
+    helper ``record_data_stats``)."""
+    net_cls_counts = {}
+    for net_i, dataidx in net_dataidx_map.items():
+        if task == "segmentation":
+            flat = [c for i in dataidx for c in label_list[i]]
+            unq, cnt = np.unique(flat, return_counts=True)
+        else:
+            unq, cnt = np.unique(np.asarray(label_list)[dataidx], return_counts=True)
+        net_cls_counts[net_i] = {int(u): int(c) for u, c in zip(unq, cnt)}
+    logging.debug("Data statistics: %s", net_cls_counts)
+    return net_cls_counts

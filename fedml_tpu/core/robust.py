@@ -1,0 +1,77 @@
+"""Robust-aggregation defense primitives as pure pytree ops.
+
+Parity with reference ``fedml_core/robustness/robust_aggregation.py``:
+- ``vectorize_weights``: flatten only *weight* parameters, excluding
+  normalization running statistics (reference ``is_weight_param`` at
+  ``robust_aggregation.py:28-29`` excludes ``running_mean/running_var/
+  num_batches_tracked``; in Flax terms, the ``batch_stats`` collection).
+- ``norm_diff_clipping``: clip the client-minus-global delta to an L2 ball
+  (``robust_aggregation.py:38-49``).
+- ``add_gaussian_noise``: weak differential privacy noise
+  (``robust_aggregation.py:51-55``).
+
+All functions are jittable so defenses run on-device inside the round step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.core import pytree
+
+# Flax state collections excluded from the defense vector, mirroring the
+# reference's is_weight_param() exclusion of BN running stats.
+NON_WEIGHT_COLLECTIONS = ("batch_stats",)
+
+
+def split_weights(state):
+    """Split a model-state pytree into (weights, non_weights) where non_weights
+    are the excluded collections (BN running stats)."""
+    if not isinstance(state, dict):
+        return state, {}
+    weights = {k: v for k, v in state.items() if k not in NON_WEIGHT_COLLECTIONS}
+    rest = {k: v for k, v in state.items() if k in NON_WEIGHT_COLLECTIONS}
+    return weights, rest
+
+
+def vectorize_weights(state):
+    """1-D fp32 vector of weight parameters only (BN stats excluded)."""
+    weights, _ = split_weights(state)
+    return pytree.tree_flatten_to_vector(weights)
+
+
+def norm_diff_clipping(local_state, global_state, norm_bound):
+    """Clip ``local - global`` (weights only) to L2 norm ``norm_bound`` and
+    re-add to global. BN stats pass through unclipped, exactly as the reference
+    excludes them from the clipping vector."""
+    local_w, local_rest = split_weights(local_state)
+    global_w, _ = split_weights(global_state)
+    diff = pytree.tree_sub(local_w, global_w)
+    norm = pytree.tree_l2_norm(diff)
+    # reference: weight_diff / max(1, ||diff|| / norm_bound)
+    scale = 1.0 / jnp.maximum(1.0, norm / norm_bound)
+    clipped = pytree.tree_add(global_w, pytree.tree_scale(diff, scale))
+    if isinstance(local_state, dict):
+        out = dict(clipped)
+        out.update(local_rest)
+        return out
+    return clipped
+
+
+def add_gaussian_noise(state, stddev, rng_key):
+    """Weak-DP Gaussian noise on weight parameters only."""
+    weights, rest = split_weights(state)
+    leaves, treedef = jax.tree.flatten(weights)
+    keys = jax.random.split(rng_key, len(leaves))
+    noised = [
+        (x + stddev * jax.random.normal(k, x.shape, jnp.float32)).astype(x.dtype)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x
+        for x, k in zip(leaves, keys)
+    ]
+    noised_tree = jax.tree.unflatten(treedef, noised)
+    if isinstance(state, dict):
+        out = dict(noised_tree)
+        out.update(rest)
+        return out
+    return noised_tree

@@ -1,0 +1,110 @@
+"""Gossip topology managers for decentralized FL.
+
+Behavioral parity with reference ``fedml_core/distributed/topology/``:
+a ring augmented with random Watts-Strogatz-style links, row-normalized into a
+doubly-usable mixing matrix; the asymmetric variant deletes random directed
+edges. On TPU the resulting per-node neighbor weights drive
+``ppermute``-based neighbor exchange instead of per-process unicast
+(see ``fedml_tpu/algorithms/decentralized.py``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class BaseTopologyManager:
+    """Interface parity with reference ``base_topology_manager.py:4-24``."""
+
+    def generate_topology(self):
+        raise NotImplementedError
+
+    def get_in_neighbor_idx_list(self, node_index):
+        raise NotImplementedError
+
+    def get_out_neighbor_idx_list(self, node_index):
+        raise NotImplementedError
+
+    def get_in_neighbor_weights(self, node_index):
+        raise NotImplementedError
+
+    def get_out_neighbor_weights(self, node_index):
+        raise NotImplementedError
+
+
+def _ring_plus_random_topology(n, neighbor_num, rng):
+    """Symmetric ring + random extra links, as in reference
+    ``symmetric_topology_manager.py:21-52`` (networkx watts_strogatz_graph with
+    rewiring probability 0 plus ``neighbor_num`` random undirected edges)."""
+    topo = np.zeros((n, n))
+    # base ring (guarantees connectivity), then neighbor_num - 2 random
+    # undirected links per node for the small-world effect
+    for i in range(n):
+        topo[i, (i + 1) % n] = 1
+        topo[i, (i - 1) % n] = 1
+    extra = max(0, neighbor_num - 2)
+    for i in range(n):
+        candidates = [j for j in range(n) if j != i and topo[i, j] == 0]
+        rng.shuffle(candidates)
+        for j in candidates[:extra]:
+            topo[i, j] = topo[j, i] = 1
+    np.fill_diagonal(topo, 1)
+    return topo
+
+
+class SymmetricTopologyManager(BaseTopologyManager):
+    """Undirected topology with row-normalized mixing weights."""
+
+    def __init__(self, n, neighbor_num=2, seed=0):
+        self.n = n
+        self.neighbor_num = min(neighbor_num, n - 1)
+        self.topology = None
+        self._seed = seed
+
+    def generate_topology(self):
+        rng = np.random.default_rng(self._seed)
+        topo = _ring_plus_random_topology(self.n, self.neighbor_num, rng)
+        # symmetrize then row-normalize (reference divides each row by its degree)
+        topo = np.maximum(topo, topo.T)
+        self.topology = topo / topo.sum(axis=1, keepdims=True)
+        return self.topology
+
+    def get_in_neighbor_idx_list(self, node_index):
+        return [i for i in range(self.n)
+                if self.topology[i, node_index] > 0 and i != node_index]
+
+    def get_out_neighbor_idx_list(self, node_index):
+        return [i for i in range(self.n)
+                if self.topology[node_index, i] > 0 and i != node_index]
+
+    def get_in_neighbor_weights(self, node_index):
+        return [float(self.topology[i, node_index]) for i in range(self.n)]
+
+    def get_out_neighbor_weights(self, node_index):
+        return [float(self.topology[node_index, i]) for i in range(self.n)]
+
+
+class AsymmetricTopologyManager(SymmetricTopologyManager):
+    """Directed topology: start symmetric, delete random directed edges with
+    probability ``undirected_neighbor_num`` semantics of reference
+    ``asymmetric_topology_manager.py:23-74``, then row-normalize."""
+
+    def __init__(self, n, neighbor_num=2, out_neighbor_num=2, seed=0):
+        super().__init__(n, neighbor_num, seed)
+        self.out_neighbor_num = out_neighbor_num
+
+    def generate_topology(self):
+        rng = np.random.default_rng(self._seed)
+        topo = _ring_plus_random_topology(self.n, self.neighbor_num, rng)
+        topo = np.maximum(topo, topo.T)
+        # randomly delete directed edges (keep self-loop and ring neighbors so
+        # the graph stays strongly connected)
+        for i in range(self.n):
+            off_ring = [j for j in range(self.n)
+                        if topo[i, j] > 0 and j not in (i, (i + 1) % self.n, (i - 1) % self.n)]
+            rng.shuffle(off_ring)
+            n_del = max(0, len(off_ring) - self.out_neighbor_num)
+            for j in off_ring[:n_del]:
+                topo[i, j] = 0
+        self.topology = topo / topo.sum(axis=1, keepdims=True)
+        return self.topology

@@ -1,0 +1,67 @@
+"""The trainer seam: where model-specific compute plugs into FL algorithms.
+
+The reference declares a framework-agnostic ``ModelTrainer`` ABC
+(``fedml_core/trainer/model_trainer.py:4-37``) as the seam between FL
+orchestration and the DL framework. We keep that ABC for API parity, and add
+the TPU-native functional form ``TrainSpec``: a triple of pure functions
+(init / local_train / evaluate) over pytrees. Every algorithm engine in
+``fedml_tpu.algorithms`` consumes TrainSpecs so the whole round stays inside
+one jitted program; ``ModelTrainer`` adapters exist for users migrating
+imperative reference-style trainers.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any, Callable, Optional
+
+
+class ModelTrainer(abc.ABC):
+    """API-parity ABC (reference ``model_trainer.py:4-37``)."""
+
+    def __init__(self, model, args=None):
+        self.model = model
+        self.id = 0
+        self.args = args
+
+    def set_id(self, trainer_id):
+        self.id = trainer_id
+
+    @abc.abstractmethod
+    def get_model_params(self):
+        ...
+
+    @abc.abstractmethod
+    def set_model_params(self, model_parameters):
+        ...
+
+    @abc.abstractmethod
+    def train(self, train_data, device, args):
+        ...
+
+    @abc.abstractmethod
+    def test(self, test_data, device, args):
+        ...
+
+    def test_on_the_server(self, train_data_local_dict, test_data_local_dict,
+                           device, args=None) -> bool:
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainSpec:
+    """Pure-function trainer triple. All functions are jit-compatible.
+
+    init_fn(rng) -> state
+        ``state`` is a pytree dict, conventionally ``{"params": ..., possibly
+        "batch_stats": ...}`` -- the quantity FedAvg averages (the reference
+        averages full state_dicts incl. BN buffers, ``FedAVGAggregator.py:72-83``).
+    loss_fn(state, batch, rng, train: bool) -> (loss, (new_model_state, metrics))
+        ``batch`` is ``{"x","y","mask"}``; masked samples contribute zero.
+    metrics_fn(state, batch) -> dict of summed metrics (e.g. correct-count)
+    """
+    init_fn: Callable[..., Any]
+    loss_fn: Callable[..., Any]
+    metrics_fn: Optional[Callable[..., Any]] = None
+    name: str = "model"
