@@ -1,0 +1,12 @@
+"""FL algorithms on the common round engine.
+
+Each module provides aggregator hooks (payload_fn / server_fn) and a
+user-facing API class matching the reference's per-algorithm surface
+(SURVEY.md sections 2.2-2.3).
+"""
+
+from fedml_tpu.algorithms.specs import (  # noqa: F401
+    make_classification_spec,
+    make_seq_classification_spec,
+)
+from fedml_tpu.algorithms.fedavg import FedAvgAPI  # noqa: F401

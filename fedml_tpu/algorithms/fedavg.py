@@ -1,0 +1,159 @@
+"""FedAvg: the north-star algorithm (reference ``fedml_api/distributed/fedavg``
++ ``fedml_api/standalone/fedavg``).
+
+One API class serves both reference paradigms: ``mesh=None`` runs the
+vmapped single-chip simulation (semantics of ``fedavg_api.py:40-115``);
+passing a mesh runs the shard_map/psum round (semantics of
+``FedAVGAggregator.py:58-87`` + managers, minus the pickle transport).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import jax
+import numpy as np
+
+from fedml_tpu.core.trainer import TrainSpec
+from fedml_tpu.parallel.engine import (
+    ClientUpdateConfig, make_sim_round, make_sharded_round, make_eval_fn)
+from fedml_tpu.parallel.mesh import shard_cohort
+from fedml_tpu.parallel.packing import pack_cohort, pack_eval
+
+
+def client_sampling(round_idx, client_num_in_total, client_num_per_round):
+    """Seeded-by-round cohort sampling, exactly the reference's
+    ``FedAVGAggregator._client_sampling`` (``FedAVGAggregator.py:89-97``):
+    reseeding with the round index makes runs reproducible and lets A/B runs
+    pick identical client subsets."""
+    if client_num_in_total == client_num_per_round:
+        return list(range(client_num_in_total))
+    np.random.seed(round_idx)
+    return list(np.random.choice(range(client_num_in_total),
+                                 client_num_per_round, replace=False))
+
+
+class FedAvgAPI:
+    """Round-loop orchestrator.
+
+    Args:
+      dataset: the 8-tuple contract (SURVEY.md section 1 L2):
+        [train_data_num, test_data_num, train_data_global, test_data_global,
+         train_data_local_num_dict, train_data_local_dict,
+         test_data_local_dict, class_num] where local dicts map
+        client_idx -> {"x": np.ndarray, "y": np.ndarray}.
+      spec: TrainSpec for the model/task.
+      args: hyperparameters (client_num_per_round, comm_round, epochs,
+        batch_size, lr, client_optimizer, wd, frequency_of_the_test, ci).
+      mesh: optional jax Mesh -- enables the sharded round path.
+      payload_fn / server_fn / server_state: aggregator hooks for algorithm
+        variants (FedOpt, FedNova, robust FedAvg) built on this same loop.
+    """
+
+    def __init__(self, dataset, spec: TrainSpec, args, mesh=None,
+                 payload_fn=None, server_fn=None, server_state=None,
+                 metrics_logger=None):
+        (self.train_data_num, self.test_data_num, self.train_data_global,
+         self.test_data_global, self.train_data_local_num_dict,
+         self.train_data_local_dict, self.test_data_local_dict,
+         self.class_num) = dataset
+        self.spec = spec
+        self.args = args
+        self.mesh = mesh
+        self.metrics_logger = metrics_logger or (lambda d: logging.info("%s", d))
+
+        cfg = ClientUpdateConfig(
+            optimizer=getattr(args, "client_optimizer", "sgd"),
+            lr=args.lr,
+            weight_decay=getattr(args, "wd", 0.0),
+            momentum=getattr(args, "momentum", 0.0),
+            grad_clip=getattr(args, "grad_clip", None))
+        self.cfg = cfg
+        if mesh is None:
+            self.round_fn = make_sim_round(spec, cfg, payload_fn, server_fn)
+        else:
+            self.round_fn = make_sharded_round(spec, cfg, mesh, payload_fn,
+                                               server_fn)
+        self.eval_fn = make_eval_fn(spec)
+        self.server_state = server_state if server_state is not None else ()
+
+        seed = getattr(args, "seed", 0)
+        self.rng = jax.random.PRNGKey(seed)
+        self.global_state = spec.init_fn(jax.random.fold_in(self.rng, 0))
+        self._data_rng = np.random.default_rng(seed)
+        self.round_idx = 0
+        self.history = []
+
+    def _cohort(self, round_idx):
+        client_indexes = client_sampling(
+            round_idx, len(self.train_data_local_dict),
+            self.args.client_num_per_round)
+        logging.info("client_indexes = %s", client_indexes)
+        datasets = [self.train_data_local_dict[i] for i in client_indexes]
+        if all(len(d["y"]) == 0 for d in datasets):
+            raise ValueError(
+                f"round {round_idx}: every sampled client has an empty shard")
+        packed = pack_cohort(datasets, self.args.batch_size, self.args.epochs,
+                             rng=self._data_rng)
+        if self.mesh is not None:
+            packed = shard_cohort(self.mesh, packed)
+        return client_indexes, packed
+
+    def train_one_round(self):
+        t0 = time.time()
+        _, packed = self._cohort(self.round_idx)
+        self.rng, round_rng = jax.random.split(self.rng)
+        self.global_state, self.server_state, info = self.round_fn(
+            self.global_state, self.server_state, packed, round_rng)
+        jax.block_until_ready(self.global_state)
+        dt = time.time() - t0
+        m = jax.tree.map(np.asarray, info["metrics"])
+        train_metrics = {
+            "round": self.round_idx,
+            "Train/Loss": float(m["loss_sum"].sum() / max(m["count"].sum(), 1)),
+            "Train/Acc": float(m["correct"].sum() / max(m["count"].sum(), 1)),
+            "round_time_s": dt,
+        }
+        self.round_idx += 1
+        return train_metrics
+
+    def evaluate_global(self):
+        packed = pack_eval(self.test_data_global, self.args.batch_size)
+        m = jax.tree.map(np.asarray, self.eval_fn(self.global_state, packed))
+        return {"Test/Loss": float(m["loss_sum"] / max(m["count"], 1)),
+                "Test/Acc": float(m["correct"] / max(m["count"], 1))}
+
+    def evaluate_local(self, max_clients=None):
+        """Per-client eval on local test shards (reference
+        ``_local_test_on_all_clients``, ``fedavg_api.py:117-180``; ``--ci``
+        short-circuits to one client, ``fedavg_api.py:157-162``)."""
+        if getattr(self.args, "ci", 0):
+            max_clients = 1
+        totals = None
+        for i, d in self.test_data_local_dict.items():
+            if max_clients is not None and i >= max_clients:
+                break
+            if d is None or len(d["y"]) == 0:
+                continue
+            packed = pack_eval(d, self.args.batch_size)
+            m = jax.tree.map(np.asarray, self.eval_fn(self.global_state, packed))
+            totals = m if totals is None else jax.tree.map(np.add, totals, m)
+        if totals is None:
+            return {}
+        return {"Test/Loss": float(totals["loss_sum"] / max(totals["count"], 1)),
+                "Test/Acc": float(totals["correct"] / max(totals["count"], 1))}
+
+    def train(self):
+        """Full training loop (reference ``fedavg_api.py:40-81``): per-round
+        cohort sampling, local training, aggregation; eval every
+        ``frequency_of_the_test`` rounds and on the final round."""
+        freq = getattr(self.args, "frequency_of_the_test", 5)
+        for _ in range(self.args.comm_round):
+            metrics = self.train_one_round()
+            last = self.round_idx == self.args.comm_round
+            if self.round_idx % freq == 0 or last:
+                metrics.update(self.evaluate_global())
+            self.metrics_logger(metrics)
+            self.history.append(metrics)
+        return self.global_state

@@ -1,0 +1,140 @@
+"""TrainSpec builders: wrap a Flax model into the pure-function trainer triple.
+
+These are the TPU equivalents of the reference's task-specific ModelTrainers
+(``my_model_trainer_classification.py`` / ``..._nwp.py`` / selected per
+dataset at ``fedml_experiments/standalone/fedavg/main_fedavg.py:269-275``):
+the loss/metric conventions match so accuracy curves are comparable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.core.trainer import TrainSpec
+
+
+def _apply_model(model, state, x, rng, train):
+    variables = dict(state)
+    rngs = {"dropout": rng} if (train and rng is not None) else None
+    if "batch_stats" in state and train:
+        out, mutated = model.apply(variables, x, train=True,
+                                   mutable=["batch_stats"], rngs=rngs)
+        new_state = dict(state)
+        new_state["batch_stats"] = mutated["batch_stats"]
+        return out, new_state
+    out = model.apply(variables, x, train=train, rngs=rngs)
+    return out, state
+
+
+def make_classification_spec(model, example_x, num_classes=None,
+                             name="classification"):
+    """Softmax cross-entropy classification over ``[B, C]`` logits.
+
+    Applying log_softmax to whatever the model emits reproduces the reference
+    LR quirk automatically (sigmoid output fed to torch CrossEntropyLoss,
+    ``lr.py:10-11``). Metrics are *sums* (loss-weighted, correct, count);
+    divide on host -- matching the reference's test accumulation
+    (``my_model_trainer_classification.py`` test loop).
+    """
+
+    def init_fn(rng):
+        variables = model.init(rng, example_x, train=False)
+        return dict(variables)
+
+    def _loss_and_metrics(logits, y, mask):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        ll = jnp.take_along_axis(logp, y[:, None].astype(jnp.int32), axis=1)[:, 0]
+        per_sample = -ll
+        count = jnp.sum(mask)
+        loss = jnp.sum(per_sample * mask) / jnp.maximum(count, 1.0)
+        correct = jnp.sum((jnp.argmax(logits, axis=-1) == y) * mask)
+        metrics = {"loss_sum": jnp.sum(per_sample * mask),
+                   "correct": correct, "count": count}
+        return loss, metrics
+
+    def loss_fn(state, batch, rng, train):
+        logits, new_state = _apply_model(model, state, batch["x"], rng, train)
+        loss, metrics = _loss_and_metrics(logits, batch["y"], batch["mask"])
+        return loss, (new_state, metrics)
+
+    def metrics_fn(state, batch):
+        logits, _ = _apply_model(model, state, batch["x"], None, False)
+        _, metrics = _loss_and_metrics(logits, batch["y"], batch["mask"])
+        return metrics
+
+    return TrainSpec(init_fn=init_fn, loss_fn=loss_fn, metrics_fn=metrics_fn,
+                     name=name)
+
+
+def make_seq_classification_spec(model, example_x, ignore_index=0,
+                                 name="nwp"):
+    """Per-token cross-entropy over ``[B, T, V]`` logits with padding-id
+    masking -- semantics of the reference NWP trainer
+    (``my_model_trainer_nwp.py:24``: ``CrossEntropyLoss(ignore_index=0)``).
+    Token mask = sample mask x (y != ignore_index).
+    """
+
+    def init_fn(rng):
+        variables = model.init(rng, example_x, train=False)
+        return dict(variables)
+
+    def _loss_and_metrics(logits, y, mask):
+        tok_mask = (y != ignore_index).astype(jnp.float32) * mask[:, None]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        ll = jnp.take_along_axis(logp, y[..., None].astype(jnp.int32),
+                                 axis=-1)[..., 0]
+        count = jnp.sum(tok_mask)
+        loss = jnp.sum(-ll * tok_mask) / jnp.maximum(count, 1.0)
+        correct = jnp.sum((jnp.argmax(logits, axis=-1) == y) * tok_mask)
+        return loss, {"loss_sum": jnp.sum(-ll * tok_mask),
+                      "correct": correct, "count": count}
+
+    def loss_fn(state, batch, rng, train):
+        logits, new_state = _apply_model(model, state, batch["x"], rng, train)
+        loss, metrics = _loss_and_metrics(logits, batch["y"], batch["mask"])
+        return loss, (new_state, metrics)
+
+    def metrics_fn(state, batch):
+        logits, _ = _apply_model(model, state, batch["x"], None, False)
+        _, metrics = _loss_and_metrics(logits, batch["y"], batch["mask"])
+        return metrics
+
+    return TrainSpec(init_fn=init_fn, loss_fn=loss_fn, metrics_fn=metrics_fn,
+                     name=name)
+
+
+def make_multilabel_spec(model, example_x, name="tag_prediction"):
+    """Sigmoid BCE multilabel (reference ``my_model_trainer_tag_prediction.py``
+    for stackoverflow_lr: BCELoss + top-k precision/recall style counts)."""
+
+    def init_fn(rng):
+        variables = model.init(rng, example_x, train=False)
+        return dict(variables)
+
+    def _loss_and_metrics(probs, y, mask):
+        probs = jnp.clip(probs.astype(jnp.float32), 1e-7, 1 - 1e-7)
+        per_sample = -jnp.sum(y * jnp.log(probs) + (1 - y) * jnp.log(1 - probs),
+                              axis=-1)
+        count = jnp.sum(mask)
+        loss = jnp.sum(per_sample * mask) / jnp.maximum(count, 1.0)
+        pred = (probs > 0.5).astype(jnp.float32)
+        tp = jnp.sum(pred * y * mask[:, None])
+        fp = jnp.sum(pred * (1 - y) * mask[:, None])
+        fn = jnp.sum((1 - pred) * y * mask[:, None])
+        return loss, {"loss_sum": jnp.sum(per_sample * mask), "tp": tp,
+                      "fp": fp, "fn": fn, "count": count,
+                      "correct": tp}  # correct == true positives for acc parity
+
+    def loss_fn(state, batch, rng, train):
+        probs, new_state = _apply_model(model, state, batch["x"], rng, train)
+        loss, metrics = _loss_and_metrics(probs, batch["y"], batch["mask"])
+        return loss, (new_state, metrics)
+
+    def metrics_fn(state, batch):
+        probs, _ = _apply_model(model, state, batch["x"], None, False)
+        _, metrics = _loss_and_metrics(probs, batch["y"], batch["mask"])
+        return metrics
+
+    return TrainSpec(init_fn=init_fn, loss_fn=loss_fn, metrics_fn=metrics_fn,
+                     name=name)

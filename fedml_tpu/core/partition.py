@@ -103,7 +103,7 @@ def homo_partition(n_samples, client_num, seed=None):
             for i, part in enumerate(np.array_split(idxs, client_num))}
 
 
-def hetero_fix_partition(label_list, client_num, classes, seed=None):
+def hetero_fix_partition(label_list, client_num, seed=None):
     """Deterministic shard-by-class partition ("hetero-fix"): sort by label and
     deal contiguous shards round-robin, giving each client ~2 classes."""
     label_list = np.asarray(label_list)

@@ -60,7 +60,11 @@ def tree_weighted_mean(stacked, weights):
     callers pass raw sample counts ``n_k``.
     """
     weights = jnp.asarray(weights, jnp.float32)
-    norm = weights / jnp.sum(weights)
+    total = jnp.sum(weights)
+    # zero total weight (every client empty) would otherwise zero the model;
+    # fall back to a uniform average, which preserves each payload's value
+    norm = jnp.where(total > 0, weights / jnp.maximum(total, 1e-12),
+                     1.0 / weights.shape[0])
 
     def avg(leaf):
         w = norm.reshape((-1,) + (1,) * (leaf.ndim - 1))
@@ -78,9 +82,13 @@ def tree_weighted_psum_mean(local_tree, local_weight, axis_name):
     gather-pickles-then-loop aggregation path (SURVEY.md section 2.8).
     """
     total = jax.lax.psum(jnp.asarray(local_weight, jnp.float32), axis_name)
+    n_shards = jax.lax.psum(jnp.float32(1.0), axis_name)
+    # same zero-total fallback as tree_weighted_mean: uniform average
+    w = jnp.where(total > 0, local_weight / jnp.maximum(total, 1e-12),
+                  1.0 / n_shards)
     return jax.tree.map(
-        lambda x: (jax.lax.psum(x.astype(jnp.float32) * local_weight, axis_name)
-                   / total).astype(x.dtype),
+        lambda x: jax.lax.psum(x.astype(jnp.float32) * w, axis_name)
+        .astype(x.dtype),
         local_tree)
 
 

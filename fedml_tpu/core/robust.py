@@ -27,8 +27,10 @@ NON_WEIGHT_COLLECTIONS = ("batch_stats",)
 
 def split_weights(state):
     """Split a model-state pytree into (weights, non_weights) where non_weights
-    are the excluded collections (BN running stats)."""
-    if not isinstance(state, dict):
+    are the excluded collections (BN running stats). Accepts any Mapping
+    (plain dict or flax FrozenDict)."""
+    from collections.abc import Mapping
+    if not isinstance(state, Mapping):
         return state, {}
     weights = {k: v for k, v in state.items() if k not in NON_WEIGHT_COLLECTIONS}
     rest = {k: v for k, v in state.items() if k in NON_WEIGHT_COLLECTIONS}
@@ -52,7 +54,8 @@ def norm_diff_clipping(local_state, global_state, norm_bound):
     # reference: weight_diff / max(1, ||diff|| / norm_bound)
     scale = 1.0 / jnp.maximum(1.0, norm / norm_bound)
     clipped = pytree.tree_add(global_w, pytree.tree_scale(diff, scale))
-    if isinstance(local_state, dict):
+    from collections.abc import Mapping
+    if isinstance(local_state, Mapping):
         out = dict(clipped)
         out.update(local_rest)
         return out
@@ -70,7 +73,8 @@ def add_gaussian_noise(state, stddev, rng_key):
         for x, k in zip(leaves, keys)
     ]
     noised_tree = jax.tree.unflatten(treedef, noised)
-    if isinstance(state, dict):
+    from collections.abc import Mapping
+    if isinstance(state, Mapping):
         out = dict(noised_tree)
         out.update(rest)
         return out

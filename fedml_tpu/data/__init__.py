@@ -1,0 +1,15 @@
+"""Federated dataset loaders.
+
+Every loader returns the reference 8-tuple contract (SURVEY.md section 1 L2,
+e.g. ``cifar10/data_loader.py:235-269``):
+
+    [train_data_num, test_data_num, train_data_global, test_data_global,
+     train_data_local_num_dict, train_data_local_dict, test_data_local_dict,
+     class_num]
+
+with global/local data as ``{"x": np.ndarray, "y": np.ndarray}`` dicts
+(device staging happens in the engine, not the loaders).
+"""
+
+from fedml_tpu.data.synthetic import load_synthetic_federated  # noqa: F401
+from fedml_tpu.data.registry import load_dataset  # noqa: F401

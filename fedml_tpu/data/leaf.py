@@ -1,0 +1,66 @@
+"""LEAF JSON loaders (MNIST family).
+
+Schema parity: reference ``fedml_api/data_preprocessing/MNIST/data_loader.py:
+8-122`` -- a directory of ``{train,test}/*.json`` files, each holding
+``{"users": [...], "num_samples": [...], "user_data": {user: {"x": [[...]],
+"y": [...]}}}``; clients are naturally keyed by user. The reference
+pre-batches into tensor lists; here loaders return raw arrays and batching
+happens in the packing layer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+
+def read_leaf_dir(data_dir):
+    """Parse every ``*.json`` under ``data_dir`` and merge users."""
+    users, data = [], {}
+    if not os.path.isdir(data_dir):
+        raise FileNotFoundError(
+            f"LEAF data dir not found: {data_dir}. Download the dataset "
+            "(reference data/MNIST/download_and_unzip.sh) or use "
+            "dataset='synthetic' in this zero-egress environment.")
+    files = sorted(f for f in os.listdir(data_dir) if f.endswith(".json"))
+    if not files:
+        raise FileNotFoundError(f"no .json files in {data_dir}")
+    for f in files:
+        with open(os.path.join(data_dir, f)) as fh:
+            blob = json.load(fh)
+        users.extend(blob["users"])
+        data.update(blob["user_data"])
+    return users, data
+
+
+def load_leaf_mnist(data_dir, client_num=None, seed=0, x_dtype=np.float32,
+                    y_dtype=np.int64):
+    """8-tuple from LEAF MNIST json (contract of ``MNIST/data_loader.py:86-122``).
+
+    ``client_num`` optionally truncates to the first N users (the reference
+    uses all users and sets ``client_num = len(users)``).
+    """
+    train_users, train_data = read_leaf_dir(os.path.join(data_dir, "train"))
+    test_users, test_data = read_leaf_dir(os.path.join(data_dir, "test"))
+    users = train_users if client_num is None else train_users[:client_num]
+
+    train_local, test_local, train_num = {}, {}, {}
+    xs_tr, ys_tr, xs_te, ys_te = [], [], [], []
+    for i, u in enumerate(users):
+        xt = np.asarray(train_data[u]["x"], x_dtype)
+        yt = np.asarray(train_data[u]["y"], y_dtype)
+        xe = np.asarray(test_data[u]["x"], x_dtype) if u in test_data else xt[:0]
+        ye = np.asarray(test_data[u]["y"], y_dtype) if u in test_data else yt[:0]
+        train_local[i] = {"x": xt, "y": yt}
+        test_local[i] = {"x": xe, "y": ye}
+        train_num[i] = len(yt)
+        xs_tr.append(xt); ys_tr.append(yt); xs_te.append(xe); ys_te.append(ye)
+
+    x_train = np.concatenate(xs_tr); y_train = np.concatenate(ys_tr)
+    x_test = np.concatenate(xs_te); y_test = np.concatenate(ys_te)
+    class_num = int(max(y_train.max(), y_test.max() if len(y_test) else 0)) + 1
+    return [len(y_train), len(y_test),
+            {"x": x_train, "y": y_train}, {"x": x_test, "y": y_test},
+            train_num, train_local, test_local, class_num]

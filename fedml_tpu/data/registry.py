@@ -1,0 +1,53 @@
+"""Dataset registry: name -> loader, mirroring the reference's ``load_data``
+switch (``fedml_experiments/distributed/fedavg/main_fedavg.py:108-214``).
+File-backed loaders check ``data_dir`` and raise a clear error when raw data
+is absent (zero-egress environment); synthetic sets always work.
+"""
+
+from __future__ import annotations
+
+
+def load_dataset(args, dataset_name):
+    client_num = getattr(args, "client_num_in_total", 10)
+    partition = getattr(args, "partition_method", "hetero")
+    alpha = getattr(args, "partition_alpha", 0.5)
+    data_dir = getattr(args, "data_dir", None)
+    seed = getattr(args, "seed", 0)
+
+    from fedml_tpu.data import synthetic
+
+    if dataset_name == "synthetic":
+        return synthetic.load_synthetic_federated(
+            client_num=client_num, partition=partition,
+            partition_alpha=alpha, seed=seed)
+    if dataset_name == "synthetic_images":
+        return synthetic.load_synthetic_images(
+            client_num=client_num, partition=partition,
+            partition_alpha=alpha, seed=seed)
+    if dataset_name == "synthetic_sequences":
+        return synthetic.load_synthetic_sequences(
+            client_num=client_num, seed=seed)
+
+    if dataset_name == "mnist":
+        from fedml_tpu.data.leaf import load_leaf_mnist
+        return load_leaf_mnist(data_dir, client_num=client_num, seed=seed)
+    if dataset_name in ("cifar10", "cifar100", "cinic10"):
+        from fedml_tpu.data.cifar import load_cifar_federated
+        return load_cifar_federated(
+            dataset_name, data_dir, client_num=client_num,
+            partition=partition, partition_alpha=alpha, seed=seed)
+    if dataset_name in ("femnist", "fed_emnist"):
+        from fedml_tpu.data.tff_h5 import load_fed_emnist
+        return load_fed_emnist(data_dir, client_num=client_num)
+    if dataset_name == "fed_cifar100":
+        from fedml_tpu.data.tff_h5 import load_fed_cifar100
+        return load_fed_cifar100(data_dir, client_num=client_num)
+    if dataset_name in ("shakespeare", "fed_shakespeare"):
+        from fedml_tpu.data.shakespeare import load_shakespeare
+        return load_shakespeare(data_dir, client_num=client_num,
+                                leaf=(dataset_name == "shakespeare"))
+    if dataset_name in ("stackoverflow_nwp", "stackoverflow_lr"):
+        from fedml_tpu.data.stackoverflow import load_stackoverflow
+        return load_stackoverflow(data_dir, task=dataset_name.split("_")[1],
+                                  client_num=client_num)
+    raise ValueError(f"unknown dataset: {dataset_name}")

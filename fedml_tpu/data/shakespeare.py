@@ -1,0 +1,130 @@
+"""Shakespeare next-character datasets (LEAF JSON and TFF h5 flavors).
+
+Vocab parity: reference ``fedml_api/data_preprocessing/fed_shakespeare/
+utils.py:18-30`` -- the 86-char TFF vocabulary with pad=0, then chars, then
+bos/eos, oov = len(vocab)+3; total 90 ids = ``RNN_OriginalFedAvg`` vocab size.
+Sequences are padded to ``SEQUENCE_LENGTH + 1`` and split into
+(input, shifted-target) pairs.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+SEQUENCE_LENGTH = 80  # McMahan et al. AISTATS 2017
+CHAR_VOCAB = list(
+    'dhlptx@DHLPTX $(,048cgkoswCGKOSW[_#\'/37;?bfjnrvzBFJNRVZ"&*.26:\naeimquyAEIMQUY]!%)-159\r'
+)
+PAD_ID = 0
+_CHAR_TO_ID = {c: i + 1 for i, c in enumerate(CHAR_VOCAB)}
+BOS_ID = len(CHAR_VOCAB) + 1
+EOS_ID = len(CHAR_VOCAB) + 2
+OOV_ID = len(CHAR_VOCAB) + 3
+VOCAB_SIZE = len(CHAR_VOCAB) + 4  # 90
+
+
+def to_ids(sentence, max_seq_len=SEQUENCE_LENGTH):
+    """<bos> + char ids + <eos>, truncated/padded to ``max_seq_len + 1``
+    (reference ``fed_shakespeare/utils.py`` ``to_ids``)."""
+    ids = [BOS_ID] + [_CHAR_TO_ID.get(c, OOV_ID) for c in sentence]
+    ids = ids[:max_seq_len] + [EOS_ID]
+    ids = ids[:max_seq_len + 1]
+    ids += [PAD_ID] * (max_seq_len + 1 - len(ids))
+    return ids
+
+
+def preprocess_snippets(snippets, max_seq_len=SEQUENCE_LENGTH):
+    """Snippet strings -> (x [n, T], y [n, T]) next-char pairs."""
+    seqs = np.asarray([to_ids(s, max_seq_len) for s in snippets], np.int32)
+    if len(seqs) == 0:
+        return (np.zeros((0, max_seq_len), np.int32),
+                np.zeros((0, max_seq_len), np.int64))
+    return seqs[:, :-1], seqs[:, 1:].astype(np.int64)
+
+
+def load_shakespeare(data_dir, client_num=None, leaf=False):
+    """8-tuple loader. ``leaf=False`` reads the TFF h5 export
+    (``shakespeare_{train,test}.h5`` with ``examples/<cid>/snippets``,
+    reference ``fed_shakespeare/data_loader.py:20-52``); ``leaf=True`` reads
+    LEAF JSON where x is raw 80-char strings and y the next char."""
+    if leaf:
+        return _load_leaf_shakespeare(data_dir, client_num)
+
+    import h5py
+    train_path = os.path.join(data_dir, "shakespeare_train.h5")
+    test_path = os.path.join(data_dir, "shakespeare_test.h5")
+    for p in (train_path, test_path):
+        if not os.path.isfile(p):
+            raise FileNotFoundError(
+                f"shakespeare h5 not found: {p}. Use "
+                "dataset='synthetic_sequences' in this zero-egress environment.")
+    train_h5 = h5py.File(train_path, "r")
+    test_h5 = h5py.File(test_path, "r")
+    try:
+        train_ids = sorted(train_h5["examples"].keys())
+        test_ids = set(test_h5["examples"].keys())
+        if client_num is not None:
+            train_ids = train_ids[:client_num]
+        train_local, test_local, train_num = {}, {}, {}
+        xs_tr, ys_tr, xs_te, ys_te = [], [], [], []
+        for i, cid in enumerate(train_ids):
+            snips = [s.decode("utf8")
+                     for s in train_h5["examples"][cid]["snippets"][()]]
+            xt, yt = preprocess_snippets(snips)
+            if cid in test_ids:
+                snips_te = [s.decode("utf8")
+                            for s in test_h5["examples"][cid]["snippets"][()]]
+                xe, ye = preprocess_snippets(snips_te)
+            else:
+                xe, ye = xt[:0], yt[:0]
+            train_local[i] = {"x": xt, "y": yt}
+            test_local[i] = {"x": xe, "y": ye}
+            train_num[i] = len(yt)
+            xs_tr.append(xt); ys_tr.append(yt); xs_te.append(xe); ys_te.append(ye)
+    finally:
+        train_h5.close()
+        test_h5.close()
+
+    x_train = np.concatenate(xs_tr); y_train = np.concatenate(ys_tr)
+    x_test = np.concatenate(xs_te); y_test = np.concatenate(ys_te)
+    return [len(y_train), len(y_test),
+            {"x": x_train, "y": y_train}, {"x": x_test, "y": y_test},
+            train_num, train_local, test_local, VOCAB_SIZE]
+
+
+def _load_leaf_shakespeare(data_dir, client_num=None):
+    """LEAF JSON shakespeare: per-user x = list of 80-char strings, y = next
+    char (reference ``shakespeare/language_utils.py`` word/letter mapping)."""
+    from fedml_tpu.data.leaf import read_leaf_dir
+
+    train_users, train_data = read_leaf_dir(os.path.join(data_dir, "train"))
+    test_users, test_data = read_leaf_dir(os.path.join(data_dir, "test"))
+    users = train_users if client_num is None else train_users[:client_num]
+
+    def encode(xs, ys):
+        x = np.asarray([[_CHAR_TO_ID.get(c, OOV_ID) for c in s] for s in xs],
+                       np.int32)
+        y = np.asarray([_CHAR_TO_ID.get(c[0] if c else "", OOV_ID) for c in ys],
+                       np.int64)
+        return x, y
+
+    train_local, test_local, train_num = {}, {}, {}
+    xs_tr, ys_tr, xs_te, ys_te = [], [], [], []
+    for i, u in enumerate(users):
+        xt, yt = encode(train_data[u]["x"], train_data[u]["y"])
+        if u in test_data:
+            xe, ye = encode(test_data[u]["x"], test_data[u]["y"])
+        else:
+            xe, ye = xt[:0], yt[:0]
+        train_local[i] = {"x": xt, "y": yt}
+        test_local[i] = {"x": xe, "y": ye}
+        train_num[i] = len(yt)
+        xs_tr.append(xt); ys_tr.append(yt); xs_te.append(xe); ys_te.append(ye)
+
+    x_train = np.concatenate(xs_tr); y_train = np.concatenate(ys_tr)
+    x_test = np.concatenate(xs_te); y_test = np.concatenate(ys_te)
+    return [len(y_train), len(y_test),
+            {"x": x_train, "y": y_train}, {"x": x_test, "y": y_test},
+            train_num, train_local, test_local, VOCAB_SIZE]

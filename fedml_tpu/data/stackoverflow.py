@@ -1,0 +1,133 @@
+"""StackOverflow federated datasets: next-word prediction (nwp) and
+logistic-regression tag prediction (lr).
+
+Parity: reference ``fedml_api/data_preprocessing/stackoverflow_nwp/`` and
+``stackoverflow_lr/`` -- TFF h5 export (``stackoverflow_{train,test}.h5``,
+``examples/<cid>/tokens|title|tags``) with a 10k-word vocabulary
+(+pad/bos/eos/oov specials for nwp; 10k word-count features x 500 tag
+multilabels for lr). Vocab files: ``stackoverflow.word_count`` /
+``stackoverflow.tag_count`` (most-common-first, one token per line).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+
+import numpy as np
+
+SEQUENCE_LENGTH = 20
+DEFAULT_VOCAB_SIZE = 10000
+DEFAULT_TAG_SIZE = 500
+PAD_ID = 0
+
+
+def load_word_vocab(data_dir, vocab_size=DEFAULT_VOCAB_SIZE):
+    path = os.path.join(data_dir, "stackoverflow.word_count")
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"vocab file not found: {path}. Use dataset='synthetic_sequences' "
+            "in this zero-egress environment.")
+    words = []
+    with open(path) as f:
+        for line in f:
+            words.append(line.split()[0])
+            if len(words) >= vocab_size:
+                break
+    return {w: i for i, w in enumerate(words)}
+
+
+def tokens_to_ids(sentence, vocab, seq_len=SEQUENCE_LENGTH):
+    """bos + word ids + eos, pad/truncate to ``seq_len + 1`` then split into
+    next-word (x, y) (reference ``stackoverflow_nwp/utils.py`` preprocess)."""
+    V = len(vocab)
+    bos, eos, oov = V + 1, V + 2, V + 3
+    ids = [bos] + [vocab.get(w, oov) + 1 for w in sentence.split()]
+    ids = ids[:seq_len] + [eos]
+    ids = ids[:seq_len + 1]
+    ids += [PAD_ID] * (seq_len + 1 - len(ids))
+    return ids
+
+
+def load_stackoverflow(data_dir, task="nwp", client_num=None,
+                       vocab_size=DEFAULT_VOCAB_SIZE, tag_size=DEFAULT_TAG_SIZE):
+    import h5py
+    train_path = os.path.join(data_dir, "stackoverflow_train.h5")
+    test_path = os.path.join(data_dir, "stackoverflow_test.h5")
+    for p in (train_path, test_path):
+        if not os.path.isfile(p):
+            raise FileNotFoundError(
+                f"stackoverflow h5 not found: {p}. Use "
+                "dataset='synthetic_sequences' in this zero-egress environment.")
+    vocab = load_word_vocab(data_dir, vocab_size)
+    if task == "lr":
+        tags = _load_tag_vocab(data_dir, tag_size)
+
+    train_h5 = h5py.File(train_path, "r")
+    test_h5 = h5py.File(test_path, "r")
+    try:
+        train_ids = sorted(train_h5["examples"].keys())
+        test_ids = set(test_h5["examples"].keys())
+        if client_num is not None:
+            train_ids = train_ids[:client_num]
+
+        def encode_client(h5, cid):
+            g = h5["examples"][cid]
+            sents = [t.decode("utf8") for t in g["tokens"][()]]
+            if task == "nwp":
+                seqs = np.asarray([tokens_to_ids(s, vocab) for s in sents],
+                                  np.int32)
+                if len(seqs) == 0:
+                    return (np.zeros((0, SEQUENCE_LENGTH), np.int32),
+                            np.zeros((0, SEQUENCE_LENGTH), np.int64))
+                return seqs[:, :-1], seqs[:, 1:].astype(np.int64)
+            # lr: bag-of-words over title+tokens -> multi-hot tags
+            titles = [t.decode("utf8") for t in g["title"][()]]
+            tag_strs = [t.decode("utf8") for t in g["tags"][()]]
+            x = np.zeros((len(sents), len(vocab)), np.float32)
+            y = np.zeros((len(sents), len(tags)), np.float32)
+            for i, (s, ti, tg) in enumerate(zip(sents, titles, tag_strs)):
+                cnt = collections.Counter(
+                    w for w in (s + " " + ti).split() if w in vocab)
+                for w, c in cnt.items():
+                    x[i, vocab[w]] = c
+                for t in tg.split("|"):
+                    if t in tags:
+                        y[i, tags[t]] = 1.0
+            return x, y
+
+        train_local, test_local, train_num = {}, {}, {}
+        xs_tr, ys_tr, xs_te, ys_te = [], [], [], []
+        for i, cid in enumerate(train_ids):
+            xt, yt = encode_client(train_h5, cid)
+            if cid in test_ids:
+                xe, ye = encode_client(test_h5, cid)
+            else:
+                xe, ye = xt[:0], yt[:0]
+            train_local[i] = {"x": xt, "y": yt}
+            test_local[i] = {"x": xe, "y": ye}
+            train_num[i] = len(yt)
+            xs_tr.append(xt); ys_tr.append(yt); xs_te.append(xe); ys_te.append(ye)
+    finally:
+        train_h5.close()
+        test_h5.close()
+
+    x_train = np.concatenate(xs_tr); y_train = np.concatenate(ys_tr)
+    x_test = np.concatenate(xs_te); y_test = np.concatenate(ys_te)
+    class_num = (vocab_size + 4) if task == "nwp" else tag_size
+    return [len(y_train), len(y_test),
+            {"x": x_train, "y": y_train}, {"x": x_test, "y": y_test},
+            train_num, train_local, test_local, class_num]
+
+
+def _load_tag_vocab(data_dir, tag_size=DEFAULT_TAG_SIZE):
+    path = os.path.join(data_dir, "stackoverflow.tag_count")
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"tag vocab file not found: {path}")
+    tags = []
+    with open(path) as f:
+        for line in f:
+            tags.append(line.split()[0])
+            if len(tags) >= tag_size:
+                break
+    return {t: i for i, t in enumerate(tags)}

@@ -1,0 +1,92 @@
+"""TFF-exported HDF5 loaders: FederatedEMNIST and fed_cifar100.
+
+Schema parity: reference ``fedml_api/data_preprocessing/FederatedEMNIST/
+data_loader.py:13-66`` (``fed_emnist_{train,test}.h5`` with
+``examples/<client_id>/pixels|label``) and ``fed_cifar100/data_loader.py``
+(``fed_cifar100_{train,test}.h5`` with ``examples/<client_id>/image|label``).
+Natural client keying -- each h5 client group is one FL client.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+_EXAMPLE = "examples"
+
+
+def _open_h5(path):
+    import h5py
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"TFF h5 file not found: {path}. Download it (reference "
+            "data/FederatedEMNIST/download_federatedEMNIST.sh) or use "
+            "dataset='synthetic_images' in this zero-egress environment.")
+    return h5py.File(path, "r")
+
+
+def _load_tff_pair(data_dir, train_file, test_file, x_key, y_key,
+                   client_num=None, x_map=None):
+    train_h5 = _open_h5(os.path.join(data_dir, train_file))
+    test_h5 = _open_h5(os.path.join(data_dir, test_file))
+    try:
+        train_ids = sorted(train_h5[_EXAMPLE].keys())
+        test_ids = set(test_h5[_EXAMPLE].keys())
+        if client_num is not None:
+            train_ids = train_ids[:client_num]
+
+        train_local, test_local, train_num = {}, {}, {}
+        xs_tr, ys_tr, xs_te, ys_te = [], [], [], []
+        for i, cid in enumerate(train_ids):
+            g = train_h5[_EXAMPLE][cid]
+            xt = np.asarray(g[x_key][()], np.float32)
+            yt = np.asarray(g[y_key][()], np.int64)
+            if x_map is not None:
+                xt = x_map(xt)
+            if cid in test_ids:
+                gt = test_h5[_EXAMPLE][cid]
+                xe = np.asarray(gt[x_key][()], np.float32)
+                ye = np.asarray(gt[y_key][()], np.int64)
+                if x_map is not None:
+                    xe = x_map(xe)
+            else:
+                xe, ye = xt[:0], yt[:0]
+            train_local[i] = {"x": xt, "y": yt}
+            test_local[i] = {"x": xe, "y": ye}
+            train_num[i] = len(yt)
+            xs_tr.append(xt); ys_tr.append(yt); xs_te.append(xe); ys_te.append(ye)
+    finally:
+        train_h5.close()
+        test_h5.close()
+
+    x_train = np.concatenate(xs_tr); y_train = np.concatenate(ys_tr)
+    x_test = np.concatenate(xs_te); y_test = np.concatenate(ys_te)
+    class_num = int(max(y_train.max(), y_test.max() if len(y_test) else 0)) + 1
+    return [len(y_train), len(y_test),
+            {"x": x_train, "y": y_train}, {"x": x_test, "y": y_test},
+            train_num, train_local, test_local, class_num]
+
+
+def load_fed_emnist(data_dir, client_num=None):
+    """3400-client federated EMNIST (62 classes, 28x28)."""
+    return _load_tff_pair(data_dir, "fed_emnist_train.h5", "fed_emnist_test.h5",
+                          "pixels", "label", client_num)
+
+
+def load_fed_cifar100(data_dir, client_num=None, crop=24):
+    """500-client federated CIFAR-100. The reference pipeline center-crops to
+    24x24 and normalizes (``fed_cifar100/utils.py``); replicated via x_map."""
+    mean = np.array([0.5071, 0.4865, 0.4409], np.float32)
+    std = np.array([0.2673, 0.2564, 0.2762], np.float32)
+
+    def x_map(x):
+        x = x / 255.0 if x.max() > 1.5 else x
+        if crop and x.shape[1] > crop:
+            off = (x.shape[1] - crop) // 2
+            x = x[:, off:off + crop, off:off + crop, :]
+        return ((x - mean) / std).astype(np.float32)
+
+    return _load_tff_pair(data_dir, "fed_cifar100_train.h5",
+                          "fed_cifar100_test.h5", "image", "label",
+                          client_num, x_map=x_map)

@@ -1,0 +1,14 @@
+"""Flax model zoo: TPU-native re-designs of the reference's PyTorch models
+(``fedml_api/model/``). All modules are NHWC (TPU-preferred layout) and return
+logits; losses live in the TrainSpec layer so every model composes with every
+FL algorithm.
+"""
+
+from fedml_tpu.models.linear import LogisticRegression  # noqa: F401
+from fedml_tpu.models.cnn import CNNOriginalFedAvg, CNNDropOut  # noqa: F401
+from fedml_tpu.models.resnet import CifarResNet, resnet56, resnet110  # noqa: F401
+from fedml_tpu.models.resnet_gn import ResNetGN, resnet18_gn, resnet34_gn, resnet50_gn  # noqa: F401
+from fedml_tpu.models.mobilenet import MobileNet  # noqa: F401
+from fedml_tpu.models.vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from fedml_tpu.models.rnn import RNNOriginalFedAvg, RNNStackOverflow  # noqa: F401
+from fedml_tpu.models.factory import create_model  # noqa: F401

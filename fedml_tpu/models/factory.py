@@ -1,0 +1,55 @@
+"""Model factory: name -> Flax module, mirroring the reference's
+``create_model`` switch (``fedml_experiments/distributed/fedavg/
+main_fedavg.py:217-252``) so reference run commands translate 1:1.
+"""
+
+from __future__ import annotations
+
+import logging
+
+
+def create_model(args, model_name, output_dim):
+    """Return an uninitialized Flax module for ``model_name``.
+
+    Accepted names (reference ``main_fedavg.py:217-252`` plus aliases):
+    lr, cnn, cnn_dropout, resnet56, resnet110, resnet18_gn, resnet34_gn,
+    resnet50_gn, mobilenet, vgg11/13/16/19, rnn (shakespeare LSTM),
+    rnn_stackoverflow.
+    """
+    from fedml_tpu import models
+
+    logging.info("create_model. model_name = %s, output_dim = %s",
+                 model_name, output_dim)
+    group_norm = getattr(args, "group_norm_channels", 32) if args else 32
+    only_digits = output_dim == 10
+
+    if model_name == "lr":
+        return models.LogisticRegression(num_classes=output_dim)
+    if model_name == "cnn":
+        return models.CNNOriginalFedAvg(only_digits=only_digits)
+    if model_name == "cnn_dropout":
+        return models.CNNDropOut(only_digits=only_digits)
+    if model_name == "resnet56":
+        return models.resnet56(class_num=output_dim)
+    if model_name == "resnet110":
+        return models.resnet110(class_num=output_dim)
+    if model_name == "resnet18_gn":
+        return models.resnet18_gn(class_num=output_dim, group_norm=group_norm)
+    if model_name == "resnet34_gn":
+        return models.resnet34_gn(class_num=output_dim, group_norm=group_norm)
+    if model_name == "resnet50_gn":
+        return models.resnet50_gn(class_num=output_dim, group_norm=group_norm)
+    if model_name == "mobilenet":
+        return models.MobileNet(num_classes=output_dim)
+    if model_name in ("vgg11", "vgg13", "vgg16", "vgg19"):
+        fn = getattr(models, model_name)
+        return fn(class_num=output_dim,
+                  batch_norm=getattr(args, "vgg_bn", False) if args else False)
+    if model_name == "rnn":
+        return models.RNNOriginalFedAvg(vocab_size=output_dim)
+    if model_name == "rnn_fed_shakespeare":
+        return models.RNNOriginalFedAvg(vocab_size=output_dim,
+                                        output_all_timesteps=True)
+    if model_name == "rnn_stackoverflow":
+        return models.RNNStackOverflow(vocab_size=output_dim - 4)
+    raise ValueError(f"unknown model: {model_name}")

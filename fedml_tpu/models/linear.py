@@ -1,0 +1,49 @@
+"""Linear models. Parity: reference ``fedml_api/model/linear/lr.py:4-11``."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LogisticRegression(nn.Module):
+    """Single dense layer. The reference applies a sigmoid at the output and
+    then feeds it to CrossEntropyLoss (``lr.py:10-11`` -- a quirk it inherited
+    from LEAF); ``apply_sigmoid=True`` reproduces that exactly so accuracy
+    curves are comparable. Default returns plain logits.
+    """
+    num_classes: int
+    apply_sigmoid: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        logits = nn.Dense(self.num_classes, name="linear")(x)
+        if self.apply_sigmoid:
+            return nn.sigmoid(logits)
+        return logits
+
+
+class DenseModel(nn.Module):
+    """Dense head used by vertical FL (reference
+    ``fedml_api/model/finance/vfl_models_standalone.py``): a linear layer with
+    optional bias, trained by exchanged gradients rather than local loss."""
+    output_dim: int = 1
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.Dense(self.output_dim, use_bias=self.use_bias, name="dense")(x)
+
+
+class LocalModel(nn.Module):
+    """Feature extractor for a vertical-FL party (reference
+    ``vfl_models_standalone.py`` LocalModel: dense -> relu stack)."""
+    hidden_dims: tuple = (32,)
+    output_dim: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for i, h in enumerate(self.hidden_dims):
+            x = nn.relu(nn.Dense(h, name=f"hidden_{i}")(x))
+        return nn.Dense(self.output_dim, name="out")(x)

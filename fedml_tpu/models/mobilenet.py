@@ -1,0 +1,50 @@
+"""MobileNet v1 (depthwise separable). Parity: reference
+``fedml_api/model/cv/mobilenet.py:60,207`` (standard 13-block v1, width 1.0).
+Depthwise convs use ``feature_group_count`` so XLA lowers them onto the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# (filters, stride) per depthwise-separable block, standard MobileNet v1
+_CFG = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+        (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2), (1024, 1)]
+
+
+class _DepthwiseSeparable(nn.Module):
+    filters: int
+    strides: int
+    norm: Any
+
+    @nn.compact
+    def __call__(self, x):
+        in_ch = x.shape[-1]
+        x = nn.Conv(in_ch, (3, 3), strides=self.strides, padding=1,
+                    feature_group_count=in_ch, use_bias=False, name="dw")(x)
+        x = nn.relu(self.norm(name="bn1")(x))
+        x = nn.Conv(self.filters, (1, 1), use_bias=False, name="pw")(x)
+        return nn.relu(self.norm(name="bn2")(x))
+
+
+class MobileNet(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        from functools import partial
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), strides=1, padding=1, use_bias=False,
+                    name="conv1")(x)
+        x = nn.relu(norm(name="bn1")(x))
+        for i, (filters, strides) in enumerate(_CFG):
+            x = _DepthwiseSeparable(filters, strides, norm, name=f"block{i}")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(
+            x.astype(jnp.float32))

@@ -1,0 +1,73 @@
+"""CIFAR ResNets (BasicBlock, BatchNorm). Parity: reference
+``fedml_api/model/cv/resnet.py:202,225`` (resnet56 / resnet110: 6n+2 layout,
+channels 16/32/64, BN + identity-padding-free 1x1 downsample shortcut).
+
+BatchNorm running statistics live in the ``batch_stats`` collection; FedAvg
+averages them along with weights (the reference averages full state_dicts,
+``FedAVGAggregator.py:72-83``) while defenses exclude them
+(``fedml_tpu.core.robust``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    norm: ModuleDef = None
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), strides=self.strides, padding=1,
+                    use_bias=False, name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding=1, use_bias=False, name="conv2")(y)
+        y = self.norm(name="bn2")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), strides=self.strides,
+                               use_bias=False, name="downsample_conv")(x)
+            residual = self.norm(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class CifarResNet(nn.Module):
+    """6n+2 CIFAR ResNet; ``depth`` in {20, 32, 44, 56, 110}."""
+    depth: int = 56
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        assert (self.depth - 2) % 6 == 0, "depth must be 6n+2"
+        n = (self.depth - 2) // 6
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=False, name="conv1")(x)
+        x = norm(name="bn1")(x)
+        x = nn.relu(x)
+        for stage, (filters, strides) in enumerate([(16, 1), (32, 2), (64, 2)]):
+            for block in range(n):
+                x = BasicBlock(filters, strides if block == 0 else 1, norm,
+                               name=f"layer{stage + 1}_block{block}")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(
+            x.astype(jnp.float32))
+
+
+def resnet56(class_num=10, **kw):
+    return CifarResNet(depth=56, num_classes=class_num, **kw)
+
+
+def resnet110(class_num=10, **kw):
+    return CifarResNet(depth=110, num_classes=class_num, **kw)

@@ -1,0 +1,118 @@
+"""Torchvision-layout ResNets with a GroupNorm knob. Parity: reference
+``fedml_api/model/cv/resnet_gn.py:183-235`` (resnet18..152 where ``group_norm``
+= channels-per-group; 0 selects BatchNorm -- ``norm2d`` at ``resnet_gn.py:26-33``)
+and ``group_normalization.py:56-104`` (GroupNorm2d). Used for fed_cifar100
+(ResNet-18 + GN, baseline 44.7% -- BASELINE.md).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _norm(group_norm: int, train: bool, dtype):
+    """``group_norm`` > 0: GroupNorm with that many channels per group
+    (reference ``norm2d``); otherwise BatchNorm."""
+    if group_norm > 0:
+        def gn(name=None):
+            # flax GroupNorm takes num_groups; convert channels-per-group at
+            # call time via group_size
+            return nn.GroupNorm(num_groups=None, group_size=group_norm,
+                                epsilon=1e-5, dtype=dtype, name=name)
+        return gn
+    return partial(nn.BatchNorm, use_running_average=not train,
+                   momentum=0.9, epsilon=1e-5, dtype=dtype)
+
+
+class _BasicBlockGN(nn.Module):
+    filters: int
+    strides: int
+    norm: Any
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), strides=self.strides, padding=1,
+                    use_bias=False, name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding=1, use_bias=False, name="conv2")(y)
+        y = self.norm(name="bn2")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), strides=self.strides,
+                               use_bias=False, name="downsample_conv")(x)
+            residual = self.norm(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class _BottleneckGN(nn.Module):
+    filters: int
+    strides: int
+    norm: Any
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, name="conv1")(x)
+        y = nn.relu(self.norm(name="bn1")(y))
+        y = nn.Conv(self.filters, (3, 3), strides=self.strides, padding=1,
+                    use_bias=False, name="conv2")(y)
+        y = nn.relu(self.norm(name="bn2")(y))
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False, name="conv3")(y)
+        y = self.norm(name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters * 4, (1, 1), strides=self.strides,
+                               use_bias=False, name="downsample_conv")(x)
+            residual = self.norm(name="downsample_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNetGN(nn.Module):
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+    block: str = "basic"  # "basic" | "bottleneck"
+    num_classes: int = 1000
+    group_norm: int = 32  # channels per group; 0 = BatchNorm
+    small_input: bool = True  # 3x3 stem for CIFAR-size inputs
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = _norm(self.group_norm, train, self.dtype)
+        block_cls = _BasicBlockGN if self.block == "basic" else _BottleneckGN
+        x = x.astype(self.dtype)
+        if self.small_input:
+            x = nn.Conv(64, (3, 3), padding=1, use_bias=False, name="conv1")(x)
+            x = nn.relu(norm(name="bn1")(x))
+        else:
+            x = nn.Conv(64, (7, 7), strides=2, padding=3, use_bias=False,
+                        name="conv1")(x)
+            x = nn.relu(norm(name="bn1")(x))
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, size in enumerate(self.stage_sizes):
+            filters = 64 * (2 ** stage)
+            for b in range(size):
+                strides = 2 if (stage > 0 and b == 0) else 1
+                x = block_cls(filters, strides, norm,
+                              name=f"layer{stage + 1}_block{b}")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(
+            x.astype(jnp.float32))
+
+
+def resnet18_gn(class_num=10, group_norm=32, **kw):
+    return ResNetGN(stage_sizes=(2, 2, 2, 2), block="basic",
+                    num_classes=class_num, group_norm=group_norm, **kw)
+
+
+def resnet34_gn(class_num=10, group_norm=32, **kw):
+    return ResNetGN(stage_sizes=(3, 4, 6, 3), block="basic",
+                    num_classes=class_num, group_norm=group_norm, **kw)
+
+
+def resnet50_gn(class_num=10, group_norm=32, **kw):
+    return ResNetGN(stage_sizes=(3, 4, 6, 3), block="bottleneck",
+                    num_classes=class_num, group_norm=group_norm, **kw)

@@ -1,0 +1,63 @@
+"""LSTM language models. Parity: reference ``fedml_api/model/nlp/rnn.py``.
+
+- ``RNNOriginalFedAvg`` (``rnn.py:4-36``): 8-d embedding (vocab 90), 2x
+  LSTM-256, dense head. ``output_all_timesteps=False`` predicts from the final
+  hidden state (LEAF shakespeare); ``True`` emits per-position logits
+  (fed_shakespeare, the commented variant at ``rnn.py:34-36``).
+- ``RNNStackOverflow`` (``rnn.py:39-70``): vocab 10000+4 specials, 96-d
+  embedding, LSTM-670, 96-d projection, tied-size output head.
+
+LSTMs run via ``flax.linen.RNN`` over ``OptimizedLSTMCell`` -- an
+``lax.scan`` whose per-step matmuls XLA fuses onto the MXU, replacing cuDNN
+LSTM kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class RNNOriginalFedAvg(nn.Module):
+    embedding_dim: int = 8
+    vocab_size: int = 90
+    hidden_size: int = 256
+    output_all_timesteps: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_seq, train: bool = False):
+        x = nn.Embed(self.vocab_size, self.embedding_dim, name="embeddings")(input_seq)
+        x = x.astype(self.dtype)
+        x = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size, dtype=self.dtype),
+                   name="lstm1")(x)
+        x = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size, dtype=self.dtype),
+                   name="lstm2")(x)
+        if not self.output_all_timesteps:
+            x = x[:, -1]
+        return nn.Dense(self.vocab_size, dtype=jnp.float32, name="fc")(
+            x.astype(jnp.float32))
+
+
+class RNNStackOverflow(nn.Module):
+    vocab_size: int = 10000
+    num_oov_buckets: int = 1
+    embedding_size: int = 96
+    latent_size: int = 670
+    num_layers: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_seq, train: bool = False):
+        extended_vocab = self.vocab_size + 3 + self.num_oov_buckets
+        x = nn.Embed(extended_vocab, self.embedding_size,
+                     name="word_embeddings")(input_seq)
+        x = x.astype(self.dtype)
+        for i in range(self.num_layers):
+            x = nn.RNN(nn.OptimizedLSTMCell(self.latent_size, dtype=self.dtype),
+                       name=f"lstm{i + 1}")(x)
+        x = nn.Dense(self.embedding_size, dtype=jnp.float32, name="fc1")(
+            x.astype(jnp.float32))
+        return nn.Dense(extended_vocab, dtype=jnp.float32, name="fc2")(x)

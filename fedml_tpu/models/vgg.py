@@ -1,0 +1,65 @@
+"""VGG 11/13/16/19 with optional BatchNorm. Parity: reference
+``fedml_api/model/cv/vgg.py:13,82-133`` (torchvision configs A/B/D/E)."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_CFGS = {
+    "A": (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "B": (64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "D": (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+          512, 512, 512, "M"),
+    "E": (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512, 512,
+          512, "M", 512, 512, 512, 512, "M"),
+}
+
+
+class VGG(nn.Module):
+    cfg: str = "A"
+    batch_norm: bool = False
+    num_classes: int = 10
+    classifier_dims: Sequence[int] = (4096, 4096)
+    dropout_rate: float = 0.5
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        conv_i = 0
+        for v in _CFGS[self.cfg]:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(v, (3, 3), padding=1, name=f"conv{conv_i}")(x)
+                if self.batch_norm:
+                    x = nn.BatchNorm(use_running_average=not train,
+                                     momentum=0.9, epsilon=1e-5,
+                                     dtype=self.dtype, name=f"bn{conv_i}")(x)
+                x = nn.relu(x)
+                conv_i += 1
+        x = x.reshape((x.shape[0], -1))
+        for i, h in enumerate(self.classifier_dims):
+            x = nn.relu(nn.Dense(h, name=f"fc{i}")(x))
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(
+            x.astype(jnp.float32))
+
+
+def vgg11(class_num=10, batch_norm=False, **kw):
+    return VGG(cfg="A", batch_norm=batch_norm, num_classes=class_num, **kw)
+
+
+def vgg13(class_num=10, batch_norm=False, **kw):
+    return VGG(cfg="B", batch_norm=batch_norm, num_classes=class_num, **kw)
+
+
+def vgg16(class_num=10, batch_norm=False, **kw):
+    return VGG(cfg="D", batch_norm=batch_norm, num_classes=class_num, **kw)
+
+
+def vgg19(class_num=10, batch_norm=False, **kw):
+    return VGG(cfg="E", batch_norm=batch_norm, num_classes=class_num, **kw)

@@ -1,0 +1,9 @@
+from fedml_tpu.parallel.mesh import make_client_mesh  # noqa: F401
+from fedml_tpu.parallel.packing import pack_cohort  # noqa: F401
+from fedml_tpu.parallel.engine import (  # noqa: F401
+    ClientUpdateConfig,
+    make_client_update,
+    make_sim_round,
+    make_sharded_round,
+    make_eval_fn,
+)

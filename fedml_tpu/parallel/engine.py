@@ -1,0 +1,242 @@
+"""The federated round engine: one XLA program per round.
+
+Reference behavior being replaced (SURVEY.md section 3.1): the server unicasts
+pickled state_dicts to N client processes, each runs E epochs of local SGD,
+sends weights back, and the server loops over state_dict keys on CPU.  Here
+the entire round --
+
+    per-client local-epochs ``lax.scan``  ->  weighted aggregation  ->  server step
+
+-- is a single jitted function. Client parallelism is ``vmap`` on one chip
+(standalone simulation, reference ``fedml_api/standalone/fedavg``) or
+``shard_map`` over a ``clients`` mesh axis (distributed, reference
+``fedml_api/distributed/fedavg``) with the weighted average as ``psum`` over
+ICI. Both placements share the same ``client_update`` and the same
+aggregator hooks, so every FL algorithm written against this engine runs in
+both paradigms -- the reference needed two separate implementations per
+algorithm (sections 2.2 vs 2.3).
+
+Aggregator hooks (see ``fedml_tpu.algorithms``):
+  payload_fn(local_state, global_state, aux) -> payload pytree
+      per-client transform before averaging (identity for FedAvg, norm-clip
+      for robust FedAvg, normalized delta for FedNova).
+  server_fn(global_state, avg_payload, server_state, rng) -> (new_global, new_server_state)
+      global update from the weighted-average payload (identity for FedAvg,
+      optimizer step on the pseudo-gradient for FedOpt).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from fedml_tpu.core import pytree
+from fedml_tpu.core.trainer import TrainSpec
+from fedml_tpu.parallel.mesh import CLIENT_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientUpdateConfig:
+    """Local-training hyperparameters (reference flags
+    ``--client_optimizer --lr --wd``, ``main_fedavg.py:46-105``; optimizer
+    construction parity with ``MyModelTrainer.py:25-31`` -- plain SGD or
+    Adam(amsgrad) with weight decay, fresh optimizer state every round)."""
+    optimizer: str = "sgd"
+    lr: float = 0.03
+    weight_decay: float = 0.0
+    momentum: float = 0.0
+    grad_clip: Optional[float] = None  # FedNAS clips local grads at 5.0
+
+
+def make_optimizer(cfg: ClientUpdateConfig) -> optax.GradientTransformation:
+    txs = []
+    if cfg.grad_clip:
+        txs.append(optax.clip_by_global_norm(cfg.grad_clip))
+    if cfg.optimizer == "sgd":
+        # torch.optim.SGD couples weight decay into the gradient
+        if cfg.weight_decay:
+            txs.append(optax.add_decayed_weights(cfg.weight_decay))
+        txs.append(optax.sgd(cfg.lr, momentum=cfg.momentum or None))
+    elif cfg.optimizer == "adam":
+        # reference uses Adam(amsgrad=True, wd) -- MyModelTrainer.py:29-31;
+        # torch couples wd into the gradient BEFORE the Adam statistics
+        if cfg.weight_decay:
+            txs.append(optax.add_decayed_weights(cfg.weight_decay))
+        txs.append(optax.amsgrad(cfg.lr))
+    else:
+        raise ValueError(f"unknown optimizer {cfg.optimizer}")
+    return optax.chain(*txs)
+
+
+def _split_state(state):
+    params = state["params"]
+    rest = {k: v for k, v in state.items() if k != "params"}
+    return params, rest
+
+
+def _tree_select(pred, new, old):
+    return jax.tree.map(lambda a, b: jnp.where(pred, a, b), new, old)
+
+
+def make_client_update(spec: TrainSpec, cfg: ClientUpdateConfig):
+    """Build the jittable per-client local-training function.
+
+    Returns ``fn(global_state, client_data, rng) -> (local_state, aux)`` where
+    ``client_data`` is one client's slice of a packed cohort
+    (``x [S,B,...], y [S,B,...], mask [S,B], n []``) and ``aux`` carries the
+    true sample count ``n`` and executed step count ``steps`` (FedNova's tau).
+    Fully-masked (padded) steps leave all carried state untouched.
+    """
+    optimizer = make_optimizer(cfg)
+
+    def client_update(global_state, client_data, rng):
+        params, rest = _split_state(global_state)
+        opt_state = optimizer.init(params)
+        S = client_data["mask"].shape[0]
+
+        def step(carry, xs):
+            params, rest, opt_state = carry
+            batch, step_idx = xs
+            step_rng = jax.random.fold_in(rng, step_idx)
+
+            def loss_wrapper(p):
+                state = dict(rest)
+                state["params"] = p
+                return spec.loss_fn(state, batch, step_rng, True)
+
+            (loss, (new_state, metrics)), grads = jax.value_and_grad(
+                loss_wrapper, has_aux=True)(params)
+            updates, new_opt = optimizer.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            new_rest = {k: new_state[k] for k in rest}
+            valid = jnp.sum(batch["mask"]) > 0
+            new_carry = _tree_select(valid, (new_params, new_rest, new_opt),
+                                     (params, rest, opt_state))
+            return new_carry, metrics
+
+        batches = {k: client_data[k] for k in ("x", "y", "mask")}
+        (params, rest, _), metrics = jax.lax.scan(
+            step, (params, rest, opt_state), (batches, jnp.arange(S)))
+        local_state = dict(rest)
+        local_state["params"] = params
+        steps_done = jnp.sum(jnp.any(client_data["mask"] > 0, axis=-1))
+        aux = {"n": client_data["n"], "steps": steps_done}
+        # metrics leaves are [S, ...] per-step sums; padded steps contributed 0
+        metrics_sum = jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics)
+        return local_state, aux, metrics_sum
+
+    return client_update
+
+
+def _default_payload(local_state, global_state, aux):
+    return local_state
+
+
+def _default_server(global_state, avg_payload, server_state, rng):
+    return avg_payload, server_state
+
+
+def make_sim_round(spec: TrainSpec, cfg: ClientUpdateConfig,
+                   payload_fn=None, server_fn=None):
+    """Single-chip round: clients vmapped over the cohort axis.
+
+    ``fn(global_state, server_state, cohort_data, rng) ->
+    (new_global, new_server_state, metrics)`` -- semantics of the reference
+    standalone loop (``fedavg_api.py:40-115``) in one jitted call.
+    """
+    client_update = make_client_update(spec, cfg)
+    payload_fn = payload_fn or _default_payload
+    server_fn = server_fn or _default_server
+
+    @jax.jit
+    def round_fn(global_state, server_state, cohort_data, rng):
+        C = cohort_data["mask"].shape[0]
+        # identical rng derivation as make_sharded_round so the two placements
+        # produce bit-identical trajectories for stochastic models too
+        rngs = jax.random.split(jax.random.fold_in(rng, 1), C)
+        server_rng = jax.random.fold_in(rng, 2)
+        local_states, aux, metrics = jax.vmap(
+            client_update, in_axes=(None, 0, 0))(global_state, cohort_data, rngs)
+        payloads = jax.vmap(payload_fn, in_axes=(0, None, 0))(
+            local_states, global_state, aux)
+        avg_payload = pytree.tree_weighted_mean(payloads, aux["n"])
+        new_global, new_server_state = server_fn(
+            global_state, avg_payload, server_state, server_rng)
+        return new_global, new_server_state, {"aux": aux, "metrics": metrics}
+
+    return round_fn
+
+
+def make_sharded_round(spec: TrainSpec, cfg: ClientUpdateConfig, mesh,
+                       payload_fn=None, server_fn=None):
+    """Pod-scale round: cohort sharded over the ``clients`` mesh axis.
+
+    Each shard trains ``C / n_shards`` clients (vmapped locally), then the
+    weighted average runs as ``psum`` collectives over ICI -- the TPU-native
+    replacement for MPISendThread + CPU aggregation (reference
+    ``mpi/com_manager.py:36-79`` + ``FedAVGAggregator.py:58-87``).
+    Works on any mesh size including 1x1, so the same code path serves
+    single-chip runs and pod slices.
+    """
+    client_update = make_client_update(spec, cfg)
+    payload_fn = payload_fn or _default_payload
+    server_fn = server_fn or _default_server
+
+    def shard_fn(global_state, server_state, cohort_data, rng):
+        # leading axis of cohort_data here is the *local* client count C/D
+        local_states, aux, metrics = jax.vmap(
+            client_update, in_axes=(None, 0, 0))(
+                global_state, cohort_data, cohort_data["rngs"])
+        payloads = jax.vmap(payload_fn, in_axes=(0, None, 0))(
+            local_states, global_state, aux)
+        w = aux["n"].astype(jnp.float32)
+        local_sum = jax.tree.map(
+            lambda x: jnp.tensordot(w, x.astype(jnp.float32), axes=(0, 0)), payloads)
+        total = jnp.maximum(jax.lax.psum(jnp.sum(w), CLIENT_AXIS), 1e-12)
+        avg_payload = jax.tree.map(
+            lambda x, t: (jax.lax.psum(x, CLIENT_AXIS) / total).astype(t.dtype),
+            local_sum, jax.tree.map(lambda x: x[0], payloads))
+        new_global, new_server_state = server_fn(
+            global_state, avg_payload, server_state, rng)
+        return new_global, new_server_state, {"aux": aux, "metrics": metrics}
+
+    sharded = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(), P(), P(CLIENT_AXIS), P()),
+        out_specs=(P(), P(), P(CLIENT_AXIS)),
+        check_vma=False)
+
+    @jax.jit
+    def round_fn(global_state, server_state, cohort_data, rng):
+        C = cohort_data["mask"].shape[0]
+        rngs = jax.random.split(jax.random.fold_in(rng, 1), C)
+        data = dict(cohort_data)
+        data["rngs"] = rngs
+        return sharded(global_state, server_state, data,
+                       jax.random.fold_in(rng, 2))
+
+    return round_fn
+
+
+def make_eval_fn(spec: TrainSpec):
+    """Jitted evaluation over packed masked batches (``pack_eval`` output).
+    Returns summed metric dict; divide by counts on host. Mirrors the
+    reference eval protocol (``FedAVGAggregator.py:99-163``) with the model
+    kept on device."""
+
+    @jax.jit
+    def eval_fn(state, data):
+        def step(carry, batch):
+            m = spec.metrics_fn(state, batch)
+            return carry, m
+
+        _, ms = jax.lax.scan(step, 0, {k: data[k] for k in ("x", "y", "mask")})
+        return jax.tree.map(lambda x: jnp.sum(x, axis=0), ms)
+
+    return eval_fn

@@ -1,0 +1,52 @@
+"""Device-mesh construction for federated rounds.
+
+The reference maps one FL client to one OS process via ``mpirun -np N+1``
+(``run_fedavg_distributed_pytorch.sh:18-38``). Here clients map to shards of a
+``clients`` mesh axis; aggregation collectives ride ICI within a slice and DCN
+across slices. A second optional ``model`` axis supports tensor-sharding large
+server models (FedGKT) without changing the round program.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+CLIENT_AXIS = "clients"
+MODEL_AXIS = "model"
+
+
+def make_client_mesh(n_client_shards=None, n_model_shards=1, devices=None):
+    """Build a ``(clients, model)`` mesh over available devices.
+
+    ``n_client_shards`` defaults to all devices / n_model_shards. On a single
+    chip this yields a 1x1 mesh -- the same round program runs unchanged, which
+    is how standalone simulation and pod execution share one code path.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if n_client_shards is None:
+        n_client_shards = len(devices) // n_model_shards
+    need = n_client_shards * n_model_shards
+    if need > len(devices):
+        raise ValueError(
+            f"mesh needs {need} devices, have {len(devices)}")
+    grid = np.array(devices[:need]).reshape(n_client_shards, n_model_shards)
+    return Mesh(grid, (CLIENT_AXIS, MODEL_AXIS))
+
+
+def client_sharding(mesh):
+    """Sharding for arrays with a leading client axis."""
+    return NamedSharding(mesh, P(CLIENT_AXIS))
+
+
+def replicated_sharding(mesh):
+    return NamedSharding(mesh, P())
+
+
+def shard_cohort(mesh, cohort_data):
+    """Place a packed cohort dict (leading axis = clients) onto the mesh."""
+    sh = client_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), cohort_data)

@@ -1,0 +1,98 @@
+"""Host-side cohort packing: ragged client datasets -> dense SPMD batches.
+
+The hardest part of running federated rounds as one XLA program is client
+heterogeneity (SURVEY.md section 7 "Hard parts" #1): LDA shards have wildly
+different sizes, but jitted code needs static shapes. We mask-and-pad: every
+client's local epoch schedule is materialized as ``[S, B]`` index batches where
+``S`` = max steps over the cohort; padded slots carry ``mask=0`` and are
+no-ops in the training scan. True sample counts are carried separately so the
+weighted aggregation uses the exact ``n_i`` of the reference
+(``FedAVGAggregator.py:63-67``).
+
+Shapes are bucketed to the cohort max, so recompilation happens only when the
+cohort max-steps bucket changes, not per client.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+def _per_epoch_steps(n, batch_size, drop_last=False):
+    per_epoch = n // batch_size if drop_last else math.ceil(n / batch_size)
+    return max(1, per_epoch)
+
+
+def _steps_for(n, batch_size, epochs, drop_last=False):
+    return _per_epoch_steps(n, batch_size, drop_last) * epochs
+
+
+def pack_cohort(client_datasets, batch_size, epochs, rng=None, drop_last=False,
+                step_bucket=8):
+    """Pack a cohort's datasets into dense arrays for one federated round.
+
+    Args:
+      client_datasets: list of ``{"x": np.ndarray [n_i, ...], "y": [n_i, ...]}``.
+      batch_size: local batch size (reference ``--batch_size``).
+      epochs: local epochs E (reference ``--epochs``).
+      rng: ``np.random.Generator`` for per-epoch shuffling.
+      drop_last: drop ragged final batch (reference DataLoader default keeps it).
+      step_bucket: round S up to a multiple of this to stabilize jit shapes.
+
+    Returns:
+      dict with ``x [C, S, B, ...]``, ``y [C, S, B, ...]``, ``mask [C, S, B]``
+      (float32 0/1), and ``n [C]`` true sample counts.
+    """
+    rng = rng or np.random.default_rng(0)
+    C = len(client_datasets)
+    steps = [_steps_for(len(d["y"]), batch_size, epochs, drop_last)
+             for d in client_datasets]
+    S = max(steps)
+    S = int(math.ceil(S / step_bucket) * step_bucket)
+
+    x0 = np.asarray(client_datasets[0]["x"])
+    y0 = np.asarray(client_datasets[0]["y"])
+    xs = np.zeros((C, S, batch_size) + x0.shape[1:], x0.dtype)
+    ys = np.zeros((C, S, batch_size) + y0.shape[1:], y0.dtype)
+    mask = np.zeros((C, S, batch_size), np.float32)
+    n = np.zeros((C,), np.float32)
+
+    for c, d in enumerate(client_datasets):
+        x, y = np.asarray(d["x"]), np.asarray(d["y"])
+        n_c = len(y)
+        n[c] = n_c
+        s = 0
+        for _ in range(epochs):
+            order = rng.permutation(n_c)
+            per_epoch = _per_epoch_steps(n_c, batch_size, drop_last)
+            for b in range(per_epoch):
+                idx = order[b * batch_size:(b + 1) * batch_size]
+                k = len(idx)
+                if k == 0:  # tiny client: reuse the epoch's data
+                    idx = order[:min(n_c, batch_size)]
+                    k = len(idx)
+                xs[c, s, :k] = x[idx]
+                ys[c, s, :k] = y[idx]
+                mask[c, s, :k] = 1.0
+                s += 1
+        # remaining [s, S) steps stay fully masked
+    return {"x": xs, "y": ys, "mask": mask, "n": n}
+
+
+def pack_eval(data, batch_size, pad_multiple=1):
+    """Pack a flat eval set into ``[S, B]`` masked batches."""
+    x, y = np.asarray(data["x"]), np.asarray(data["y"])
+    n = len(y)
+    S = max(1, math.ceil(n / batch_size))
+    S = int(math.ceil(S / pad_multiple) * pad_multiple)
+    xs = np.zeros((S, batch_size) + x.shape[1:], x.dtype)
+    ys = np.zeros((S, batch_size) + y.shape[1:], y.dtype)
+    mask = np.zeros((S, batch_size), np.float32)
+    for s in range(min(S, math.ceil(n / batch_size))):
+        idx = np.arange(s * batch_size, min((s + 1) * batch_size, n))
+        xs[s, :len(idx)] = x[idx]
+        ys[s, :len(idx)] = y[idx]
+        mask[s, :len(idx)] = 1.0
+    return {"x": xs, "y": ys, "mask": mask}

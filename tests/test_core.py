@@ -99,7 +99,7 @@ class TestPartition:
 
     def test_hetero_fix(self):
         labels = np.tile(np.arange(10), 100)
-        parts = hetero_fix_partition(labels, 5, 10, seed=0)
+        parts = hetero_fix_partition(labels, 5, seed=0)
         assert sum(len(p) for p in parts.values()) == 1000
         # each client sees few classes
         for i in range(5):

@@ -1,0 +1,164 @@
+import json
+import os
+
+import numpy as np
+import pytest
+
+from fedml_tpu.data import load_dataset, load_synthetic_federated
+from fedml_tpu.data.shakespeare import (
+    to_ids, preprocess_snippets, VOCAB_SIZE, BOS_ID, EOS_ID, PAD_ID)
+
+
+def _args(**kw):
+    import types
+    base = dict(client_num_in_total=4, partition_method="hetero",
+                partition_alpha=0.5, data_dir=None, seed=0)
+    base.update(kw)
+    return types.SimpleNamespace(**base)
+
+
+def _check_eight_tuple(ds, client_num):
+    (train_num, test_num, train_global, test_global, train_num_dict,
+     train_local, test_local, class_num) = ds
+    assert train_num == len(train_global["y"])
+    assert test_num == len(test_global["y"])
+    assert set(train_local.keys()) == set(range(client_num))
+    assert sum(train_num_dict.values()) == train_num
+    assert class_num > 1
+
+
+class TestSynthetic:
+    def test_contract(self):
+        ds = load_synthetic_federated(client_num=6, n_train=600, n_test=120)
+        _check_eight_tuple(ds, 6)
+
+    def test_alpha_beta_heterogeneity(self):
+        # alpha>0 gives each client its own labeling function -> a model fit
+        # on client 0's data should transfer poorly to client 1 vs alpha=0
+        iid = load_synthetic_federated(client_num=2, n_train=2000, alpha=0.0,
+                                       beta=0.0, seed=1)
+        het = load_synthetic_federated(client_num=2, n_train=2000, alpha=2.0,
+                                       beta=2.0, seed=1)
+
+        def cross_client_label_agreement(ds):
+            a, b = ds[5][0], ds[5][1]
+            # nearest-centroid labels per client: compare class means distance
+            ma = np.stack([a["x"][a["y"] == c].mean(0) if (a["y"] == c).any()
+                           else np.zeros(60) for c in range(10)])
+            mb = np.stack([b["x"][b["y"] == c].mean(0) if (b["y"] == c).any()
+                           else np.zeros(60) for c in range(10)])
+            return float(np.linalg.norm(ma - mb))
+
+        assert cross_client_label_agreement(het) > cross_client_label_agreement(iid)
+
+    def test_registry_synthetic_names(self):
+        for name in ("synthetic", "synthetic_images", "synthetic_sequences"):
+            ds = load_dataset(_args(), name)
+            _check_eight_tuple(ds, 4)
+
+
+class TestLeafJson:
+    def test_parse_leaf_dir(self, tmp_path):
+        rng = np.random.default_rng(0)
+        for split, n in (("train", 20), ("test", 5)):
+            d = tmp_path / split
+            d.mkdir()
+            blob = {
+                "users": ["u0", "u1"],
+                "num_samples": [n, n],
+                "user_data": {
+                    u: {"x": rng.normal(size=(n, 784)).tolist(),
+                        "y": rng.integers(0, 10, n).tolist()}
+                    for u in ("u0", "u1")},
+            }
+            (d / "data.json").write_text(json.dumps(blob))
+        ds = load_dataset(_args(data_dir=str(tmp_path),
+                                client_num_in_total=None), "mnist")
+        _check_eight_tuple(ds, 2)
+        assert ds[5][0]["x"].shape == (20, 784)
+
+    def test_missing_dir_raises_clear_error(self):
+        with pytest.raises(FileNotFoundError, match="synthetic"):
+            load_dataset(_args(data_dir="/nonexistent"), "mnist")
+
+
+class TestTffH5:
+    def test_fed_emnist_schema(self, tmp_path):
+        h5py = pytest.importorskip("h5py")
+        rng = np.random.default_rng(0)
+        for split, n in (("train", 12), ("test", 4)):
+            with h5py.File(tmp_path / f"fed_emnist_{split}.h5", "w") as f:
+                for cid in ("c0", "c1", "c2"):
+                    g = f.create_group(f"examples/{cid}")
+                    g.create_dataset("pixels", data=rng.random((n, 28, 28)))
+                    g.create_dataset("label", data=rng.integers(0, 62, n))
+        ds = load_dataset(_args(data_dir=str(tmp_path),
+                                client_num_in_total=None), "femnist")
+        _check_eight_tuple(ds, 3)
+        assert ds[5][0]["x"].shape == (12, 28, 28)
+
+    def test_fed_cifar100_crop(self, tmp_path):
+        h5py = pytest.importorskip("h5py")
+        rng = np.random.default_rng(0)
+        for split, n in (("train", 10), ("test", 4)):
+            with h5py.File(tmp_path / f"fed_cifar100_{split}.h5", "w") as f:
+                for cid in ("a", "b"):
+                    g = f.create_group(f"examples/{cid}")
+                    g.create_dataset("image",
+                                     data=rng.integers(0, 255, (n, 32, 32, 3)))
+                    g.create_dataset("label", data=rng.integers(0, 100, n))
+        ds = load_dataset(_args(data_dir=str(tmp_path),
+                                client_num_in_total=None), "fed_cifar100")
+        assert ds[5][0]["x"].shape == (10, 24, 24, 3)  # center crop applied
+
+
+class TestShakespeare:
+    def test_to_ids_roundtrip(self):
+        ids = to_ids("hello")
+        assert ids[0] == BOS_ID
+        assert len(ids) == 81
+        assert EOS_ID in ids
+        assert ids[-1] == PAD_ID  # short sentence is padded
+
+    def test_long_sentence_truncated(self):
+        ids = to_ids("x" * 200)
+        assert len(ids) == 81
+        assert PAD_ID not in ids
+
+    def test_vocab_size_matches_model(self):
+        assert VOCAB_SIZE == 90  # RNN_OriginalFedAvg vocab
+
+    def test_h5_loader(self, tmp_path):
+        h5py = pytest.importorskip("h5py")
+        for split in ("train", "test"):
+            with h5py.File(tmp_path / f"shakespeare_{split}.h5", "w") as f:
+                for cid in ("p0", "p1"):
+                    g = f.create_group(f"examples/{cid}")
+                    g.create_dataset(
+                        "snippets",
+                        data=[b"to be or not to be", b"that is the question"])
+        ds = load_dataset(_args(data_dir=str(tmp_path),
+                                client_num_in_total=None), "fed_shakespeare")
+        _check_eight_tuple(ds, 2)
+        assert ds[5][0]["x"].shape == (2, 80)
+        assert ds[7] == 90
+
+
+class TestCifar:
+    def test_cifar10_pickle_format(self, tmp_path):
+        import pickle
+        base = tmp_path / "cifar-10-batches-py"
+        base.mkdir()
+        rng = np.random.default_rng(0)
+        for name, n in [(f"data_batch_{i}", 20) for i in range(1, 6)] + \
+                        [("test_batch", 10)]:
+            blob = {b"data": rng.integers(0, 255, (n, 3072), dtype=np.uint8),
+                    b"labels": rng.integers(0, 10, n).tolist()}
+            with open(base / name, "wb") as f:
+                pickle.dump(blob, f)
+        ds = load_dataset(_args(data_dir=str(tmp_path), client_num_in_total=4,
+                                partition_method="homo"), "cifar10")
+        _check_eight_tuple(ds, 4)
+        assert ds[2]["x"].shape == (100, 32, 32, 3)
+        # normalized
+        assert abs(float(ds[2]["x"].mean())) < 1.0

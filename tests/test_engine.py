@@ -1,0 +1,200 @@
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flax.linen as nn
+
+from fedml_tpu import models
+from fedml_tpu.algorithms.specs import make_classification_spec
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, client_sampling
+from fedml_tpu.core import pytree
+from fedml_tpu.data import load_synthetic_federated
+from fedml_tpu.parallel.engine import (
+    ClientUpdateConfig, make_client_update, make_sim_round,
+    make_sharded_round, make_eval_fn)
+from fedml_tpu.parallel.mesh import make_client_mesh
+from fedml_tpu.parallel.packing import pack_cohort, pack_eval
+
+
+def _args(**kw):
+    base = dict(client_num_per_round=4, comm_round=2, epochs=1, batch_size=16,
+                lr=0.1, client_optimizer="sgd", wd=0.0,
+                frequency_of_the_test=1, ci=0, seed=0)
+    base.update(kw)
+    return types.SimpleNamespace(**base)
+
+
+def _lr_spec(feature_dim=60, classes=10):
+    model = models.LogisticRegression(num_classes=classes, apply_sigmoid=False)
+    return make_classification_spec(model, jnp.zeros((1, feature_dim)))
+
+
+class TestClientUpdate:
+    def test_padded_steps_are_noops(self):
+        spec = _lr_spec()
+        cfg = ClientUpdateConfig(lr=0.1)
+        update = make_client_update(spec, cfg)
+        rng = jax.random.PRNGKey(0)
+        state = spec.init_fn(rng)
+
+        x = np.random.default_rng(0).normal(size=(10, 60)).astype(np.float32)
+        y = np.zeros(10, np.int64)
+        # same data packed with different amounts of padding must agree
+        p1 = pack_cohort([{"x": x, "y": y}], batch_size=10, epochs=1,
+                         step_bucket=1)
+        p2 = pack_cohort([{"x": x, "y": y}], batch_size=10, epochs=1,
+                         step_bucket=16)
+        s1, aux1, _ = update(state, jax.tree.map(lambda a: a[0], p1), rng)
+        s2, aux2, _ = update(state, jax.tree.map(lambda a: a[0], p2), rng)
+        np.testing.assert_allclose(s1["params"]["linear"]["kernel"],
+                                   s2["params"]["linear"]["kernel"], atol=1e-6)
+        assert float(aux1["steps"]) == 1 and float(aux2["steps"]) == 1
+
+    def test_ragged_batches_masked_mean(self):
+        # 10 samples, batch 4 -> batches of 4,4,2; last batch mean over 2
+        spec = _lr_spec()
+        update = make_client_update(spec, ClientUpdateConfig(lr=0.05))
+        state = spec.init_fn(jax.random.PRNGKey(0))
+        x = np.random.default_rng(1).normal(size=(10, 60)).astype(np.float32)
+        y = np.arange(10) % 10
+        p = pack_cohort([{"x": x, "y": y}], batch_size=4, epochs=2,
+                        step_bucket=1)
+        assert p["mask"].shape[1] == 6  # 3 steps x 2 epochs
+        s, aux, metrics = update(state, jax.tree.map(lambda a: a[0], p),
+                                 jax.random.PRNGKey(1))
+        assert float(aux["n"]) == 10
+        assert float(metrics["count"]) == 20  # 10 samples x 2 epochs
+
+
+class TestFederatedEqualsCentralized:
+    """The CI equivalence invariant (reference ``CI-script-fedavg.sh:42-47``):
+    full-batch, 1-local-epoch FedAvg over all clients == one centralized
+    full-batch SGD step. Exact algebra of weighted psum aggregation."""
+
+    def test_equivalence(self):
+        spec = _lr_spec()
+        cfg = ClientUpdateConfig(lr=0.5)
+        rng = jax.random.PRNGKey(42)
+        state = spec.init_fn(rng)
+
+        rnd = np.random.default_rng(0)
+        clients = []
+        for n in (7, 13, 29, 11):  # ragged on purpose
+            clients.append({
+                "x": rnd.normal(size=(n, 60)).astype(np.float32),
+                "y": rnd.integers(0, 10, n).astype(np.int64)})
+        pooled = {"x": np.concatenate([c["x"] for c in clients]),
+                  "y": np.concatenate([c["y"] for c in clients])}
+
+        round_fn = make_sim_round(spec, cfg)
+        packed = pack_cohort(clients, batch_size=64, epochs=1)
+        fed_state, _, _ = round_fn(state, (), packed, rng)
+
+        central_packed = pack_cohort([pooled], batch_size=64, epochs=1)
+        central_state, _, _ = round_fn(state, (), central_packed, rng)
+
+        for a, b in zip(jax.tree.leaves(fed_state["params"]),
+                        jax.tree.leaves(central_state["params"])):
+            np.testing.assert_allclose(a, b, atol=2e-5)
+
+    def test_sim_equals_sharded(self):
+        spec = _lr_spec()
+        cfg = ClientUpdateConfig(lr=0.3)
+        state = spec.init_fn(jax.random.PRNGKey(7))
+        rnd = np.random.default_rng(3)
+        clients = [{"x": rnd.normal(size=(n, 60)).astype(np.float32),
+                    "y": rnd.integers(0, 10, n).astype(np.int64)}
+                   for n in (16, 8, 24, 12, 16, 8, 8, 20)]
+        packed = pack_cohort(clients, batch_size=8, epochs=1)
+
+        sim = make_sim_round(spec, cfg)
+        mesh = make_client_mesh(8)
+        sharded = make_sharded_round(spec, cfg, mesh)
+
+        s1, _, _ = sim(state, (), packed, jax.random.PRNGKey(5))
+        s2, _, _ = sharded(state, (), packed, jax.random.PRNGKey(5))
+        for a, b in zip(jax.tree.leaves(s1["params"]),
+                        jax.tree.leaves(s2["params"])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    def test_sharded_multiple_clients_per_shard(self):
+        spec = _lr_spec()
+        cfg = ClientUpdateConfig(lr=0.3)
+        state = spec.init_fn(jax.random.PRNGKey(7))
+        rnd = np.random.default_rng(3)
+        clients = [{"x": rnd.normal(size=(8, 60)).astype(np.float32),
+                    "y": rnd.integers(0, 10, 8).astype(np.int64)}
+                   for _ in range(16)]  # 16 clients over 8 shards -> 2 each
+        packed = pack_cohort(clients, batch_size=8, epochs=1)
+        sim = make_sim_round(spec, cfg)
+        sharded = make_sharded_round(spec, cfg, make_client_mesh(8))
+        s1, _, _ = sim(state, (), packed, jax.random.PRNGKey(5))
+        s2, _, _ = sharded(state, (), packed, jax.random.PRNGKey(5))
+        np.testing.assert_allclose(
+            np.asarray(s1["params"]["linear"]["kernel"]),
+            np.asarray(s2["params"]["linear"]["kernel"]), atol=1e-5)
+
+
+class TestBatchNormState:
+    def test_batch_stats_travel_through_round(self):
+        class TinyBN(nn.Module):
+            @nn.compact
+            def __call__(self, x, train=False):
+                x = nn.Dense(8)(x)
+                x = nn.BatchNorm(use_running_average=not train)(x)
+                return nn.Dense(3)(x)
+
+        model = TinyBN()
+        spec = make_classification_spec(model, jnp.zeros((1, 5)))
+        state = spec.init_fn(jax.random.PRNGKey(0))
+        assert "batch_stats" in state
+        rnd = np.random.default_rng(0)
+        clients = [{"x": rnd.normal(size=(12, 5)).astype(np.float32),
+                    "y": rnd.integers(0, 3, 12).astype(np.int64)}
+                   for _ in range(4)]
+        packed = pack_cohort(clients, batch_size=4, epochs=1)
+        round_fn = make_sim_round(spec, ClientUpdateConfig(lr=0.1))
+        new_state, _, _ = round_fn(state, (), packed, jax.random.PRNGKey(1))
+        # running stats must have moved away from init (mean 0)
+        assert not np.allclose(
+            np.asarray(jax.tree.leaves(new_state["batch_stats"])[0]),
+            np.asarray(jax.tree.leaves(state["batch_stats"])[0]))
+
+
+class TestFedAvgAPI:
+    def test_sampling_parity(self):
+        # reference reseeds np.random with the round index
+        a = client_sampling(3, 100, 10)
+        b = client_sampling(3, 100, 10)
+        assert a == b
+        np.random.seed(3)
+        expect = list(np.random.choice(range(100), 10, replace=False))
+        assert a == expect
+
+    def test_learning_happens(self):
+        dataset = load_synthetic_federated(client_num=8, n_train=800,
+                                           n_test=200, seed=0)
+        spec = _lr_spec()
+        args = _args(client_num_per_round=8, comm_round=8, lr=0.5,
+                     frequency_of_the_test=100)
+        api = FedAvgAPI(dataset, spec, args)
+        first = api.train_one_round()
+        for _ in range(7):
+            last = api.train_one_round()
+        final = api.evaluate_global()
+        assert last["Train/Acc"] > first["Train/Acc"]
+        # per-client labeling functions (LEAF synthetic) cap global accuracy;
+        # 0.25 is well above the 0.1 chance level
+        assert final["Test/Acc"] > 0.25
+
+    def test_partial_participation(self):
+        dataset = load_synthetic_federated(client_num=10, n_train=500,
+                                           n_test=100, seed=0)
+        spec = _lr_spec()
+        args = _args(client_num_per_round=3, comm_round=2)
+        api = FedAvgAPI(dataset, spec, args)
+        api.train()
+        assert len(api.history) == 2

@@ -1,0 +1,113 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu import models
+from fedml_tpu.core.pytree import tree_count_params
+
+
+def _init(model, x, **kw):
+    variables = model.init(jax.random.PRNGKey(0), x, **kw)
+    return variables
+
+
+class TestParamParity:
+    def test_cnn_original_fedavg_param_count(self):
+        # Reference cnn.py:10-12: exactly 1,663,370 params with only_digits
+        model = models.CNNOriginalFedAvg(only_digits=True)
+        v = _init(model, jnp.zeros((1, 28, 28)))
+        assert tree_count_params(v["params"]) == 1_663_370
+
+    def test_cnn_dropout_param_count(self):
+        # Reference cnn.py docstring: 1,199,882 params with only_digits
+        model = models.CNNDropOut(only_digits=True)
+        v = _init(model, jnp.zeros((1, 28, 28)))
+        assert tree_count_params(v["params"]) == 1_199_882
+
+    def test_lr_param_count(self):
+        model = models.LogisticRegression(num_classes=10)
+        v = _init(model, jnp.zeros((1, 28 * 28)))
+        assert tree_count_params(v["params"]) == 28 * 28 * 10 + 10
+
+
+class TestShapes:
+    def test_resnet56_forward(self):
+        model = models.resnet56(class_num=10)
+        x = jnp.zeros((2, 32, 32, 3))
+        v = _init(model, x)
+        out, mutated = model.apply(v, x, train=True, mutable=["batch_stats"])
+        assert out.shape == (2, 10)
+        assert "batch_stats" in v and "batch_stats" in mutated
+        assert out.dtype == jnp.float32
+
+    def test_resnet18_gn_forward_no_batch_stats(self):
+        model = models.resnet18_gn(class_num=100, group_norm=32)
+        x = jnp.zeros((2, 24, 24, 3))
+        v = _init(model, x)
+        assert "batch_stats" not in v  # GroupNorm is stateless
+        out = model.apply(v, x, train=True)
+        assert out.shape == (2, 100)
+
+    def test_resnet18_bn_mode(self):
+        model = models.resnet18_gn(class_num=10, group_norm=0)
+        x = jnp.zeros((1, 32, 32, 3))
+        v = _init(model, x)
+        assert "batch_stats" in v
+
+    def test_mobilenet_forward(self):
+        model = models.MobileNet(num_classes=10)
+        x = jnp.zeros((2, 32, 32, 3))
+        v = _init(model, x)
+        out, _ = model.apply(v, x, train=True, mutable=["batch_stats"])
+        assert out.shape == (2, 10)
+
+    def test_vgg11_forward(self):
+        model = models.vgg11(class_num=10, classifier_dims=(512,))
+        x = jnp.zeros((2, 32, 32, 3))
+        v = _init(model, x)
+        out = model.apply(v, x, train=False)
+        assert out.shape == (2, 10)
+
+    def test_rnn_shakespeare(self):
+        model = models.RNNOriginalFedAvg()
+        x = jnp.zeros((3, 80), jnp.int32)
+        v = _init(model, x)
+        out = model.apply(v, x)
+        assert out.shape == (3, 90)
+        # all-timesteps variant for fed_shakespeare
+        model2 = models.RNNOriginalFedAvg(output_all_timesteps=True)
+        v2 = _init(model2, x)
+        assert model2.apply(v2, x).shape == (3, 80, 90)
+
+    def test_rnn_stackoverflow(self):
+        model = models.RNNStackOverflow(vocab_size=100, latent_size=32)
+        x = jnp.zeros((2, 20), jnp.int32)
+        v = _init(model, x)
+        out = model.apply(v, x)
+        assert out.shape == (2, 20, 104)  # vocab + pad/bos/eos/oov
+
+    def test_lr_sigmoid_output(self):
+        model = models.LogisticRegression(num_classes=10, apply_sigmoid=True)
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 784))
+        v = _init(model, x)
+        out = model.apply(v, x)
+        assert float(out.min()) >= 0.0 and float(out.max()) <= 1.0
+
+
+class TestFactory:
+    @pytest.mark.parametrize("name,dim,x_shape", [
+        ("lr", 10, (1, 784)),
+        ("cnn", 62, (1, 28, 28)),
+        ("resnet56", 10, (1, 32, 32, 3)),
+        ("rnn", 90, (1, 80)),
+    ])
+    def test_create_model(self, name, dim, x_shape):
+        model = models.create_model(None, name, dim)
+        dtype = jnp.int32 if name == "rnn" else jnp.float32
+        v = model.init(jax.random.PRNGKey(0), jnp.zeros(x_shape, dtype))
+        assert v is not None
+
+    def test_unknown_model_raises(self):
+        with pytest.raises(ValueError, match="unknown model"):
+            models.create_model(None, "nope", 10)
