@@ -1,0 +1,109 @@
+"""Benchmark: FedAvg rounds/hour, CIFAR-10-scale ResNet-56, 32 clients.
+
+The north-star metric (BASELINE.json): CIFAR-10 + ResNet-56 cross-silo FedAvg
+with 32 clients -- reference recipe LDA alpha=0.5, bs64, SGD, 20 local epochs
+(``benchmark/README.md:105``, ``fedml_experiments/distributed/fedavg/
+README.md:38-52``, published at 10 clients) -- measured as rounds/hour.
+
+Baseline derivation (no wall-clock numbers are published in-repo, BASELINE.md):
+the reference runs one torch process per client over 8 V100s with pickle-over-
+MPI transport and 0.3 s receive polling. At 32 clients x (50000/32 samples x
+20 epochs / bs64) ~= 490 ResNet-56 steps per client per round, ~15 ms/step on
+V100, 4 waves over 8 GPUs => ~29 s compute + serialization of 32 full
+state_dicts and CPU aggregation => ~60 s/round ~= 60 rounds/hour. We use
+BASELINE_ROUNDS_PER_HOUR = 60 (an estimate favorable to the reference).
+
+Data is synthetic CIFAR-10-shaped (50000x32x32x3; zero-egress environment) --
+identical compute/communication profile to real CIFAR-10.
+
+Usage: python bench.py [--smoke] [--rounds N] [--epochs E]
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_ROUNDS_PER_HOUR = 60.0
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny config to validate the bench path quickly")
+    p.add_argument("--rounds", type=int, default=3,
+                   help="measured rounds (after one warmup/compile round)")
+    p.add_argument("--epochs", type=int, default=20)
+    p.add_argument("--clients", type=int, default=32)
+    p.add_argument("--batch_size", type=int, default=64)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu import models
+    from fedml_tpu.algorithms.specs import make_classification_spec
+    from fedml_tpu.data.synthetic import load_synthetic_images
+    from fedml_tpu.parallel.engine import ClientUpdateConfig, make_sim_round
+    from fedml_tpu.parallel.packing import pack_cohort
+
+    if args.smoke:
+        n_train, image, epochs, rounds = 2 * args.clients * 8, 16, 1, 1
+    else:
+        n_train, image, epochs, rounds = 50_000, 32, args.epochs, args.rounds
+
+    dataset = load_synthetic_images(
+        client_num=args.clients, n_train=n_train, n_test=max(64, n_train // 50),
+        image_size=image, partition="hetero", partition_alpha=0.5, seed=0)
+    train_local = dataset[5]
+
+    model = models.resnet56(class_num=10, dtype=jnp.bfloat16)
+    spec = make_classification_spec(
+        model, jnp.zeros((1, image, image, 3)))
+    cfg = ClientUpdateConfig(optimizer="sgd", lr=0.001, weight_decay=0.001)
+    round_fn = make_sim_round(spec, cfg)
+
+    state = spec.init_fn(jax.random.PRNGKey(0))
+    rng = jax.random.PRNGKey(1)
+    data_rng = np.random.default_rng(0)
+
+    def one_round(state, r):
+        packed = pack_cohort([train_local[i] for i in range(args.clients)],
+                             args.batch_size, epochs, rng=data_rng)
+        state, _, info = round_fn(state, (), packed,
+                                  jax.random.fold_in(rng, r))
+        jax.block_until_ready(state)
+        return state, info
+
+    # warmup (compile)
+    t0 = time.time()
+    state, _ = one_round(state, 0)
+    compile_s = time.time() - t0
+
+    times = []
+    for r in range(1, rounds + 1):
+        t0 = time.time()
+        state, info = one_round(state, r)
+        times.append(time.time() - t0)
+
+    round_s = float(np.median(times))
+    rph = 3600.0 / round_s
+    result = {
+        "metric": "FedAvg rounds/hour (CIFAR-10-scale ResNet-56, "
+                  f"{args.clients} clients, bs{args.batch_size}, "
+                  f"{epochs} local epochs)",
+        "value": round(rph, 2),
+        "unit": "rounds/hour",
+        "vs_baseline": round(rph / BASELINE_ROUNDS_PER_HOUR, 2),
+    }
+    print(json.dumps(result))
+    print(f"# round_time_s={round_s:.2f} compile_s={compile_s:.1f} "
+          f"times={[round(t, 2) for t in times]} device={jax.devices()[0]}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -8,5 +8,15 @@ user-facing API class matching the reference's per-algorithm surface
 from fedml_tpu.algorithms.specs import (  # noqa: F401
     make_classification_spec,
     make_seq_classification_spec,
+    make_multilabel_spec,
 )
 from fedml_tpu.algorithms.fedavg import FedAvgAPI  # noqa: F401
+from fedml_tpu.algorithms.fedopt import FedOptAPI  # noqa: F401
+from fedml_tpu.algorithms.fednova import FedNovaAPI  # noqa: F401
+from fedml_tpu.algorithms.fedavg_robust import FedAvgRobustAPI  # noqa: F401
+from fedml_tpu.algorithms.hierarchical import HierarchicalFedAvgAPI  # noqa: F401
+from fedml_tpu.algorithms.decentralized import DecentralizedFedAPI  # noqa: F401
+from fedml_tpu.algorithms.splitnn import SplitNNAPI  # noqa: F401
+from fedml_tpu.algorithms.fedgkt import FedGKTAPI  # noqa: F401
+from fedml_tpu.algorithms.vertical import VerticalFLAPI  # noqa: F401
+from fedml_tpu.algorithms.turboaggregate import TurboAggregateAPI  # noqa: F401

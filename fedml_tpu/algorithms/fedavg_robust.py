@@ -1,0 +1,55 @@
+"""Robust FedAvg: defenses against poisoning (reference
+``fedml_api/distributed/fedavg_robust/FedAvgRobustAggregator.py:10-130`` +
+``fedml_core/robustness/robust_aggregation.py``).
+
+Defense = per-client norm-difference clipping of the update (before the
+weighted average) + weak-DP Gaussian noise on the aggregate -- both pure
+pytree ops running on-device inside the round. Backdoor-accuracy evaluation
+uses the poisoned test set from ``fedml_tpu.data.poison``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.core.robust import add_gaussian_noise, norm_diff_clipping
+from fedml_tpu.parallel.packing import pack_eval
+
+
+def make_robust_hooks(norm_bound, stddev):
+    def payload_fn(local_state, global_state, aux):
+        return norm_diff_clipping(local_state, global_state, norm_bound)
+
+    def server_fn(global_state, avg_state, server_state, rng):
+        if stddev and stddev > 0:
+            avg_state = add_gaussian_noise(avg_state, stddev, rng)
+        return avg_state, server_state
+
+    return payload_fn, server_fn
+
+
+class FedAvgRobustAPI(FedAvgAPI):
+    """Extra args (reference ``main_fedavg_robust.py:56-83``):
+    ``norm_bound`` (clip radius), ``stddev`` (weak-DP noise); the poisoned
+    dataset itself comes from the data layer (``--poison_type`` etc.)."""
+
+    def __init__(self, dataset, spec, args, mesh=None, metrics_logger=None,
+                 poisoned_test_data=None):
+        payload_fn, server_fn = make_robust_hooks(
+            getattr(args, "norm_bound", 30.0),
+            getattr(args, "stddev", 0.025))
+        super().__init__(dataset, spec, args, mesh=mesh,
+                         payload_fn=payload_fn, server_fn=server_fn,
+                         metrics_logger=metrics_logger)
+        self.poisoned_test_data = poisoned_test_data
+
+    def evaluate_backdoor(self):
+        """Attack success rate on the poisoned test set (reference
+        ``test_target_accuracy``, ``FedAvgRobustAggregator.py:14-111``)."""
+        if self.poisoned_test_data is None:
+            return {}
+        import numpy as np
+        packed = pack_eval(self.poisoned_test_data, self.args.batch_size)
+        m = jax.tree.map(np.asarray, self.eval_fn(self.global_state, packed))
+        return {"Backdoor/Acc": float(m["correct"] / max(m["count"], 1))}

@@ -1,0 +1,45 @@
+"""FedNova: normalized averaging (reference
+``fedml_api/standalone/fednova/fednova.py:10-71`` + ``fednova_trainer.py:
+97-109``).
+
+Each client reports its normalized update direction ``d_i = (global - local)
+/ tau_i`` (tau_i = executed local steps); the server applies
+``global -= tau_eff * sum_i p_i d_i`` with ``tau_eff = sum_i p_i tau_i``,
+removing the objective inconsistency caused by heterogeneous local step
+counts. Both the per-client normalization and tau_eff flow through the
+engine's single weighted mean: the payload carries ``{"d": d_i, "tau": tau_i}``
+and its n_i-weighted average is exactly ``{sum p_i d_i, tau_eff}``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.core import pytree
+
+
+def fednova_payload(local_state, global_state, aux):
+    tau = jnp.maximum(aux["steps"].astype(jnp.float32), 1.0)
+    d = pytree.tree_scale(
+        pytree.tree_sub(global_state["params"], local_state["params"]),
+        1.0 / tau)
+    rest = {k: v for k, v in local_state.items() if k != "params"}
+    return {"d": d, "tau": tau, "rest": rest}
+
+
+def fednova_server(global_state, avg_payload, server_state, rng):
+    tau_eff = avg_payload["tau"]
+    new_params = pytree.tree_sub(
+        global_state["params"],
+        pytree.tree_scale(avg_payload["d"], tau_eff))
+    new_global = dict(avg_payload["rest"])
+    new_global["params"] = new_params
+    return new_global, server_state
+
+
+class FedNovaAPI(FedAvgAPI):
+    def __init__(self, dataset, spec, args, mesh=None, metrics_logger=None):
+        super().__init__(dataset, spec, args, mesh=mesh,
+                         payload_fn=fednova_payload, server_fn=fednova_server,
+                         metrics_logger=metrics_logger)

@@ -1,0 +1,120 @@
+"""Hierarchical FL: client -> group -> global two-tier averaging (reference
+``fedml_api/standalone/hierarchical_fl/{trainer,group}.py`` -- note the
+reference's trainer has a broken import, SURVEY.md "Known reference defects";
+the behavior is reconstructed from ``group.py:24-46``: each group runs
+``group_comm_round`` FedAvg rounds locally, then groups' models are averaged
+globally, weighted by group sample counts).
+
+TPU mapping (SURVEY.md section 2.7): groups are the outer vmap axis, clients
+the inner one -- one jitted call per global round executes every group's full
+sub-round schedule; on a pod this nests as two mesh axes (ICI within a slice
+for the group tier, DCN across for the global tier).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, client_sampling
+from fedml_tpu.core import pytree
+from fedml_tpu.parallel.engine import ClientUpdateConfig, make_client_update
+from fedml_tpu.parallel.packing import pack_cohort
+
+
+class HierarchicalFedAvgAPI(FedAvgAPI):
+    """Extra args: ``group_num``, ``group_comm_round`` (reference
+    ``main_hierarchical_fl.py`` flags). Clients are assigned to groups
+    round-robin; each global round runs ``group_comm_round`` intra-group
+    FedAvg rounds inside one jitted program."""
+
+    def __init__(self, dataset, spec, args, mesh=None, metrics_logger=None):
+        super().__init__(dataset, spec, args, mesh=mesh,
+                         metrics_logger=metrics_logger)
+        self.group_num = getattr(args, "group_num", 2)
+        self.group_comm_round = getattr(args, "group_comm_round", 1)
+        client_update = make_client_update(spec, self.cfg)
+
+        def group_round(group_state, group_data, rng):
+            """One intra-group FedAvg round: vmap clients, weighted mean."""
+            C = group_data["mask"].shape[0]
+            rngs = jax.random.split(rng, C)
+            local_states, aux, metrics = jax.vmap(
+                client_update, in_axes=(None, 0, 0))(group_state, group_data, rngs)
+            return pytree.tree_weighted_mean(local_states, aux["n"]), aux, metrics
+
+        def global_round(global_state, cohort_data, rng):
+            """All groups run their sub-rounds from the same global model,
+            then group models average weighted by group sample counts.
+            cohort_data leading axes: [G, C_per_group, S, B, ...]."""
+            G = cohort_data["mask"].shape[0]
+
+            def one_group(group_data, grng):
+                def body(state, r):
+                    new_state, aux, metrics = group_round(
+                        state, group_data, jax.random.fold_in(grng, r))
+                    return new_state, (aux, metrics)
+
+                state, (aux, metrics) = jax.lax.scan(
+                    body, global_state, jnp.arange(self.group_comm_round))
+                n_group = jnp.sum(aux["n"][0])  # n constant across sub-rounds
+                return state, n_group, metrics
+
+            grngs = jax.random.split(rng, G)
+            group_states, group_ns, metrics = jax.vmap(one_group)(
+                cohort_data, grngs)
+            new_global = pytree.tree_weighted_mean(group_states, group_ns)
+            return new_global, metrics
+
+        self._global_round = jax.jit(global_round)
+
+    def train_one_round(self):
+        t0 = time.time()
+        client_indexes = client_sampling(
+            self.round_idx, len(self.train_data_local_dict),
+            self.args.client_num_per_round)
+        # round-robin group assignment (reference partitions the cohort into
+        # group_num groups); unequal groups are padded with empty client slots
+        # (weight 0, fully masked) so no sampled client is dropped
+        groups = [client_indexes[g::self.group_num] for g in range(self.group_num)]
+        groups = [g for g in groups if g]
+        per_group = max(len(g) for g in groups)
+        logging.info("hierarchical groups = %s", groups)
+
+        empty = {"x": np.zeros((0,) + self.train_data_local_dict[
+            client_indexes[0]]["x"].shape[1:],
+            self.train_data_local_dict[client_indexes[0]]["x"].dtype),
+            "y": np.zeros((0,), self.train_data_local_dict[
+                client_indexes[0]]["y"].dtype)}
+        packs = [pack_cohort(
+            [self.train_data_local_dict[i] for i in g] +
+            [empty] * (per_group - len(g)),
+            self.args.batch_size, self.args.epochs, rng=self._data_rng)
+            for g in groups]
+        S = max(p["mask"].shape[1] for p in packs)
+        for p in packs:
+            pad = S - p["mask"].shape[1]
+            if pad:
+                for k in ("x", "y", "mask"):
+                    p[k] = np.concatenate(
+                        [p[k], np.zeros((p[k].shape[0], pad) + p[k].shape[2:],
+                                        p[k].dtype)], axis=1)
+        cohort = {k: np.stack([p[k] for p in packs]) for k in packs[0]}
+
+        self.rng, round_rng = jax.random.split(self.rng)
+        self.global_state, metrics = self._global_round(
+            self.global_state, cohort, round_rng)
+        jax.block_until_ready(self.global_state)
+        m = jax.tree.map(np.asarray, metrics)
+        out = {
+            "round": self.round_idx,
+            "Train/Loss": float(m["loss_sum"].sum() / max(m["count"].sum(), 1)),
+            "Train/Acc": float(m["correct"].sum() / max(m["count"].sum(), 1)),
+            "round_time_s": time.time() - t0,
+        }
+        self.round_idx += 1
+        return out

@@ -1,0 +1,160 @@
+"""SplitNN: split learning with per-batch activation/gradient exchange
+(reference ``fedml_api/distributed/split_nn/``: client half forwards a batch,
+sends activations+labels; the server half computes loss, backprops, and
+returns the activation gradient; clients proceed in a relay ring --
+``client_manager.py:35-70``, ``server.py:40-60``).
+
+TPU re-design: the activation handoff is a *program seam*, not a network hop.
+One jitted step computes client-half forward, server-half forward/backward,
+and the client-half backward via the chain rule -- what crossed the process
+boundary twice per minibatch (the reference's latency-critical path,
+SURVEY.md section 3.3) becomes a fused XLA program. The relay-ring semantics
+(clients train sequentially against an evolving server half) are preserved by
+scanning clients in ring order within the round. On a multi-host mesh the
+seam maps to a mesh partition with activation transfer over ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.parallel.engine import ClientUpdateConfig, make_optimizer
+from fedml_tpu.parallel.packing import pack_cohort, pack_eval
+
+
+class SplitNNAPI:
+    """Args: dataset 8-tuple, ``client_model`` / ``server_model`` flax modules
+    where ``client_model.apply -> activations`` and ``server_model.apply ->
+    logits``. The client half is personal (per-client params); the server
+    half is shared and updated continuously in ring order."""
+
+    def __init__(self, dataset, client_model, server_model, args,
+                 metrics_logger=None):
+        (_, _, _, self.test_data_global, _, self.train_data_local_dict,
+         self.test_data_local_dict, self.class_num) = dataset
+        self.args = args
+        self.client_model = client_model
+        self.server_model = server_model
+        self.metrics_logger = metrics_logger or (lambda d: None)
+        self.n_clients = len(self.train_data_local_dict)
+
+        cfg = ClientUpdateConfig(
+            optimizer=getattr(args, "client_optimizer", "sgd"),
+            lr=args.lr, weight_decay=getattr(args, "wd", 0.0),
+            momentum=getattr(args, "momentum", 0.0))
+        self.tx = make_optimizer(cfg)
+
+        rng = jax.random.PRNGKey(getattr(args, "seed", 0))
+        example = jnp.asarray(self.train_data_local_dict[0]["x"][:1])
+        self.client_params = jax.vmap(
+            lambda k: client_model.init(k, example)
+        )(jax.random.split(jax.random.fold_in(rng, 1), self.n_clients))
+        acts = client_model.apply(
+            jax.tree.map(lambda x: x[0], self.client_params), example)
+        self.server_params = server_model.init(jax.random.fold_in(rng, 2), acts)
+        self.client_opt = jax.vmap(self.tx.init)(self.client_params)
+        self.server_opt = self.tx.init(self.server_params)
+        self.rng = rng
+        self._data_rng = np.random.default_rng(getattr(args, "seed", 0))
+        self.round_idx = 0
+
+        def loss_fn(cp, sp, batch):
+            acts = client_model.apply(cp, batch["x"])
+            logits = server_model.apply(sp, acts)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            ll = jnp.take_along_axis(
+                logp, batch["y"][:, None].astype(jnp.int32), axis=1)[:, 0]
+            mask = batch["mask"]
+            count = jnp.maximum(jnp.sum(mask), 1.0)
+            loss = jnp.sum(-ll * mask) / count
+            correct = jnp.sum(
+                (jnp.argmax(logits, axis=-1) == batch["y"]) * mask)
+            return loss, {"loss_sum": jnp.sum(-ll * mask), "correct": correct,
+                          "count": jnp.sum(mask)}
+
+        def train_client(carry, client_idx, cohort):
+            sp, s_opt, cps, c_opts = carry
+            cp = jax.tree.map(lambda x: x[client_idx], cps)
+            c_opt = jax.tree.map(lambda x: x[client_idx], c_opts)
+            data = jax.tree.map(lambda x: x[client_idx], cohort)
+
+            def batch_step(inner, xs):
+                cp, c_opt, sp, s_opt = inner
+                batch = xs
+                (loss, metrics), grads = jax.value_and_grad(
+                    loss_fn, argnums=(0, 1), has_aux=True)(cp, sp, batch)
+                g_c, g_s = grads
+                valid = jnp.sum(batch["mask"]) > 0
+                up_c, c_opt2 = self.tx.update(g_c, c_opt, cp)
+                up_s, s_opt2 = self.tx.update(g_s, s_opt, sp)
+                new = (optax.apply_updates(cp, up_c), c_opt2,
+                       optax.apply_updates(sp, up_s), s_opt2)
+                out = jax.tree.map(
+                    lambda a, b: jnp.where(valid, a, b), new,
+                    (cp, c_opt, sp, s_opt))
+                return out, metrics
+
+            batches = {k: data[k] for k in ("x", "y", "mask")}
+            (cp, c_opt, sp, s_opt), metrics = jax.lax.scan(
+                batch_step, (cp, c_opt, sp, s_opt), batches)
+            cps = jax.tree.map(
+                lambda all_, one: all_.at[client_idx].set(one), cps, cp)
+            c_opts = jax.tree.map(
+                lambda all_, one: all_.at[client_idx].set(one), c_opts, c_opt)
+            msum = jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics)
+            return (sp, s_opt, cps, c_opts), msum
+
+        @jax.jit
+        def round_fn(sp, s_opt, cps, c_opts, cohort, rng):
+            def body(carry, idx):
+                return train_client(carry, idx, cohort)
+
+            (sp, s_opt, cps, c_opts), metrics = jax.lax.scan(
+                body, (sp, s_opt, cps, c_opts),
+                jnp.arange(self.n_clients))  # ring order
+            return sp, s_opt, cps, c_opts, metrics
+
+        self._round_fn = round_fn
+
+    def train_one_round(self):
+        packed = pack_cohort(
+            [self.train_data_local_dict[i] for i in range(self.n_clients)],
+            self.args.batch_size, self.args.epochs, rng=self._data_rng)
+        self.rng, rng = jax.random.split(self.rng)
+        (self.server_params, self.server_opt, self.client_params,
+         self.client_opt, metrics) = self._round_fn(
+            self.server_params, self.server_opt, self.client_params,
+            self.client_opt, packed, rng)
+        m = jax.tree.map(np.asarray, metrics)
+        out = {"round": self.round_idx,
+               "Train/Loss": float(m["loss_sum"].sum() / max(m["count"].sum(), 1)),
+               "Train/Acc": float(m["correct"].sum() / max(m["count"].sum(), 1))}
+        self.round_idx += 1
+        self.metrics_logger(out)
+        return out
+
+    def evaluate(self, client_idx=0):
+        """Eval through client ``client_idx``'s half + the shared server half
+        (reference ``run_eval``, ``client_manager.py:40-55``)."""
+        packed = pack_eval(self.test_data_global, self.args.batch_size)
+        cp = jax.tree.map(lambda x: x[client_idx], self.client_params)
+
+        def step(carry, batch):
+            acts = self.client_model.apply(cp, batch["x"])
+            logits = self.server_model.apply(self.server_params, acts)
+            correct = jnp.sum(
+                (jnp.argmax(logits, axis=-1) == batch["y"]) * batch["mask"])
+            return carry, {"correct": correct, "count": jnp.sum(batch["mask"])}
+
+        _, m = jax.lax.scan(step, 0,
+                            {k: jnp.asarray(packed[k]) for k in ("x", "y", "mask")})
+        m = jax.tree.map(lambda x: float(np.asarray(x).sum()), m)
+        return {"Test/Acc": m["correct"] / max(m["count"], 1)}
+
+    def train(self):
+        for _ in range(self.args.comm_round):
+            out = self.train_one_round()
+        return out

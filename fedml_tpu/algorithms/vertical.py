@@ -1,0 +1,117 @@
+"""Classical vertical (feature-partitioned) FL.
+
+Reference protocol (``fedml_api/distributed/classical_vertical_fl/
+guest_trainer.py:59-80`` + ``fedml_api/standalone/classical_vertical_fl/
+vfl.py:21-56``): the label-holding *guest* and feature-only *hosts* each run a
+local feature extractor producing logit contributions; hosts send theirs to
+the guest, the guest sums, computes the loss, and broadcasts the common
+gradient w.r.t. the summed logits; each party backprops locally.
+
+TPU re-design: the exchanged quantities (host logits forward, d loss/d logits
+backward) are exactly the values JAX's chain rule routes across the party
+seam, so the whole protocol is one jitted step over the party list; party
+separation is preserved in the pytree structure ``{party_id: params}`` (on a
+mesh, parties map to shards of the ``model`` axis and the logit-sum is a
+psum). Labels and loss never leave the guest subtree, matching the privacy
+boundary of the reference.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.parallel.engine import ClientUpdateConfig, make_optimizer
+
+
+class VerticalFLAPI:
+    """Args:
+      party_models: list of flax modules, one per party; index 0 = guest.
+      party_data: list of feature matrices ``x_k [n, d_k]`` (same row order --
+        the record linkage is assumed done, as in the reference loaders).
+      labels: ``y [n]`` binary or ``[n, 1]`` -- held by the guest only.
+    """
+
+    def __init__(self, party_models, party_data, labels, args,
+                 test_party_data=None, test_labels=None):
+        assert len(party_models) == len(party_data)
+        self.models = party_models
+        self.args = args
+        self.n_parties = len(party_models)
+        self.x_parts = [np.asarray(x, np.float32) for x in party_data]
+        self.y = np.asarray(labels, np.float32).reshape(-1)
+        self.x_test = ([np.asarray(x, np.float32) for x in test_party_data]
+                       if test_party_data is not None else None)
+        self.y_test = (np.asarray(test_labels, np.float32).reshape(-1)
+                       if test_labels is not None else None)
+
+        tx = make_optimizer(ClientUpdateConfig(
+            optimizer=getattr(args, "client_optimizer", "sgd"),
+            lr=args.lr, weight_decay=getattr(args, "wd", 0.0)))
+        self.tx = tx
+        rng = jax.random.PRNGKey(getattr(args, "seed", 0))
+        self.params = [
+            m.init(jax.random.fold_in(rng, i), jnp.asarray(x[:1]))
+            for i, (m, x) in enumerate(zip(party_models, self.x_parts))]
+        self.opts = [tx.init(p) for p in self.params]
+        self._data_rng = np.random.default_rng(getattr(args, "seed", 0))
+        models = party_models
+
+        def loss_fn(params_list, xs, y):
+            # each party contributes a scalar logit per row; guest sums
+            contribs = [models[k].apply(params_list[k], xs[k]).reshape(-1)
+                        for k in range(len(models))]
+            logit = sum(contribs)
+            # guest-side binary CE with logits (reference uses BCE on the
+            # summed logit, vfl.py:38-44)
+            loss = jnp.mean(
+                jnp.maximum(logit, 0) - logit * y +
+                jnp.log1p(jnp.exp(-jnp.abs(logit))))
+            correct = jnp.sum(((logit > 0) == (y > 0.5)))
+            return loss, correct
+
+        @jax.jit
+        def train_step(params_list, opt_list, xs, y):
+            (loss, correct), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params_list, xs, y)
+            new_params, new_opts = [], []
+            for p, o, g in zip(params_list, opt_list, grads):
+                up, o2 = tx.update(g, o, p)
+                new_params.append(optax.apply_updates(p, up))
+                new_opts.append(o2)
+            return new_params, new_opts, loss, correct
+
+        self._train_step = train_step
+        self._loss_fn = jax.jit(loss_fn)
+        self.history = []
+
+    def fit(self):
+        """Epoch loop over joined minibatches (reference
+        ``vfl_fixture.py`` fit loop)."""
+        n = len(self.y)
+        bs = self.args.batch_size
+        for epoch in range(self.args.epochs):
+            order = self._data_rng.permutation(n)
+            losses, corrects = [], 0.0
+            for s in range(0, n, bs):
+                idx = order[s:s + bs]
+                xs = [jnp.asarray(x[idx]) for x in self.x_parts]
+                yb = jnp.asarray(self.y[idx])
+                self.params, self.opts, loss, correct = self._train_step(
+                    self.params, self.opts, xs, yb)
+                losses.append(float(loss))
+                corrects += float(correct)
+            rec = {"epoch": epoch, "Train/Loss": float(np.mean(losses)),
+                   "Train/Acc": corrects / n}
+            if self.x_test is not None:
+                rec.update(self.evaluate())
+            self.history.append(rec)
+        return self.history
+
+    def evaluate(self):
+        xs = [jnp.asarray(x) for x in self.x_test]
+        loss, correct = self._loss_fn(self.params, xs, jnp.asarray(self.y_test))
+        return {"Test/Loss": float(loss),
+                "Test/Acc": float(correct) / len(self.y_test)}

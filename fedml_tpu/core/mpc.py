@@ -1,0 +1,113 @@
+"""Secure-aggregation MPC primitives (TurboAggregate).
+
+Functional parity with reference ``fedml_api/distributed/turboaggregate/
+mpc_function.py``: finite-field fixed-point quantization, additive secret
+sharing, Shamir/BGW polynomial sharing with Lagrange reconstruction
+(coefficients at ``mpc_function.py:39-59``, BGW encoding at ``:62-75``) --
+the building blocks under TurboAggregate's circular aggregation topology.
+
+Field math is exact int64 modular arithmetic and stays on host (numpy): it is
+control-plane-sized (shares of model updates), and XLA's int path offers no
+advantage for modular inverses. The quantize/dequantize boundary is where
+device tensors enter/leave the field.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+DEFAULT_PRIME = 2 ** 31 - 1  # Mersenne prime fits int64 products via Python int
+
+
+def quantize(x, scale=2 ** 16, p=DEFAULT_PRIME):
+    """Float array -> field elements (two's-complement style embedding)."""
+    q = np.round(np.asarray(x, np.float64) * scale).astype(np.int64)
+    return np.mod(q, p)
+
+
+def dequantize(q, scale=2 ** 16, p=DEFAULT_PRIME):
+    """Field elements -> float array, mapping (p/2, p) back to negatives."""
+    q = np.asarray(q, np.int64)
+    signed = np.where(q > p // 2, q - p, q)
+    return signed.astype(np.float64) / scale
+
+
+def modular_inverse(a, p=DEFAULT_PRIME):
+    return pow(int(a) % p, p - 2, p)
+
+
+def additive_shares(secret, n_shares, p=DEFAULT_PRIME, rng=None):
+    """Split field array into n uniformly random additive shares."""
+    rng = rng or np.random.default_rng()
+    shares = [rng.integers(0, p, size=np.shape(secret), dtype=np.int64)
+              for _ in range(n_shares - 1)]
+    last = np.mod(np.asarray(secret, np.int64) - sum(np.int64(0) + s for s in shares), p)
+    shares.append(last)
+    return shares
+
+
+def reconstruct_additive(shares, p=DEFAULT_PRIME):
+    total = np.zeros_like(np.asarray(shares[0], np.int64))
+    for s in shares:
+        total = np.mod(total + np.asarray(s, np.int64), p)
+    return total
+
+
+def lagrange_coefficients(eval_points, target=0, p=DEFAULT_PRIME):
+    """w_i = prod_{j != i} (target - x_j) / (x_i - x_j) mod p."""
+    xs = [int(x) % p for x in eval_points]
+    coeffs = []
+    for i, xi in enumerate(xs):
+        num, den = 1, 1
+        for j, xj in enumerate(xs):
+            if i == j:
+                continue
+            num = (num * ((target - xj) % p)) % p
+            den = (den * ((xi - xj) % p)) % p
+        coeffs.append((num * modular_inverse(den, p)) % p)
+    return coeffs
+
+
+def bgw_encode(secret, eval_points, t, p=DEFAULT_PRIME, rng=None):
+    """Shamir/BGW degree-t polynomial shares of a field array: share_k =
+    secret + sum_{d=1..t} r_d * x_k^d (reference BGW_encoding)."""
+    rng = rng or np.random.default_rng()
+    secret = np.asarray(secret, np.int64)
+    coeffs = [rng.integers(0, p, size=secret.shape, dtype=np.int64)
+              for _ in range(t)]
+    shares = []
+    for x in eval_points:
+        acc = secret.copy()
+        xp = 1
+        for d in range(1, t + 1):
+            xp = (xp * int(x)) % p
+            acc = np.mod(acc + coeffs[d - 1] * xp, p)
+        shares.append(acc)
+    return shares
+
+
+def bgw_decode(shares, eval_points, p=DEFAULT_PRIME):
+    """Reconstruct the secret (polynomial at 0) from >= t+1 shares."""
+    ws = lagrange_coefficients(eval_points, 0, p)
+    acc = np.zeros_like(np.asarray(shares[0], np.int64))
+    for w, s in zip(ws, shares):
+        acc = np.mod(acc + (np.asarray(s, np.int64).astype(object) * int(w)) % p, p)
+    return acc.astype(np.int64)
+
+
+def secure_aggregate(client_updates, p=DEFAULT_PRIME, scale=2 ** 16, rng=None):
+    """Additive-masking secure aggregation of float arrays: each client's
+    quantized update is split into shares, only share-sums are 'revealed',
+    and the sum is dequantized -- the server never sees an individual update.
+    Semantics of TurboAggregate's aggregation result (``TA_Aggregator.py:
+    56-85`` computes the same weighted sum in the clear)."""
+    rng = rng or np.random.default_rng(0)
+    n = len(client_updates)
+    q = [quantize(u, scale, p) for u in client_updates]
+    all_shares = [additive_shares(qi, n, p, rng) for qi in q]
+    # share j of every client is summed by party j (no single party holds any
+    # full update); the final sum of partial sums equals the sum of updates
+    partials = [reconstruct_additive([all_shares[i][j] for i in range(n)], p)
+                for j in range(n)]
+    total_q = reconstruct_additive(partials, p)
+    return dequantize(total_q, scale, p)

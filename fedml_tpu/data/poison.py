@@ -1,0 +1,70 @@
+"""Backdoor / poisoning utilities.
+
+Behavioral parity target: reference ``fedml_api/data_preprocessing/
+edge_case_examples/data_loader.py:283`` (``load_poisoned_dataset``: southwest/
+howto/ardis edge-case backdoors mapped to a wrong target label) and the attack
+schedule flags ``--attack_freq --poison_type`` (``main_fedavg_robust.py:
+56-83``). The curated edge-case archives are not downloadable in a zero-egress
+environment, so the same threat model is expressed synthetically: a trigger
+pattern stamped onto a fraction of samples whose labels flip to the attack
+target.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def stamp_trigger(x, pattern="corner", intensity=3.0):
+    """Apply a backdoor trigger to image batch ``x [N, H, W, C]``."""
+    x = np.array(x, copy=True)
+    if pattern == "corner":
+        x[:, -4:, -4:, :] = intensity
+    elif pattern == "cross":
+        h, w = x.shape[1] // 2, x.shape[2] // 2
+        x[:, h - 1:h + 2, :, :] = intensity
+        x[:, :, w - 1:w + 2, :] = intensity
+    else:
+        raise ValueError(f"unknown trigger pattern: {pattern}")
+    return x
+
+
+def poison_client_data(data, poison_frac, target_label, pattern="corner",
+                       seed=0):
+    """Poison a fraction of one client's shard: trigger + label flip."""
+    rng = np.random.default_rng(seed)
+    n = len(data["y"])
+    k = int(n * poison_frac)
+    if k == 0:
+        return data
+    idx = rng.choice(n, k, replace=False)
+    x = np.array(data["x"], copy=True)
+    y = np.array(data["y"], copy=True)
+    x[idx] = stamp_trigger(x[idx], pattern)
+    y[idx] = target_label
+    return {"x": x, "y": y}
+
+
+def make_backdoor_testset(test_data, target_label, pattern="corner"):
+    """All-triggered test set for attack-success-rate eval; samples already
+    belonging to the target class are excluded (reference backdoor test
+    excludes the target class, ``FedAvgRobustAggregator.py:14-111``)."""
+    keep = np.asarray(test_data["y"]) != target_label
+    x = stamp_trigger(np.asarray(test_data["x"])[keep], pattern)
+    y = np.full(int(keep.sum()), target_label,
+                dtype=np.asarray(test_data["y"]).dtype)
+    return {"x": x, "y": y}
+
+
+def poison_federated_dataset(dataset, adversary_clients, poison_frac,
+                             target_label, pattern="corner", seed=0):
+    """Poison selected clients of an 8-tuple dataset in place-safe copy;
+    returns (dataset, poisoned_test_data)."""
+    ds = list(dataset)
+    train_local = dict(ds[5])
+    for c in adversary_clients:
+        train_local[c] = poison_client_data(
+            train_local[c], poison_frac, target_label, pattern, seed + c)
+    ds[5] = train_local
+    poisoned_test = make_backdoor_testset(ds[3], target_label, pattern)
+    return ds, poisoned_test

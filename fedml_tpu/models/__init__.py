@@ -11,4 +11,7 @@ from fedml_tpu.models.resnet_gn import ResNetGN, resnet18_gn, resnet34_gn, resne
 from fedml_tpu.models.mobilenet import MobileNet  # noqa: F401
 from fedml_tpu.models.vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from fedml_tpu.models.rnn import RNNOriginalFedAvg, RNNStackOverflow  # noqa: F401
+from fedml_tpu.models.gkt import (  # noqa: F401
+    GKTClientResNet, GKTServerResNet, resnet5_56, resnet8_56, resnet56_server)
+from fedml_tpu.models.linear import DenseModel, LocalModel  # noqa: F401
 from fedml_tpu.models.factory import create_model  # noqa: F401

@@ -1,0 +1,72 @@
+"""Split ResNets for FedGKT (reference ``fedml_api/model/cv/resnet56_gkt/``:
+``resnet_client.py:206,230`` define resnet5_56 / resnet8_56 -- a stem + one
+16-channel stage + a local classification head that also exposes the feature
+maps; ``resnet_server.py:200`` defines resnet56_server -- the remaining 32/64
+channel stages consuming those features).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.resnet import BasicBlock
+
+
+class GKTClientResNet(nn.Module):
+    """Small edge model: stem + ``n_blocks`` 16-channel blocks. Returns
+    ``(features [B,H,W,16], logits [B,classes])`` -- the two payloads the
+    client uploads (reference ``GKTClientTrainer.py:108-129``)."""
+    n_blocks: int = 1  # 1 -> resnet5_56, 2 -> resnet8_56
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=False, name="conv1")(x)
+        x = nn.relu(norm(name="bn1")(x))
+        for b in range(self.n_blocks):
+            x = BasicBlock(16, 1, norm, name=f"block{b}")(x)
+        features = x
+        pooled = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
+        logits = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(pooled)
+        return features, logits
+
+
+class GKTServerResNet(nn.Module):
+    """Large server model consuming client feature maps: the 32/64-channel
+    stages of ResNet-56 (reference ``resnet_server.py:200``)."""
+    n: int = 9  # blocks per stage (9 -> ResNet-56 tail)
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, features, train: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        x = features.astype(self.dtype)
+        for stage, (filters, strides) in enumerate([(32, 2), (64, 2)]):
+            for b in range(self.n):
+                x = BasicBlock(filters, strides if b == 0 else 1, norm,
+                               name=f"layer{stage + 2}_block{b}")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(
+            x.astype(jnp.float32))
+
+
+def resnet5_56(class_num=10, **kw):
+    return GKTClientResNet(n_blocks=1, num_classes=class_num, **kw)
+
+
+def resnet8_56(class_num=10, **kw):
+    return GKTClientResNet(n_blocks=2, num_classes=class_num, **kw)
+
+
+def resnet56_server(class_num=10, **kw):
+    return GKTServerResNet(n=9, num_classes=class_num, **kw)

@@ -30,7 +30,7 @@ def _steps_for(n, batch_size, epochs, drop_last=False):
 
 
 def pack_cohort(client_datasets, batch_size, epochs, rng=None, drop_last=False,
-                step_bucket=8):
+                step_bucket=8, return_indices=False):
     """Pack a cohort's datasets into dense arrays for one federated round.
 
     Args:
@@ -43,7 +43,11 @@ def pack_cohort(client_datasets, batch_size, epochs, rng=None, drop_last=False,
 
     Returns:
       dict with ``x [C, S, B, ...]``, ``y [C, S, B, ...]``, ``mask [C, S, B]``
-      (float32 0/1), and ``n [C]`` true sample counts.
+      (float32 0/1), and ``n [C]`` true sample counts. With
+      ``return_indices=True``, also ``idx [C, S, B]`` int32 -- each slot's
+      index into its client's local dataset (0 where masked), for callers
+      that must align per-sample side information across rounds (FedGKT
+      teacher logits).
     """
     rng = rng or np.random.default_rng(0)
     C = len(client_datasets)
@@ -57,6 +61,7 @@ def pack_cohort(client_datasets, batch_size, epochs, rng=None, drop_last=False,
     xs = np.zeros((C, S, batch_size) + x0.shape[1:], x0.dtype)
     ys = np.zeros((C, S, batch_size) + y0.shape[1:], y0.dtype)
     mask = np.zeros((C, S, batch_size), np.float32)
+    slot_idx = np.zeros((C, S, batch_size), np.int32)
     n = np.zeros((C,), np.float32)
 
     for c, d in enumerate(client_datasets):
@@ -76,9 +81,13 @@ def pack_cohort(client_datasets, batch_size, epochs, rng=None, drop_last=False,
                 xs[c, s, :k] = x[idx]
                 ys[c, s, :k] = y[idx]
                 mask[c, s, :k] = 1.0
+                slot_idx[c, s, :k] = idx
                 s += 1
         # remaining [s, S) steps stay fully masked
-    return {"x": xs, "y": ys, "mask": mask, "n": n}
+    out = {"x": xs, "y": ys, "mask": mask, "n": n}
+    if return_indices:
+        out["idx"] = slot_idx
+    return out
 
 
 def pack_eval(data, batch_size, pad_multiple=1):

@@ -1,0 +1,252 @@
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu import models
+from fedml_tpu.algorithms.specs import make_classification_spec
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.algorithms.fedopt import FedOptAPI, get_server_optimizer
+from fedml_tpu.algorithms.fednova import FedNovaAPI
+from fedml_tpu.algorithms.fedavg_robust import FedAvgRobustAPI
+from fedml_tpu.algorithms.hierarchical import HierarchicalFedAvgAPI
+from fedml_tpu.algorithms.decentralized import DecentralizedFedAPI, mix_states
+from fedml_tpu.core.topology import SymmetricTopologyManager
+from fedml_tpu.data import load_synthetic_federated
+from fedml_tpu.data.poison import poison_federated_dataset
+from fedml_tpu.data.synthetic import load_synthetic_images
+
+
+def _args(**kw):
+    base = dict(client_num_per_round=6, comm_round=3, epochs=1, batch_size=16,
+                lr=0.3, client_optimizer="sgd", wd=0.0,
+                frequency_of_the_test=100, ci=0, seed=0)
+    base.update(kw)
+    return types.SimpleNamespace(**base)
+
+
+def _spec():
+    return make_classification_spec(
+        models.LogisticRegression(num_classes=10, apply_sigmoid=False),
+        jnp.zeros((1, 60)))
+
+
+def _dataset(clients=6, n=600):
+    return load_synthetic_federated(client_num=clients, n_train=n,
+                                    n_test=n // 4, alpha=0.0, beta=0.0, seed=0)
+
+
+class TestFedOpt:
+    def test_server_optimizer_registry(self):
+        for name in ("sgd", "fedavgm", "adam", "fedadam", "adagrad", "yogi"):
+            assert get_server_optimizer(name, 0.1) is not None
+        with pytest.raises(ValueError):
+            get_server_optimizer("nope", 0.1)
+
+    def test_server_lr_1_sgd_equals_fedavg(self):
+        # FedOpt with plain SGD server_lr=1, momentum=0 reduces exactly to
+        # FedAvg (pseudo-grad step of size 1 == taking the average)
+        ds = _dataset()
+        a1 = FedAvgAPI(ds, _spec(), _args())
+        a2 = FedOptAPI(ds, _spec(), _args(server_optimizer="sgd",
+                                          server_lr=1.0, server_momentum=0.0))
+        m1 = a1.train_one_round()
+        m2 = a2.train_one_round()
+        for x, y in zip(jax.tree.leaves(a1.global_state["params"]),
+                        jax.tree.leaves(a2.global_state["params"])):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-5)
+
+    def test_fedadam_learns(self):
+        ds = _dataset()
+        api = FedOptAPI(ds, _spec(), _args(server_optimizer="adam",
+                                           server_lr=0.05, comm_round=6))
+        first = api.train_one_round()
+        for _ in range(5):
+            last = api.train_one_round()
+        assert last["Train/Acc"] > first["Train/Acc"]
+
+
+class TestFedNova:
+    def test_equal_steps_reduces_to_fedavg(self):
+        # with identical client sizes and tau_i == tau for all, FedNova's
+        # normalized update equals FedAvg's plain average
+        ds = load_synthetic_federated(client_num=4, n_train=400, n_test=100,
+                                      alpha=0.0, beta=0.0,
+                                      partition="homo", seed=0)
+        a1 = FedAvgAPI(ds, _spec(), _args(client_num_per_round=4))
+        a2 = FedNovaAPI(ds, _spec(), _args(client_num_per_round=4))
+        a1.train_one_round()
+        a2.train_one_round()
+        for x, y in zip(jax.tree.leaves(a1.global_state["params"]),
+                        jax.tree.leaves(a2.global_state["params"])):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-5)
+
+    def test_heterogeneous_steps_differ_from_fedavg(self):
+        # LDA partition -> skewed client sizes -> different tau_i
+        ds = load_synthetic_federated(client_num=6, n_train=600, n_test=150,
+                                      alpha=0.0, beta=0.0,
+                                      partition="hetero", seed=0)
+        a1 = FedAvgAPI(ds, _spec(), _args(epochs=2))
+        a2 = FedNovaAPI(ds, _spec(), _args(epochs=2))
+        a1.train_one_round()
+        a2.train_one_round()
+        diffs = [float(np.abs(np.asarray(x) - np.asarray(y)).max())
+                 for x, y in zip(jax.tree.leaves(a1.global_state["params"]),
+                                 jax.tree.leaves(a2.global_state["params"]))]
+        assert max(diffs) > 1e-6
+
+
+class TestRobust:
+    def test_defense_bounds_poisoned_update(self):
+        ds = load_synthetic_images(client_num=4, n_train=200, n_test=80,
+                                   image_size=16, seed=0)
+        ds, poisoned_test = poison_federated_dataset(
+            ds, adversary_clients=[0], poison_frac=0.5, target_label=1)
+        spec = make_classification_spec(
+            models.CNNDropOut(only_digits=True), jnp.zeros((1, 16, 16, 1)))
+        # grayscale adapt: use 3-channel CNN via LR on flattened instead
+        spec = make_classification_spec(
+            models.LogisticRegression(num_classes=10, apply_sigmoid=False),
+            jnp.zeros((1, 16 * 16 * 3)))
+        flat = lambda d: {"x": np.asarray(d["x"]).reshape(len(d["y"]), -1),
+                          "y": d["y"]}
+        ds = list(ds)
+        ds[2], ds[3] = flat(ds[2]), flat(ds[3])
+        ds[5] = {k: flat(v) for k, v in ds[5].items()}
+        ds[6] = {k: flat(v) for k, v in ds[6].items()}
+        poisoned_test = flat(poisoned_test)
+
+        api = FedAvgRobustAPI(ds, spec, _args(client_num_per_round=4,
+                                              norm_bound=0.5, stddev=0.0),
+                              poisoned_test_data=poisoned_test)
+        init = jax.tree.map(np.asarray, api.global_state["params"])
+        api.train_one_round()
+        bd = api.evaluate_backdoor()
+        assert "Backdoor/Acc" in bd
+        # norm clipping caps the global drift: ||new - init|| <= norm_bound
+        delta = np.concatenate([
+            (np.asarray(a) - b).ravel()
+            for a, b in zip(jax.tree.leaves(api.global_state["params"]),
+                            jax.tree.leaves(init))])
+        assert float(np.linalg.norm(delta)) <= 0.5 + 1e-4
+
+    def test_noise_applied(self):
+        ds = _dataset(4, 400)
+        a_clean = FedAvgAPI(ds, _spec(), _args(client_num_per_round=4))
+        a_noisy = FedAvgRobustAPI(ds, _spec(),
+                                  _args(client_num_per_round=4,
+                                        norm_bound=1e9, stddev=0.05))
+        a_clean.train_one_round()
+        a_noisy.train_one_round()
+        d = max(float(np.abs(np.asarray(x) - np.asarray(y)).max())
+                for x, y in zip(jax.tree.leaves(a_clean.global_state["params"]),
+                                jax.tree.leaves(a_noisy.global_state["params"])))
+        assert d > 1e-4
+
+
+class TestHierarchical:
+    def test_one_group_one_subround_equals_fedavg(self):
+        ds = load_synthetic_federated(client_num=4, n_train=400, n_test=100,
+                                      alpha=0.0, beta=0.0,
+                                      partition="homo", seed=0)
+        a1 = FedAvgAPI(ds, _spec(), _args(client_num_per_round=4))
+        a2 = HierarchicalFedAvgAPI(
+            ds, _spec(), _args(client_num_per_round=4, group_num=1,
+                               group_comm_round=1))
+        a1.train_one_round()
+        a2.train_one_round()
+        for x, y in zip(jax.tree.leaves(a1.global_state["params"]),
+                        jax.tree.leaves(a2.global_state["params"])):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-5)
+
+    def test_uneven_groups_keep_all_clients(self):
+        # 5 clients over 2 groups -> groups of 3 and 2; nobody is dropped
+        ds = load_synthetic_federated(client_num=5, n_train=500, n_test=100,
+                                      alpha=0.0, beta=0.0,
+                                      partition="natural", seed=0)
+        api = HierarchicalFedAvgAPI(
+            ds, _spec(), _args(client_num_per_round=5, group_num=2,
+                               group_comm_round=1))
+        api._counts = []
+        orig = api._global_round
+
+        def wrapped(gs, cohort, rng):
+            new, metrics = orig(gs, cohort, rng)
+            api._counts.append(float(np.asarray(metrics["count"]).sum()))
+            return new, metrics
+
+        api._global_round = wrapped
+        api.train_one_round()
+        # every client has 100 samples x 1 epoch = 500 total trained samples
+        assert api._counts[0] == 500.0
+
+    def test_two_tier_runs_and_learns(self):
+        ds = _dataset(8, 800)
+        api = HierarchicalFedAvgAPI(
+            ds, _spec(), _args(client_num_per_round=8, group_num=2,
+                               group_comm_round=2, comm_round=4, lr=0.5))
+        first = api.train_one_round()
+        for _ in range(3):
+            last = api.train_one_round()
+        assert last["Train/Acc"] > first["Train/Acc"]
+
+
+class TestDecentralized:
+    def test_mixing_preserves_average(self):
+        # row-stochastic symmetric W with uniform weights preserves the mean
+        tm = SymmetricTopologyManager(8, neighbor_num=3, seed=0)
+        W = tm.generate_topology()
+        states = {"w": jnp.asarray(np.random.default_rng(0).normal(size=(8, 5)))}
+        mixed = mix_states(states, W)
+        # doubly-stochastic not guaranteed, but mixing must contract spread
+        assert float(jnp.var(mixed["w"], axis=0).mean()) < float(
+            jnp.var(states["w"], axis=0).mean())
+
+    def test_dsgd_consensus_contracts(self):
+        ds = _dataset(6, 600)
+        api = DecentralizedFedAPI(ds, _spec(), _args(comm_round=4, lr=0.1))
+        api.train_one_round()
+        d1 = api.consensus_distance()
+        for _ in range(3):
+            api.train_one_round()
+        d2 = api.consensus_distance()
+        assert np.isfinite(d1) and np.isfinite(d2)
+        assert d2 < max(d1, 1.0)  # gossip keeps nodes near consensus
+
+    def test_pushsum_runs(self):
+        from fedml_tpu.core.topology import AsymmetricTopologyManager
+        ds = _dataset(6, 600)
+        tm = AsymmetricTopologyManager(6, neighbor_num=3, seed=0)
+        api = DecentralizedFedAPI(ds, _spec(), _args(comm_round=2, lr=0.1),
+                                  topology=tm, algorithm="pushsum")
+        # pushsum matrix must be column-stochastic (senders split their mass)
+        np.testing.assert_allclose(api.W.sum(axis=0), np.ones(6), rtol=1e-5)
+        api.train()
+        # de-biasing weights must actually evolve on a non-doubly-stochastic W
+        assert not np.allclose(np.asarray(api.pushsum_w), 1.0)
+        assert np.isfinite(api.consensus_distance())
+        assert all(np.isfinite(np.asarray(leaf)).all()
+                   for leaf in jax.tree.leaves(api.states))
+
+    def test_pushsum_debias_recovers_uniform_average(self):
+        # pure gossip (lr=0 -> no local drift): after many pushsum rounds the
+        # de-biased states must approach the UNIFORM average of the initial
+        # states regardless of the directed topology's stationary distribution
+        from fedml_tpu.core.topology import AsymmetricTopologyManager
+        ds = _dataset(6, 600)
+        tm = AsymmetricTopologyManager(6, neighbor_num=3, seed=0)
+        api = DecentralizedFedAPI(ds, _spec(), _args(comm_round=1, lr=0.0),
+                                  topology=tm, algorithm="pushsum")
+        # give nodes distinct states
+        key = jax.random.PRNGKey(0)
+        api.states = jax.tree.map(
+            lambda x: x + jax.random.normal(key, x.shape), api.states)
+        target = jax.tree.map(lambda x: np.asarray(jnp.mean(x, axis=0)),
+                              api.states)
+        for _ in range(30):
+            api.train_one_round()
+        got = jax.tree.map(lambda x: np.asarray(jnp.mean(x, axis=0)), api.states)
+        for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(target)):
+            np.testing.assert_allclose(a, b, atol=2e-2)

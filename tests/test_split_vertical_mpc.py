@@ -1,0 +1,176 @@
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flax.linen as nn
+
+from fedml_tpu import models
+from fedml_tpu.algorithms.specs import make_classification_spec
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.algorithms.splitnn import SplitNNAPI
+from fedml_tpu.algorithms.fedgkt import FedGKTAPI, kl_divergence
+from fedml_tpu.algorithms.vertical import VerticalFLAPI
+from fedml_tpu.algorithms.turboaggregate import TurboAggregateAPI
+from fedml_tpu.core import mpc
+from fedml_tpu.models.linear import DenseModel, LocalModel
+from fedml_tpu.models.gkt import (
+    GKTServerResNet, resnet5_56, resnet8_56, resnet56_server)
+from fedml_tpu.data import load_synthetic_federated
+from fedml_tpu.data.synthetic import load_synthetic_images
+
+
+def _args(**kw):
+    base = dict(client_num_per_round=4, comm_round=2, epochs=1, batch_size=16,
+                lr=0.3, client_optimizer="sgd", wd=0.0,
+                frequency_of_the_test=100, ci=0, seed=0)
+    base.update(kw)
+    return types.SimpleNamespace(**base)
+
+
+class _ClientHalf(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.relu(nn.Dense(16)(x.reshape((x.shape[0], -1))))
+
+
+class _ServerHalf(nn.Module):
+    classes: int = 10
+
+    @nn.compact
+    def __call__(self, acts):
+        return nn.Dense(self.classes)(nn.relu(nn.Dense(32)(acts)))
+
+
+class TestSplitNN:
+    def test_split_training_learns(self):
+        ds = load_synthetic_federated(client_num=3, n_train=300, n_test=60,
+                                      alpha=0.0, beta=0.0, seed=0)
+        api = SplitNNAPI(ds, _ClientHalf(), _ServerHalf(), _args(lr=0.2))
+        m1 = api.train_one_round()
+        for _ in range(4):
+            m2 = api.train_one_round()
+        assert m2["Train/Acc"] > m1["Train/Acc"]
+        ev = api.evaluate(client_idx=0)
+        assert 0.0 <= ev["Test/Acc"] <= 1.0
+
+    def test_client_halves_are_personal(self):
+        ds = load_synthetic_federated(client_num=3, n_train=300, n_test=60,
+                                      seed=0)
+        api = SplitNNAPI(ds, _ClientHalf(), _ServerHalf(), _args())
+        api.train_one_round()
+        p0 = jax.tree.leaves(jax.tree.map(lambda x: x[0], api.client_params))
+        p1 = jax.tree.leaves(jax.tree.map(lambda x: x[1], api.client_params))
+        assert any(not np.allclose(np.asarray(a), np.asarray(b))
+                   for a, b in zip(p0, p1))
+
+
+class TestFedGKT:
+    def test_kl_divergence_properties(self):
+        logits = jnp.asarray(np.random.default_rng(0).normal(size=(4, 10)))
+        same = kl_divergence(logits, logits, 3.0)
+        np.testing.assert_allclose(np.asarray(same), 0.0, atol=1e-5)
+        other = kl_divergence(logits, logits + 1e3 * jnp.ones((4, 10)), 3.0)
+        np.testing.assert_allclose(np.asarray(other), 0.0, atol=1e-3)  # shift-invariant
+
+    def test_gkt_round_runs(self):
+        ds = load_synthetic_images(client_num=2, n_train=64, n_test=32,
+                                   image_size=8, seed=0)
+        api = FedGKTAPI(ds, resnet5_56(class_num=10),
+                        GKTServerResNet(n=1, num_classes=10),
+                        _args(batch_size=8, epochs=1))
+        m1 = api.train_one_round()
+        m2 = api.train_one_round()
+        assert np.isfinite(m2["Train/Loss"])
+        # server logits are now fed back as teacher
+        assert api.server_logits is not None
+        ev = api.evaluate()
+        assert 0.0 <= ev["Test/Acc"] <= 1.0
+
+    def test_gkt_models_shapes(self):
+        x = jnp.zeros((2, 32, 32, 3))
+        for maker, blocks in ((resnet5_56, 1), (resnet8_56, 2)):
+            m = maker(class_num=10)
+            v = m.init(jax.random.PRNGKey(0), x)
+            (feats, logits), _ = m.apply(v, x, train=True,
+                                         mutable=["batch_stats"])
+            assert feats.shape == (2, 32, 32, 16)
+            assert logits.shape == (2, 10)
+        server = resnet56_server(class_num=10)
+        sv = server.init(jax.random.PRNGKey(1), feats)
+        out = server.apply(sv, feats, train=False)
+        assert out.shape == (2, 10)
+
+
+class TestVerticalFL:
+    def test_two_party_learns(self):
+        rng = np.random.default_rng(0)
+        n = 600
+        x = rng.normal(size=(n, 20)).astype(np.float32)
+        w = rng.normal(size=20)
+        y = (x @ w > 0).astype(np.float32)
+        # guest holds features 0:12, host holds 12:20
+        api = VerticalFLAPI(
+            [LocalModel(hidden_dims=(16,), output_dim=1),
+             LocalModel(hidden_dims=(16,), output_dim=1)],
+            [x[:500, :12], x[:500, 12:]], y[:500],
+            _args(epochs=8, lr=0.1, batch_size=64),
+            test_party_data=[x[500:, :12], x[500:, 12:]],
+            test_labels=y[500:])
+        hist = api.fit()
+        assert hist[-1]["Train/Acc"] > hist[0]["Train/Acc"]
+        assert hist[-1]["Test/Acc"] > 0.6
+
+    def test_dense_model(self):
+        m = DenseModel(output_dim=1)
+        v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 5)))
+        assert m.apply(v, jnp.ones((3, 5))).shape == (3, 1)
+
+
+class TestMPC:
+    def test_quantize_roundtrip(self):
+        x = np.random.default_rng(0).normal(size=(4, 7))
+        back = mpc.dequantize(mpc.quantize(x))
+        np.testing.assert_allclose(back, x, atol=1e-4)
+
+    def test_additive_shares_hide_and_reconstruct(self):
+        secret = mpc.quantize(np.array([1.5, -2.25, 0.0]))
+        shares = mpc.additive_shares(secret, 5, rng=np.random.default_rng(1))
+        assert len(shares) == 5
+        # no single share equals the secret
+        assert all(not np.array_equal(s, secret) for s in shares[:-1])
+        rec = mpc.reconstruct_additive(shares)
+        np.testing.assert_array_equal(rec, secret)
+
+    def test_bgw_encode_decode(self):
+        secret = mpc.quantize(np.array([3.0, -1.5]))
+        points = [1, 2, 3, 4, 5]
+        shares = mpc.bgw_encode(secret, points, t=2,
+                                rng=np.random.default_rng(2))
+        # any t+1=3 shares reconstruct
+        rec = mpc.bgw_decode(shares[:3], points[:3])
+        np.testing.assert_array_equal(rec, secret)
+        rec2 = mpc.bgw_decode(shares[2:], points[2:])
+        np.testing.assert_array_equal(rec2, secret)
+
+    def test_secure_aggregate_equals_plain_sum(self):
+        rng = np.random.default_rng(3)
+        updates = [rng.normal(size=(6,)) for _ in range(4)]
+        agg = mpc.secure_aggregate(updates, rng=rng)
+        np.testing.assert_allclose(agg, sum(updates), atol=1e-3)
+
+    def test_turboaggregate_matches_fedavg(self):
+        ds = load_synthetic_federated(client_num=4, n_train=400, n_test=80,
+                                      alpha=0.0, beta=0.0, seed=0)
+        spec = make_classification_spec(
+            models.LogisticRegression(num_classes=10, apply_sigmoid=False),
+            jnp.zeros((1, 60)))
+        a1 = FedAvgAPI(ds, spec, _args())
+        a2 = TurboAggregateAPI(ds, spec, _args(mpc_scale=2 ** 20))
+        a1.train_one_round()
+        a2.train_one_round()
+        for x, y in zip(jax.tree.leaves(a1.global_state["params"]),
+                        jax.tree.leaves(a2.global_state["params"])):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-3)
