@@ -20,3 +20,4 @@ from fedml_tpu.algorithms.splitnn import SplitNNAPI  # noqa: F401
 from fedml_tpu.algorithms.fedgkt import FedGKTAPI  # noqa: F401
 from fedml_tpu.algorithms.vertical import VerticalFLAPI  # noqa: F401
 from fedml_tpu.algorithms.turboaggregate import TurboAggregateAPI  # noqa: F401
+from fedml_tpu.algorithms.fednas import FedNASAPI, FedNASConfig  # noqa: F401

@@ -1,0 +1,249 @@
+"""FedNAS: federated architecture search over the DARTS space.
+
+Reference behavior (``fedml_api/distributed/fednas``): each client alternates
+an architecture (alpha) update on its validation split with a weight update on
+its training split (``FedNASTrainer.py:34-127``, ``architect.step_v2`` at
+``:103``); the server does sample-weighted averaging of BOTH weights and alpha
+(``FedNASAggregator.py:56-64,95-100``) and records the genotype each round
+(``FedNASServerManager.py:58-59``).
+
+TPU-native design: the alternating (arch step, weight step) pair is one scan
+step; clients are vmapped; the whole federated search round is one XLA
+program. Where the reference approximates the second-order DARTS term with
+finite differences (``architect.py:229-260`` Hessian-vector products), JAX
+differentiates through the unrolled inner SGD step exactly --
+``grad_alpha L_val(w - xi * grad_w L_train(w, alpha), alpha)`` is a single
+``jax.grad``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.algorithms.fedavg import client_sampling
+from fedml_tpu.algorithms.specs import make_classification_spec
+from fedml_tpu.core import pytree
+from fedml_tpu.models.darts import DARTSNetwork, derive_genotype
+from fedml_tpu.parallel.packing import pack_cohort, pack_eval
+
+
+@dataclasses.dataclass(frozen=True)
+class FedNASConfig:
+    """Search-stage hyperparameters (reference flags ``main_fednas.py:44-99``
+    and optimizer construction in ``FedNASTrainer``)."""
+    lr: float = 0.025            # weight SGD lr
+    momentum: float = 0.9
+    weight_decay: float = 3e-4
+    grad_clip: float = 5.0       # FedNASTrainer.py:106-113
+    arch_lr: float = 3e-4        # Architect Adam
+    arch_weight_decay: float = 1e-3
+    arch_order: int = 2          # 2 = unrolled (step_v2), 1 = first-order
+    unrolled_xi: float = 0.025   # inner-step lr for the unrolled term
+
+
+def make_search_client_update(spec, cfg: FedNASConfig):
+    """Per-client local search: scan of (arch step on val batch, weight step
+    on train batch) pairs. ``client_data`` carries parallel train/val batch
+    streams (see ``_pack_search_cohort``)."""
+    w_opt = optax.chain(optax.clip_by_global_norm(cfg.grad_clip),
+                        optax.add_decayed_weights(cfg.weight_decay),
+                        optax.sgd(cfg.lr, momentum=cfg.momentum))
+    a_opt = optax.chain(optax.add_decayed_weights(cfg.arch_weight_decay),
+                        optax.adam(cfg.arch_lr, b1=0.5, b2=0.999))
+
+    def _loss(state, batch, rng):
+        return spec.loss_fn(state, batch, rng, True)
+
+    def client_update(global_state, client_data, rng):
+        arch = global_state["arch"]
+        params = global_state["params"]
+        rest = {k: v for k, v in global_state.items()
+                if k not in ("arch", "params")}
+        w_state = w_opt.init(params)
+        a_state = a_opt.init(arch)
+        S = client_data["mask"].shape[0]
+
+        def step(carry, xs):
+            params, arch, rest, w_state, a_state = carry
+            (train_batch, val_batch), step_idx = xs
+            step_rng = jax.random.fold_in(rng, step_idx)
+
+            # --- architecture step on the validation batch ---
+            def val_loss(a):
+                if cfg.arch_order == 2:
+                    def train_loss(p):
+                        st = dict(rest); st["params"] = p; st["arch"] = a
+                        return _loss(st, train_batch, step_rng)[0]
+                    g = jax.grad(train_loss)(params)
+                    p2 = jax.tree.map(lambda p_, g_: p_ - cfg.unrolled_xi * g_,
+                                      params, g)
+                else:
+                    p2 = params
+                st = dict(rest); st["params"] = p2; st["arch"] = a
+                return _loss(st, val_batch, step_rng)[0]
+
+            a_grads = jax.grad(val_loss)(arch)
+            a_updates, new_a_state = a_opt.update(a_grads, a_state, arch)
+            new_arch = optax.apply_updates(arch, a_updates)
+
+            # --- weight step on the training batch ---
+            def train_loss2(p):
+                st = dict(rest); st["params"] = p; st["arch"] = new_arch
+                return _loss(st, train_batch, step_rng)
+
+            (loss, (new_state, metrics)), grads = jax.value_and_grad(
+                train_loss2, has_aux=True)(params)
+            w_updates, new_w_state = w_opt.update(grads, w_state, params)
+            new_params = optax.apply_updates(params, w_updates)
+            new_rest = {k: new_state[k] for k in rest}
+
+            valid = jnp.sum(train_batch["mask"]) > 0
+            new_carry = jax.tree.map(
+                lambda a_, b_: jnp.where(valid, a_, b_),
+                (new_params, new_arch, new_rest, new_w_state, new_a_state),
+                (params, arch, rest, w_state, a_state))
+            return new_carry, metrics
+
+        train_batches = {k: client_data[k] for k in ("x", "y", "mask")}
+        val_batches = {"x": client_data["val_x"], "y": client_data["val_y"],
+                       "mask": jnp.ones(client_data["val_y"].shape[:2],
+                                        jnp.float32)}
+        (params, arch, rest, _, _), metrics = jax.lax.scan(
+            step, (params, arch, rest, w_state, a_state),
+            ((train_batches, val_batches), jnp.arange(S)))
+        local_state = dict(rest)
+        local_state["params"] = params
+        local_state["arch"] = arch
+        aux = {"n": client_data["n"]}
+        return local_state, aux, jax.tree.map(lambda m: jnp.sum(m, axis=0),
+                                              metrics)
+
+    return client_update
+
+
+def _pack_search_cohort(datasets, batch_size, epochs, rng):
+    """Split each client's shard 50/50 into train/val (reference FedNAS search
+    splits the local set for the bilevel objective), pack the train half with
+    mask-and-pad, and cycle the val half into a parallel ``[S, B]`` stream
+    (wrap-around sampling -- every val batch is full, so no val mask)."""
+    train_sets, val_sets = [], []
+    for d in datasets:
+        n = len(d["y"])
+        split = max(1, n // 2)
+        train_sets.append({"x": d["x"][:split], "y": d["y"][:split]})
+        val_sets.append({"x": d["x"][split:] if n - split > 0 else d["x"][:split],
+                         "y": d["y"][split:] if n - split > 0 else d["y"][:split]})
+    packed = pack_cohort(train_sets, batch_size, epochs, rng=rng)
+    S, B = packed["mask"].shape[1], packed["mask"].shape[2]
+    val_x, val_y = [], []
+    for d in val_sets:
+        n = len(d["y"])
+        if n == 0:
+            # empty shard: zero batches are safe -- the client's all-zero train
+            # mask gates every carry update and its n=0 zeroes its aggregation
+            # weight, matching pack_cohort's empty-client handling
+            val_x.append(np.zeros((S, B) + d["x"].shape[1:], d["x"].dtype))
+            val_y.append(np.zeros((S, B) + d["y"].shape[1:], d["y"].dtype))
+            continue
+        idx = np.concatenate([rng.permutation(n)
+                              for _ in range(int(np.ceil(S * B / n)) + 1)])[:S * B]
+        val_x.append(d["x"][idx].reshape((S, B) + d["x"].shape[1:]))
+        val_y.append(d["y"][idx].reshape((S, B) + d["y"].shape[1:]))
+    packed["val_x"] = np.stack(val_x)
+    packed["val_y"] = np.stack(val_y)
+    return packed
+
+
+class FedNASAPI:
+    """Federated DARTS search (stage ``search`` of ``main_fednas.py``).
+
+    ``dataset`` is the 8-tuple contract; the model is the DARTS search
+    network. Every round: sample cohort -> vmapped local bilevel search ->
+    weighted average of weights AND alphas -> derive genotype.
+    """
+
+    def __init__(self, dataset, args, model=None, cfg: FedNASConfig = None,
+                 metrics_logger=None):
+        (self.train_data_num, self.test_data_num, self.train_data_global,
+         self.test_data_global, self.train_data_local_num_dict,
+         self.train_data_local_dict, self.test_data_local_dict,
+         self.class_num) = dataset
+        self.args = args
+        self.cfg = cfg or FedNASConfig(
+            lr=getattr(args, "lr", 0.025),
+            arch_order=getattr(args, "arch_order", 2))
+        self.model = model or DARTSNetwork(
+            C=getattr(args, "init_channels", 16),
+            layers=getattr(args, "layers", 8),
+            num_classes=self.class_num)
+        example = jnp.zeros((1,) + self.train_data_global["x"].shape[1:],
+                            jnp.float32)
+        self.spec = make_classification_spec(self.model, example, name="fednas")
+        self.metrics_logger = metrics_logger or (lambda d: logging.info("%s", d))
+
+        seed = getattr(args, "seed", 0)
+        self.rng = jax.random.PRNGKey(seed)
+        self.global_state = self.spec.init_fn(jax.random.fold_in(self.rng, 0))
+        self._data_rng = np.random.default_rng(seed)
+        self.round_idx = 0
+        self.history = []
+
+        client_update = make_search_client_update(self.spec, self.cfg)
+
+        @jax.jit
+        def round_fn(global_state, cohort_data, rng):
+            C = cohort_data["mask"].shape[0]
+            rngs = jax.random.split(jax.random.fold_in(rng, 1), C)
+            local_states, aux, metrics = jax.vmap(
+                client_update, in_axes=(None, 0, 0))(
+                    global_state, cohort_data, rngs)
+            new_global = pytree.tree_weighted_mean(local_states, aux["n"])
+            return new_global, {"aux": aux, "metrics": metrics}
+
+        self.round_fn = round_fn
+        from fedml_tpu.parallel.engine import make_eval_fn
+        self.eval_fn = make_eval_fn(self.spec)
+
+    def train_one_round(self):
+        t0 = time.time()
+        idxs = client_sampling(self.round_idx, len(self.train_data_local_dict),
+                               self.args.client_num_per_round)
+        datasets = [self.train_data_local_dict[i] for i in idxs]
+        packed = _pack_search_cohort(datasets, self.args.batch_size,
+                                     self.args.epochs, self._data_rng)
+        self.rng, round_rng = jax.random.split(self.rng)
+        self.global_state, info = self.round_fn(self.global_state, packed,
+                                                round_rng)
+        jax.block_until_ready(self.global_state)
+        m = jax.tree.map(np.asarray, info["metrics"])
+        out = {"round": self.round_idx,
+               "Train/Loss": float(m["loss_sum"].sum() / max(m["count"].sum(), 1)),
+               "Train/Acc": float(m["correct"].sum() / max(m["count"].sum(), 1)),
+               "genotype": self.genotype(),
+               "round_time_s": time.time() - t0}
+        self.metrics_logger({k: v for k, v in out.items() if k != "genotype"})
+        self.history.append(out)
+        self.round_idx += 1
+        return out
+
+    def genotype(self):
+        return derive_genotype(jax.tree.map(np.asarray,
+                                            self.global_state["arch"]))
+
+    def evaluate(self):
+        data = pack_eval(self.test_data_global, self.args.batch_size)
+        m = jax.tree.map(np.asarray, self.eval_fn(self.global_state, data))
+        return {"Test/Acc": float(m["correct"].sum() / max(m["count"].sum(), 1)),
+                "Test/Loss": float(m["loss_sum"].sum() / max(m["count"].sum(), 1))}
+
+    def train(self):
+        for _ in range(self.args.comm_round):
+            self.train_one_round()
+        return self.genotype()

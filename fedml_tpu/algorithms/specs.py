@@ -16,7 +16,8 @@ from fedml_tpu.core.trainer import TrainSpec
 
 def _apply_model(model, state, x, rng, train):
     variables = dict(state)
-    rngs = {"dropout": rng} if (train and rng is not None) else None
+    rngs = ({"dropout": rng, "droppath": jax.random.fold_in(rng, 7)}
+            if (train and rng is not None) else None)
     if "batch_stats" in state and train:
         out, mutated = model.apply(variables, x, train=True,
                                    mutable=["batch_stats"], rngs=rngs)

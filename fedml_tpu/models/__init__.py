@@ -14,4 +14,6 @@ from fedml_tpu.models.rnn import RNNOriginalFedAvg, RNNStackOverflow  # noqa: F4
 from fedml_tpu.models.gkt import (  # noqa: F401
     GKTClientResNet, GKTServerResNet, resnet5_56, resnet8_56, resnet56_server)
 from fedml_tpu.models.linear import DenseModel, LocalModel  # noqa: F401
+from fedml_tpu.models.darts import (  # noqa: F401
+    DARTSNetwork, DARTSFixedNetwork, Genotype, DARTS_V1, derive_genotype)
 from fedml_tpu.models.factory import create_model  # noqa: F401

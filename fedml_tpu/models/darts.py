@@ -1,0 +1,381 @@
+"""DARTS search space for FedNAS, TPU-native.
+
+Capability parity with the reference search space (``fedml_api/model/cv/darts/
+model_search.py:10,26,172`` MixedOp/Cell/Network, ``operations.py`` primitive
+set, ``genotypes.py`` Genotype schema) re-designed for XLA:
+
+- Architecture parameters (alpha) live in their own Flax collection ``arch``,
+  so the bilevel split (weights vs architecture) is a pytree partition, not an
+  optimizer bookkeeping exercise, and FedNAS's server-side averaging of BOTH
+  weights and alpha (``FedNASAggregator.py:56-64,95-100``) is the same
+  weighted tree-mean used for every other collection.
+- A MixedOp evaluates all primitives and takes the softmax-weighted sum --
+  dense compute with static shapes that XLA fuses and tiles onto the MXU;
+  there is no data-dependent branching anywhere.
+- The fixed (post-search) network applies drop-path as a per-sample Bernoulli
+  mask (reference ``utils.drop_path``) using Flax's ``droppath`` rng stream.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+PRIMITIVES = (
+    "none",
+    "max_pool_3x3",
+    "avg_pool_3x3",
+    "skip_connect",
+    "sep_conv_3x3",
+    "sep_conv_5x5",
+    "dil_conv_3x3",
+    "dil_conv_5x5",
+)
+
+
+class Genotype(NamedTuple):
+    normal: Sequence[Tuple[str, int]]
+    normal_concat: Sequence[int]
+    reduce: Sequence[Tuple[str, int]]
+    reduce_concat: Sequence[int]
+
+
+# Published DARTS genotypes (schema of reference ``genotypes.py``) -- usable as
+# fixed architectures without running a search.
+DARTS_V1 = Genotype(
+    normal=[("sep_conv_3x3", 1), ("sep_conv_3x3", 0), ("skip_connect", 0),
+            ("sep_conv_3x3", 1), ("skip_connect", 0), ("sep_conv_3x3", 1),
+            ("sep_conv_3x3", 0), ("skip_connect", 2)],
+    normal_concat=[2, 3, 4, 5],
+    reduce=[("max_pool_3x3", 0), ("max_pool_3x3", 1), ("skip_connect", 2),
+            ("max_pool_3x3", 0), ("max_pool_3x3", 0), ("skip_connect", 2),
+            ("skip_connect", 2), ("avg_pool_3x3", 0)],
+    reduce_concat=[2, 3, 4, 5])
+
+
+def _bn(train, affine=True, name=None):
+    return nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                        epsilon=1e-5, use_scale=affine, use_bias=affine,
+                        name=name)
+
+
+class ReLUConvBN(nn.Module):
+    C_out: int
+    kernel: int = 1
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train):
+        x = nn.relu(x)
+        x = nn.Conv(self.C_out, (self.kernel, self.kernel),
+                    strides=self.stride, padding="SAME", use_bias=False)(x)
+        return _bn(train, affine=False)(x)
+
+
+class FactorizedReduce(nn.Module):
+    """Stride-2 reduction via two offset 1x1 convs (keeps all pixels)."""
+    C_out: int
+
+    @nn.compact
+    def __call__(self, x, train):
+        x = nn.relu(x)
+        a = nn.Conv(self.C_out // 2, (1, 1), strides=2, use_bias=False)(x)
+        b = nn.Conv(self.C_out - self.C_out // 2, (1, 1), strides=2,
+                    use_bias=False)(x[:, 1:, 1:, :])
+        # pad b back to a's spatial dims (odd inputs)
+        pad_h = a.shape[1] - b.shape[1]
+        pad_w = a.shape[2] - b.shape[2]
+        b = jnp.pad(b, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)))
+        return _bn(train, affine=False)(jnp.concatenate([a, b], axis=-1))
+
+
+class SepConv(nn.Module):
+    """Two stacked depthwise-separable convs (reference ``operations.py``)."""
+    C_out: int
+    kernel: int
+    stride: int
+
+    @nn.compact
+    def __call__(self, x, train):
+        C_in = x.shape[-1]
+        for i, (stride, cout) in enumerate([(self.stride, C_in),
+                                            (1, self.C_out)]):
+            x = nn.relu(x)
+            x = nn.Conv(x.shape[-1], (self.kernel, self.kernel), strides=stride,
+                        padding="SAME", feature_group_count=x.shape[-1],
+                        use_bias=False, name=f"dw{i}")(x)
+            x = nn.Conv(cout, (1, 1), use_bias=False, name=f"pw{i}")(x)
+            x = _bn(train, affine=False, name=f"bn{i}")(x)
+        return x
+
+
+class DilConv(nn.Module):
+    C_out: int
+    kernel: int
+    stride: int
+    dilation: int = 2
+
+    @nn.compact
+    def __call__(self, x, train):
+        x = nn.relu(x)
+        x = nn.Conv(x.shape[-1], (self.kernel, self.kernel),
+                    strides=self.stride, padding="SAME",
+                    kernel_dilation=self.dilation,
+                    feature_group_count=x.shape[-1], use_bias=False)(x)
+        x = nn.Conv(self.C_out, (1, 1), use_bias=False)(x)
+        return _bn(train, affine=False)(x)
+
+
+class PoolOp(nn.Module):
+    kind: str  # "max" | "avg"
+    stride: int
+
+    @nn.compact
+    def __call__(self, x, train):
+        if self.kind == "max":
+            x = nn.max_pool(x, (3, 3), strides=(self.stride, self.stride),
+                            padding="SAME")
+        else:
+            x = nn.avg_pool(x, (3, 3), strides=(self.stride, self.stride),
+                            padding="SAME", count_include_pad=False)
+        return _bn(train, affine=False)(x)
+
+
+class ZeroOp(nn.Module):
+    stride: int
+
+    def __call__(self, x, train):
+        if self.stride == 1:
+            return jnp.zeros_like(x)
+        return jnp.zeros_like(x[:, ::self.stride, ::self.stride, :])
+
+
+class SkipOp(nn.Module):
+    C_out: int
+    stride: int
+
+    @nn.compact
+    def __call__(self, x, train):
+        if self.stride == 1:
+            return x
+        return FactorizedReduce(self.C_out)(x, train)
+
+
+def make_op(primitive: str, C: int, stride: int, name: str):
+    if primitive == "none":
+        return ZeroOp(stride, name=name)
+    if primitive == "max_pool_3x3":
+        return PoolOp("max", stride, name=name)
+    if primitive == "avg_pool_3x3":
+        return PoolOp("avg", stride, name=name)
+    if primitive == "skip_connect":
+        return SkipOp(C, stride, name=name)
+    if primitive == "sep_conv_3x3":
+        return SepConv(C, 3, stride, name=name)
+    if primitive == "sep_conv_5x5":
+        return SepConv(C, 5, stride, name=name)
+    if primitive == "dil_conv_3x3":
+        return DilConv(C, 3, stride, name=name)
+    if primitive == "dil_conv_5x5":
+        return DilConv(C, 5, stride, name=name)
+    raise ValueError(primitive)
+
+
+class MixedOp(nn.Module):
+    C: int
+    stride: int
+
+    @nn.compact
+    def __call__(self, x, weights, train):
+        outs = [make_op(p, self.C, self.stride, name=p)(x, train)
+                for p in PRIMITIVES]
+        return sum(w * o for w, o in zip(weights, outs))
+
+
+class SearchCell(nn.Module):
+    """DARTS cell: 2 input nodes + ``steps`` intermediate nodes, every edge a
+    MixedOp; output = channel-concat of the intermediate nodes."""
+    C: int
+    steps: int
+    reduction: bool
+    reduction_prev: bool
+
+    @nn.compact
+    def __call__(self, s0, s1, weights, train):
+        if self.reduction_prev:
+            s0 = FactorizedReduce(self.C, name="pre0")(s0, train)
+        else:
+            s0 = ReLUConvBN(self.C, name="pre0")(s0, train)
+        s1 = ReLUConvBN(self.C, name="pre1")(s1, train)
+        states = [s0, s1]
+        offset = 0
+        for i in range(self.steps):
+            s = sum(
+                MixedOp(self.C, 2 if self.reduction and j < 2 else 1,
+                        name=f"edge{offset + j}")(
+                    states[j], weights[offset + j], train)
+                for j in range(len(states)))
+            states.append(s)
+            offset += len(states) - 1
+        return jnp.concatenate(states[-self.steps:], axis=-1)
+
+
+def n_edges(steps: int) -> int:
+    return sum(2 + i for i in range(steps))
+
+
+class DARTSNetwork(nn.Module):
+    """Search network (reference ``model_search.py:172`` Network).
+
+    Alphas are ``arch`` collection variables ``alphas_normal`` /
+    ``alphas_reduce`` of shape ``[n_edges, |PRIMITIVES|]``; softmax happens
+    inside the forward pass, gradients flow to the ``arch`` collection.
+    """
+    C: int = 16
+    layers: int = 8
+    num_classes: int = 10
+    steps: int = 4
+    stem_multiplier: int = 3
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        k = n_edges(self.steps)
+        init = nn.initializers.normal(1e-3)
+        a_n = self.variable("arch", "alphas_normal", init,
+                            self.make_rng("params") if self.is_initializing()
+                            else None, (k, len(PRIMITIVES)))
+        a_r = self.variable("arch", "alphas_reduce", init,
+                            self.make_rng("params") if self.is_initializing()
+                            else None, (k, len(PRIMITIVES)))
+        w_normal = jax.nn.softmax(a_n.value, axis=-1)
+        w_reduce = jax.nn.softmax(a_r.value, axis=-1)
+
+        C_curr = self.stem_multiplier * self.C
+        s = nn.Conv(C_curr, (3, 3), padding=1, use_bias=False, name="stem")(x)
+        s0 = s1 = _bn(train, name="stem_bn")(s)
+        C_curr = self.C
+        reduction_prev = False
+        for i in range(self.layers):
+            reduction = self.layers >= 3 and i in (self.layers // 3,
+                                                   2 * self.layers // 3)
+            if reduction:
+                C_curr *= 2
+            cell = SearchCell(C_curr, self.steps, reduction, reduction_prev,
+                              name=f"cell{i}")
+            s0, s1 = s1, cell(s0, s1, w_reduce if reduction else w_normal,
+                              train)
+            reduction_prev = reduction
+        out = jnp.mean(s1, axis=(1, 2))
+        return nn.Dense(self.num_classes, name="classifier")(out)
+
+
+def derive_genotype(arch) -> Genotype:
+    """Discretize alphas -> Genotype: per node keep the 2 strongest incoming
+    edges (ranked by max non-``none`` weight), each with its best non-``none``
+    primitive (reference ``model_search.py`` ``genotype()``)."""
+    import numpy as np
+
+    def parse(alphas):
+        w = np.asarray(jax.nn.softmax(jnp.asarray(alphas), axis=-1))
+        gene, start = [], 0
+        steps = _steps_from_edges(w.shape[0])
+        none_idx = PRIMITIVES.index("none")
+        for i in range(steps):
+            n_in = 2 + i
+            rows = w[start:start + n_in]
+            strength = np.max(np.delete(rows, none_idx, axis=1), axis=1)
+            for j in np.argsort(-strength)[:2]:
+                ops = rows[j].copy()
+                ops[none_idx] = -1
+                gene.append((PRIMITIVES[int(np.argmax(ops))], int(j)))
+            start += n_in
+        return gene, list(range(2, 2 + steps))[-4:] if steps >= 4 else list(
+            range(2, 2 + steps))
+
+    normal, n_cat = parse(arch["alphas_normal"])
+    reduce, r_cat = parse(arch["alphas_reduce"])
+    return Genotype(normal=normal, normal_concat=n_cat,
+                    reduce=reduce, reduce_concat=r_cat)
+
+
+def _steps_from_edges(k: int) -> int:
+    steps, total = 0, 0
+    while total < k:
+        total += 2 + steps
+        steps += 1
+    assert total == k, f"invalid edge count {k}"
+    return steps
+
+
+class FixedCell(nn.Module):
+    """Discrete cell from a genotype (reference train-stage ``model.py`` Cell)
+    with per-sample drop-path on non-skip edges."""
+    C: int
+    genotype: Genotype
+    reduction: bool
+    reduction_prev: bool
+    drop_path_prob: float = 0.0
+
+    @nn.compact
+    def __call__(self, s0, s1, train):
+        if self.reduction_prev:
+            s0 = FactorizedReduce(self.C, name="pre0")(s0, train)
+        else:
+            s0 = ReLUConvBN(self.C, name="pre0")(s0, train)
+        s1 = ReLUConvBN(self.C, name="pre1")(s1, train)
+        gene = self.genotype.reduce if self.reduction else self.genotype.normal
+        concat = (self.genotype.reduce_concat if self.reduction
+                  else self.genotype.normal_concat)
+        states = [s0, s1]
+        steps = len(gene) // 2
+        for i in range(steps):
+            outs = []
+            for e in range(2):
+                op_name, j = gene[2 * i + e]
+                stride = 2 if self.reduction and j < 2 else 1
+                h = make_op(op_name, self.C, stride,
+                            name=f"node{i}_edge{e}_{op_name}")(states[j], train)
+                if (train and self.drop_path_prob > 0.0
+                        and op_name != "skip_connect"):
+                    keep = 1.0 - self.drop_path_prob
+                    mask = jax.random.bernoulli(
+                        self.make_rng("droppath"), keep,
+                        (h.shape[0], 1, 1, 1)).astype(h.dtype)
+                    h = h * mask / keep
+                outs.append(h)
+            states.append(outs[0] + outs[1])
+        return jnp.concatenate([states[i] for i in concat], axis=-1)
+
+
+class DARTSFixedNetwork(nn.Module):
+    """Post-search evaluation network built from a Genotype (reference
+    train-stage NetworkCIFAR; flags at ``main_fednas.py:44-99`` stage
+    ``train``)."""
+    genotype: Genotype = DARTS_V1
+    C: int = 36
+    layers: int = 8
+    num_classes: int = 10
+    stem_multiplier: int = 3
+    drop_path_prob: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        C_curr = self.stem_multiplier * self.C
+        s = nn.Conv(C_curr, (3, 3), padding=1, use_bias=False, name="stem")(x)
+        s0 = s1 = _bn(train, name="stem_bn")(s)
+        C_curr = self.C
+        reduction_prev = False
+        for i in range(self.layers):
+            reduction = self.layers >= 3 and i in (self.layers // 3,
+                                                   2 * self.layers // 3)
+            if reduction:
+                C_curr *= 2
+            cell = FixedCell(C_curr, self.genotype, reduction, reduction_prev,
+                             self.drop_path_prob, name=f"cell{i}")
+            s0, s1 = s1, cell(s0, s1, train)
+            reduction_prev = reduction
+        out = jnp.mean(s1, axis=(1, 2))
+        return nn.Dense(self.num_classes, name="classifier")(out)
