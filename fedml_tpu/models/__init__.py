@@ -9,6 +9,8 @@ from fedml_tpu.models.cnn import CNNOriginalFedAvg, CNNDropOut  # noqa: F401
 from fedml_tpu.models.resnet import CifarResNet, resnet56, resnet110  # noqa: F401
 from fedml_tpu.models.resnet_gn import ResNetGN, resnet18_gn, resnet34_gn, resnet50_gn  # noqa: F401
 from fedml_tpu.models.mobilenet import MobileNet  # noqa: F401
+from fedml_tpu.models.mobilenet_v3 import MobileNetV3  # noqa: F401
+from fedml_tpu.models.efficientnet import EfficientNet, efficientnet  # noqa: F401
 from fedml_tpu.models.vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from fedml_tpu.models.rnn import RNNOriginalFedAvg, RNNStackOverflow  # noqa: F401
 from fedml_tpu.models.gkt import (  # noqa: F401

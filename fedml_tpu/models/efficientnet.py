@@ -1,0 +1,172 @@
+"""EfficientNet (b0-b7) in Flax. Parity: reference
+``fedml_api/model/cv/efficientnet.py:138`` (``EfficientNet.from_name``) and
+``efficientnet_utils.py`` (swish, drop-connect, compound width/depth scaling,
+``round_filters``/``round_repeats`` at ``efficientnet_utils.py:79-110``).
+
+TPU notes: MBConv is expressed as 1x1 expand -> depthwise (``feature_group_
+count``) -> SE -> 1x1 project, all MXU-friendly; swish and the SE gate fuse
+into the convs under XLA. Drop-connect is per-sample stochastic depth drawn
+from the ``dropout`` RNG collection at train time.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, NamedTuple, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BlockArgs(NamedTuple):
+    """One MBConv stage (reference ``BlockArgs``,
+    ``efficientnet_utils.py:45-47``)."""
+    kernel: int
+    strides: int
+    expand_ratio: int
+    in_filters: int
+    out_filters: int
+    num_repeat: int
+    se_ratio: float = 0.25
+
+
+# EfficientNet-B0 baseline stages (reference block-string decode,
+# ``efficientnet_utils.py`` blocks_args for b0).
+_B0_BLOCKS: Sequence[BlockArgs] = [
+    BlockArgs(3, 1, 1, 32, 16, 1),
+    BlockArgs(3, 2, 6, 16, 24, 2),
+    BlockArgs(5, 2, 6, 24, 40, 2),
+    BlockArgs(3, 2, 6, 40, 80, 3),
+    BlockArgs(5, 1, 6, 80, 112, 3),
+    BlockArgs(5, 2, 6, 112, 192, 4),
+    BlockArgs(3, 1, 6, 192, 320, 1),
+]
+
+# name -> (width_coef, depth_coef, dropout) (reference
+# ``efficientnet_utils.py`` efficientnet_params table).
+_PARAMS = {
+    "efficientnet-b0": (1.0, 1.0, 0.2),
+    "efficientnet-b1": (1.0, 1.1, 0.2),
+    "efficientnet-b2": (1.1, 1.2, 0.3),
+    "efficientnet-b3": (1.2, 1.4, 0.3),
+    "efficientnet-b4": (1.4, 1.8, 0.4),
+    "efficientnet-b5": (1.6, 2.2, 0.4),
+    "efficientnet-b6": (1.8, 2.6, 0.5),
+    "efficientnet-b7": (2.0, 3.1, 0.5),
+}
+
+
+def round_filters(filters: int, width_coef: float, divisor: int = 8) -> int:
+    """Width scaling (reference ``efficientnet_utils.py:79-102``); same
+    divisor rounding rule as MobileNetV3's channel rounding."""
+    from fedml_tpu.models.mobilenet_v3 import _make_divisible
+    return _make_divisible(filters * width_coef, divisor)
+
+
+def round_repeats(repeats: int, depth_coef: float) -> int:
+    """Depth scaling (reference ``efficientnet_utils.py:105-110``)."""
+    return int(-(-depth_coef * repeats // 1))  # ceil
+
+
+def drop_connect(x, rng, rate: float):
+    """Per-sample stochastic depth (reference
+    ``efficientnet_utils.py`` drop_connect)."""
+    import jax
+    keep = 1.0 - rate
+    shape = (x.shape[0],) + (1,) * (x.ndim - 1)
+    mask = jnp.floor(keep + jax.random.uniform(rng, shape, x.dtype))
+    return x / keep * mask
+
+
+class MBConvBlock(nn.Module):
+    """Mobile inverted bottleneck + SE (reference ``MBConvBlock``,
+    ``efficientnet.py:36-135``)."""
+    kernel: int
+    strides: int
+    expand_ratio: int
+    out_filters: int
+    se_ratio: float
+    norm: Any
+    drop_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        in_ch = x.shape[-1]
+        mid = in_ch * self.expand_ratio
+        y = x
+        if self.expand_ratio != 1:
+            y = nn.Conv(mid, (1, 1), use_bias=False, name="expand")(y)
+            y = nn.swish(self.norm(name="bn0")(y))
+        y = nn.Conv(mid, (self.kernel, self.kernel), strides=self.strides,
+                    padding=self.kernel // 2, feature_group_count=mid,
+                    use_bias=False, name="dw")(y)
+        y = nn.swish(self.norm(name="bn1")(y))
+        if self.se_ratio > 0:
+            se_ch = max(1, int(in_ch * self.se_ratio))
+            s = jnp.mean(y, axis=(1, 2))
+            s = nn.swish(nn.Dense(se_ch, name="se_reduce")(s))
+            s = nn.sigmoid(nn.Dense(mid, name="se_expand")(s))
+            y = y * s[:, None, None, :]
+        y = nn.Conv(self.out_filters, (1, 1), use_bias=False, name="project")(y)
+        y = self.norm(name="bn2")(y)
+        if self.strides == 1 and in_ch == self.out_filters:
+            if train and self.drop_rate > 0:
+                y = drop_connect(y, self.make_rng("dropout"), self.drop_rate)
+            y = y + x
+        return y
+
+
+class EfficientNet(nn.Module):
+    """Reference ``EfficientNet`` (``efficientnet.py:138-302``); construct via
+    :func:`efficientnet` (the ``from_name`` analog, ``efficientnet.py:305``)."""
+    num_classes: int = 1000
+    width_coef: float = 1.0
+    depth_coef: float = 1.0
+    dropout_rate: float = 0.2
+    drop_connect_rate: float = 0.2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.99, epsilon=1e-3, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = nn.Conv(round_filters(32, self.width_coef), (3, 3), strides=2,
+                    padding=1, use_bias=False, name="stem")(x)
+        x = nn.swish(norm(name="bn_stem")(x))
+
+        total = sum(round_repeats(b.num_repeat, self.depth_coef)
+                    for b in _B0_BLOCKS)
+        idx = 0
+        for si, b in enumerate(_B0_BLOCKS):
+            out_f = round_filters(b.out_filters, self.width_coef)
+            for r in range(round_repeats(b.num_repeat, self.depth_coef)):
+                # drop-connect rate scales linearly with depth
+                # (reference efficientnet.py:291-294)
+                rate = self.drop_connect_rate * idx / total
+                x = MBConvBlock(b.kernel, b.strides if r == 0 else 1,
+                                b.expand_ratio, out_f, b.se_ratio, norm,
+                                drop_rate=rate,
+                                name=f"block{si}_{r}")(x, train=train)
+                idx += 1
+
+        x = nn.Conv(round_filters(1280, self.width_coef), (1, 1),
+                    use_bias=False, name="head")(x)
+        x = nn.swish(norm(name="bn_head")(x))
+        x = jnp.mean(x, axis=(1, 2))
+        if self.dropout_rate > 0:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(
+            x.astype(jnp.float32))
+
+
+def efficientnet(model_name: str = "efficientnet-b0", num_classes: int = 1000,
+                 **kw) -> EfficientNet:
+    """``EfficientNet.from_name`` analog (reference ``efficientnet.py:305-325``)."""
+    if model_name not in _PARAMS:
+        raise ValueError(
+            f"model_name should be one of: {', '.join(sorted(_PARAMS))}")
+    w, d, drop = _PARAMS[model_name]
+    kw.setdefault("dropout_rate", drop)
+    return EfficientNet(num_classes=num_classes, width_coef=w, depth_coef=d,
+                        **kw)

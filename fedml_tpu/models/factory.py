@@ -13,8 +13,8 @@ def create_model(args, model_name, output_dim):
 
     Accepted names (reference ``main_fedavg.py:217-252`` plus aliases):
     lr, cnn, cnn_dropout, resnet56, resnet110, resnet18_gn, resnet34_gn,
-    resnet50_gn, mobilenet, vgg11/13/16/19, rnn (shakespeare LSTM),
-    rnn_stackoverflow.
+    resnet50_gn, mobilenet, mobilenet_v3, efficientnet[-b0..b7],
+    vgg11/13/16/19, rnn (shakespeare LSTM), rnn_stackoverflow.
     """
     from fedml_tpu import models
 
@@ -41,6 +41,12 @@ def create_model(args, model_name, output_dim):
         return models.resnet50_gn(class_num=output_dim, group_norm=group_norm)
     if model_name == "mobilenet":
         return models.MobileNet(num_classes=output_dim)
+    if model_name == "mobilenet_v3":
+        mode = getattr(args, "model_mode", "LARGE") if args else "LARGE"
+        return models.MobileNetV3(model_mode=mode, num_classes=output_dim)
+    if model_name.startswith("efficientnet"):
+        name = "efficientnet-b0" if model_name == "efficientnet" else model_name
+        return models.efficientnet(name, num_classes=output_dim)
     if model_name in ("vgg11", "vgg13", "vgg16", "vgg19"):
         fn = getattr(models, model_name)
         return fn(class_num=output_dim,

@@ -1,0 +1,156 @@
+"""MobileNetV3 LARGE/SMALL. Parity: reference
+``fedml_api/model/cv/mobilenet_v3.py:137`` (``MobileNetV3(model_mode=
+"LARGE"|"SMALL", num_classes, multiplier, dropout_rate)``).
+
+TPU notes: depthwise convs use ``feature_group_count`` so XLA maps them onto
+the MXU; h-swish/h-sigmoid are cheap elementwise ops XLA fuses into the
+surrounding convs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def h_sigmoid(x):
+    """Reference ``mobilenet_v3.py:35-41`` (relu6(x+3)/6)."""
+    return nn.relu6(x + 3.0) / 6.0
+
+
+def h_swish(x):
+    """Reference ``mobilenet_v3.py:44-50`` (x * h_sigmoid(x))."""
+    return x * h_sigmoid(x)
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    """Channel rounding, reference ``mobilenet_v3.py:54-61``."""
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class SqueezeExcite(nn.Module):
+    """SE block with h-sigmoid gate (reference ``SqueezeBlock``,
+    ``mobilenet_v3.py:64-81``, divide=4)."""
+    channels: int
+    divide: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        s = jnp.mean(x, axis=(1, 2))
+        s = nn.relu(nn.Dense(self.channels // self.divide, name="fc1")(s))
+        s = h_sigmoid(nn.Dense(self.channels, name="fc2")(s))
+        return x * s[:, None, None, :]
+
+
+class _Bneck(nn.Module):
+    """Inverted-residual bottleneck (reference ``MobileBlock``,
+    ``mobilenet_v3.py:84-135``)."""
+    kernel: int
+    exp_size: int
+    out_channels: int
+    use_se: bool
+    use_hs: bool  # h-swish if True else ReLU
+    strides: int
+    norm: Any
+
+    @nn.compact
+    def __call__(self, x):
+        act = h_swish if self.use_hs else nn.relu
+        in_ch = x.shape[-1]
+        y = x
+        if self.exp_size != in_ch:
+            y = nn.Conv(self.exp_size, (1, 1), use_bias=False, name="expand")(y)
+            y = act(self.norm(name="bn1")(y))
+        y = nn.Conv(self.exp_size, (self.kernel, self.kernel),
+                    strides=self.strides, padding=self.kernel // 2,
+                    feature_group_count=self.exp_size, use_bias=False,
+                    name="dw")(y)
+        y = act(self.norm(name="bn2")(y))
+        if self.use_se:
+            y = SqueezeExcite(self.exp_size, name="se")(y)
+        y = nn.Conv(self.out_channels, (1, 1), use_bias=False, name="project")(y)
+        y = self.norm(name="bn3")(y)
+        if self.strides == 1 and in_ch == self.out_channels:
+            y = y + x
+        return y
+
+
+# (kernel, exp_size, out, SE, h-swish, stride) -- paper Table 1/2, matching
+# the reference's layer settings (mobilenet_v3.py:137-243).
+_LARGE: Sequence[Tuple[int, int, int, bool, bool, int]] = [
+    (3, 16, 16, False, False, 1),
+    (3, 64, 24, False, False, 2),
+    (3, 72, 24, False, False, 1),
+    (5, 72, 40, True, False, 2),
+    (5, 120, 40, True, False, 1),
+    (5, 120, 40, True, False, 1),
+    (3, 240, 80, False, True, 2),
+    (3, 200, 80, False, True, 1),
+    (3, 184, 80, False, True, 1),
+    (3, 184, 80, False, True, 1),
+    (3, 480, 112, True, True, 1),
+    (3, 672, 112, True, True, 1),
+    (5, 672, 160, True, True, 2),
+    (5, 960, 160, True, True, 1),
+    (5, 960, 160, True, True, 1),
+]
+_SMALL: Sequence[Tuple[int, int, int, bool, bool, int]] = [
+    (3, 16, 16, True, False, 2),
+    (3, 72, 24, False, False, 2),
+    (3, 88, 24, False, False, 1),
+    (5, 96, 40, True, True, 2),
+    (5, 240, 40, True, True, 1),
+    (5, 240, 40, True, True, 1),
+    (5, 120, 48, True, True, 1),
+    (5, 144, 48, True, True, 1),
+    (5, 288, 96, True, True, 2),
+    (5, 576, 96, True, True, 1),
+    (5, 576, 96, True, True, 1),
+]
+
+
+class MobileNetV3(nn.Module):
+    """Reference ``MobileNetV3`` (``mobilenet_v3.py:137-265``)."""
+    model_mode: str = "LARGE"
+    num_classes: int = 1000
+    multiplier: float = 1.0
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        mode = self.model_mode.upper()
+        if mode not in ("LARGE", "SMALL"):
+            raise ValueError(f"model_mode must be LARGE or SMALL, got "
+                             f"{self.model_mode!r}")
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        cfg = _LARGE if mode == "LARGE" else _SMALL
+        last_exp = 960 if mode == "LARGE" else 576
+        x = x.astype(self.dtype)
+
+        stem = _make_divisible(16 * self.multiplier)
+        x = nn.Conv(stem, (3, 3), strides=2, padding=1, use_bias=False,
+                    name="stem")(x)
+        x = h_swish(norm(name="bn_stem")(x))
+        for i, (k, e, c, se, hs, s) in enumerate(cfg):
+            x = _Bneck(k, _make_divisible(e * self.multiplier),
+                       _make_divisible(c * self.multiplier), se, hs, s, norm,
+                       name=f"bneck{i}")(x)
+        head = _make_divisible(last_exp * self.multiplier)
+        x = nn.Conv(head, (1, 1), use_bias=False, name="head_conv")(x)
+        x = h_swish(norm(name="bn_head")(x))
+        x = jnp.mean(x, axis=(1, 2))
+        x = h_swish(nn.Dense(1280, name="head_fc")(x))
+        if self.dropout_rate > 0:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="classifier")(x.astype(jnp.float32))

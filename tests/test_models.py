@@ -62,6 +62,35 @@ class TestShapes:
         out, _ = model.apply(v, x, train=True, mutable=["batch_stats"])
         assert out.shape == (2, 10)
 
+    @pytest.mark.parametrize("mode", ["LARGE", "SMALL"])
+    def test_mobilenet_v3_forward(self, mode):
+        model = models.MobileNetV3(model_mode=mode, num_classes=10)
+        x = jnp.zeros((2, 32, 32, 3))
+        v = _init(model, x)
+        out, _ = model.apply(v, x, train=True, mutable=["batch_stats"])
+        assert out.shape == (2, 10)
+
+    def test_efficientnet_b0_forward(self):
+        model = models.efficientnet("efficientnet-b0", num_classes=10)
+        x = jnp.zeros((2, 32, 32, 3))
+        v = _init(model, x)
+        out = model.apply(v, x, train=False)
+        assert out.shape == (2, 10)
+        # train mode exercises drop-connect + dropout RNGs
+        out2, _ = model.apply(v, x, train=True, mutable=["batch_stats"],
+                              rngs={"dropout": jax.random.PRNGKey(1)})
+        assert out2.shape == (2, 10)
+
+    def test_efficientnet_scaling(self):
+        # b1 deepens without widening; b2 widens (compound scaling table)
+        from fedml_tpu.models.efficientnet import round_filters, round_repeats
+        assert round_filters(32, 1.0) == 32
+        assert round_filters(32, 1.1) == 32  # 35.2 rounds down within 10%
+        assert round_filters(40, 1.1) == 48  # divisor-8 rounding up
+        assert round_repeats(2, 1.1) == 3  # ceil
+        with pytest.raises(ValueError, match="model_name"):
+            models.efficientnet("efficientnet-b9")
+
     def test_vgg11_forward(self):
         model = models.vgg11(class_num=10, classifier_dims=(512,))
         x = jnp.zeros((2, 32, 32, 3))
