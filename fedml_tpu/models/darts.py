@@ -340,11 +340,9 @@ class FixedCell(nn.Module):
                             name=f"node{i}_edge{e}_{op_name}")(states[j], train)
                 if (train and self.drop_path_prob > 0.0
                         and op_name != "skip_connect"):
-                    keep = 1.0 - self.drop_path_prob
-                    mask = jax.random.bernoulli(
-                        self.make_rng("droppath"), keep,
-                        (h.shape[0], 1, 1, 1)).astype(h.dtype)
-                    h = h * mask / keep
+                    from fedml_tpu.models.layers import drop_path
+                    h = drop_path(h, self.make_rng("droppath"),
+                                  self.drop_path_prob)
                 outs.append(h)
             states.append(outs[0] + outs[1])
         return jnp.concatenate([states[i] for i in concat], axis=-1)

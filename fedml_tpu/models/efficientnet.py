@@ -71,11 +71,8 @@ def round_repeats(repeats: int, depth_coef: float) -> int:
 def drop_connect(x, rng, rate: float):
     """Per-sample stochastic depth (reference
     ``efficientnet_utils.py`` drop_connect)."""
-    import jax
-    keep = 1.0 - rate
-    shape = (x.shape[0],) + (1,) * (x.ndim - 1)
-    mask = jnp.floor(keep + jax.random.uniform(rng, shape, x.dtype))
-    return x / keep * mask
+    from fedml_tpu.models.layers import drop_path
+    return drop_path(x, rng, rate)
 
 
 class MBConvBlock(nn.Module):

@@ -1,0 +1,23 @@
+"""Shared layer helpers used across the model zoo."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def drop_path(x, rng, rate: float):
+    """Per-sample stochastic depth: zero a sample's whole residual branch
+    with probability ``rate``, rescaling survivors by 1/keep.
+
+    One implementation for both reference variants -- EfficientNet's
+    ``drop_connect`` (``efficientnet_utils.py``) and DARTS' ``drop_path``
+    (``cv/darts/utils.py``); they are the same transform.
+    """
+    keep = 1.0 - rate
+    shape = (x.shape[0],) + (1,) * (x.ndim - 1)
+    mask = jax.random.bernoulli(rng, keep, shape).astype(x.dtype)
+    return x * mask / keep
+
+
+__all__ = ["drop_path"]

@@ -1,0 +1,17 @@
+"""Observability + persistence utilities (SURVEY.md section 5).
+
+The reference's two metric channels are Python ``logging`` with a
+``process_id - timestamp file:line`` format (``main_fedavg.py:285-289``) and
+wandb on rank 0 (``main_fedavg.py:297-305``). Its only checkpointer is
+FedSeg's ``Saver`` (``fedseg/utils.py:169-242``); tracing is ad-hoc
+wall-clock logs. Here these are first-class: a wandb-or-JSONL metrics
+logger, orbax checkpoint/resume, and ``jax.profiler`` trace hooks.
+"""
+
+from fedml_tpu.utils.logging_utils import init_logging
+from fedml_tpu.utils.metrics import MetricsLogger
+from fedml_tpu.utils.checkpoint import Checkpointer
+from fedml_tpu.utils.profiling import profile_trace, annotate_step
+
+__all__ = ["init_logging", "MetricsLogger", "Checkpointer",
+           "profile_trace", "annotate_step"]

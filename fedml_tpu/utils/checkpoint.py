@@ -1,0 +1,148 @@
+"""Checkpoint/resume: orbax over (global params, server state, round, RNG).
+
+The reference's only checkpointer is FedSeg's ``Saver``
+(``fedseg/utils.py:169-242``): it writes ``checkpoint.pth.tar`` per
+experiment dir, tracks the best metric (best mIoU) across runs in
+``best_pred.txt``, and snapshots the config to ``parameters.txt`` -- but
+nothing anywhere can *resume*. This module keeps Saver's semantics
+(best-metric tracking, config snapshot) and adds real resume: the full
+round-loop state -- global model pytree, server optimizer state, round
+index, PRNG key -- round-trips through orbax, so a killed run continues
+bit-exactly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+class Checkpointer:
+    """Orbax-backed checkpoint manager with Saver-parity extras."""
+
+    def __init__(self, directory, max_to_keep=3, best_mode: Optional[str] = None):
+        """Args:
+          directory: checkpoint root (created if absent).
+          max_to_keep: retained steps (orbax GC).
+          best_mode: None keeps the most recent ``max_to_keep``; "max"/"min"
+            keeps the best by the ``metric`` passed to ``save`` (Saver's
+            best-mIoU behavior, ``fedseg/utils.py:189-204``).
+        """
+        import orbax.checkpoint as ocp
+        self._ocp = ocp
+        self.best_mode = best_mode
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            best_fn=(lambda m: m["metric"]) if best_mode else None,
+            best_mode=best_mode or "max",
+        )
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+
+    def save(self, round_idx: int, global_state, server_state=(),
+             rng=None, metric: Optional[float] = None) -> bool:
+        """Checkpoint one round. Returns True if orbax kept it."""
+        payload = {
+            "global_state": global_state,
+            "server_state": _pack_aux(server_state),
+            "rng": rng if rng is not None else jax.random.PRNGKey(0),
+            "has_rng": np.asarray(rng is not None),
+            "round_idx": np.asarray(round_idx),
+        }
+        metrics = {"metric": float(metric)} if metric is not None else None
+        saved = self._mgr.save(
+            round_idx, args=self._ocp.args.StandardSave(payload),
+            metrics=metrics)
+        if metric is not None:
+            self._update_best(round_idx, metric)
+        return saved
+
+    def restore(self, round_idx: Optional[int] = None) -> Optional[dict]:
+        """Restore a round (latest if None). Returns
+        ``{"global_state","server_state","rng","round_idx"}`` or None when
+        the directory has no checkpoints (fresh start)."""
+        self._mgr.wait_until_finished()
+        step = round_idx if round_idx is not None else self._mgr.latest_step()
+        if step is None:
+            return None
+        payload = self._mgr.restore(step)
+        has_rng = bool(np.asarray(payload.get("has_rng", True)))
+        return {
+            "global_state": payload["global_state"],
+            "server_state": _unpack_aux(payload["server_state"]),
+            "rng": (jax.numpy.asarray(payload["rng"], dtype=jax.numpy.uint32)
+                    if has_rng else None),
+            "round_idx": int(np.asarray(payload["round_idx"])),
+        }
+
+    def latest_round(self) -> Optional[int]:
+        self._mgr.wait_until_finished()
+        return self._mgr.latest_step()
+
+    def best_round(self) -> Optional[int]:
+        self._mgr.wait_until_finished()
+        return self._mgr.best_step()
+
+    def save_config(self, args) -> None:
+        """Config snapshot -- the ``parameters.txt`` of Saver
+        (``fedseg/utils.py:206-224``), as JSON."""
+        d = vars(args) if hasattr(args, "__dict__") else dict(args)
+        with open(os.path.join(self.directory, "parameters.json"), "w") as f:
+            json.dump({k: _jsonable(v) for k, v in d.items()}, f, indent=2)
+
+    def _update_best(self, round_idx, metric):
+        """``best_pred.txt`` tracking across runs (``fedseg/utils.py:189-204``)."""
+        path = os.path.join(self.directory, "best_pred.txt")
+        best = None
+        if os.path.exists(path):
+            with open(path) as f:
+                best = json.loads(f.read())
+        better = (metric < best["metric"] if self.best_mode == "min"
+                  else metric > best["metric"]) if best is not None else True
+        if better:
+            with open(path, "w") as f:
+                f.write(json.dumps({"metric": float(metric),
+                                    "round": int(round_idx)}))
+
+    def close(self):
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
+def _pack_aux(tree) -> dict:
+    """Orbax needs non-empty array pytrees; arbitrary aux state (possibly an
+    empty tuple) rides as leaves + treedef-repr pair."""
+    leaves, treedef = jax.tree.flatten(tree)
+    return {"leaves": {str(i): leaf for i, leaf in enumerate(leaves)},
+            "n": np.asarray(len(leaves)),
+            "_treedef": np.frombuffer(
+                _treedef_bytes(treedef), dtype=np.uint8).copy()}
+
+
+def _unpack_aux(packed):
+    import pickle
+    n = int(np.asarray(packed["n"]))
+    leaves = [packed["leaves"][str(i)] for i in range(n)]
+    treedef = pickle.loads(np.asarray(packed["_treedef"]).tobytes())
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def _treedef_bytes(treedef):
+    import pickle
+    return pickle.dumps(treedef)
+
+
+def _jsonable(v):
+    if isinstance(v, (int, float, str, bool, type(None))):
+        return v
+    if hasattr(v, "tolist"):
+        return v.tolist()
+    return str(v)
+
+
+__all__ = ["Checkpointer"]

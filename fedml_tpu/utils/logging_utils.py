@@ -1,0 +1,41 @@
+"""Process-tagged logging, matching the reference's format.
+
+Reference: ``fedml_experiments/distributed/fedavg/main_fedavg.py:285-289``
+configures ``logging.basicConfig`` with
+``str(process_id) + " - %(asctime)s %(filename)s:%(lineno)d] %(message)s"``
+plus ``setproctitle`` process naming (``:281-283``). We reproduce the format
+(so log-scraping tooling carries over) and make the process tag default to
+the JAX process index, which is the SPMD analog of the MPI rank.
+"""
+
+from __future__ import annotations
+
+import logging
+
+
+def init_logging(process_id=None, level=logging.INFO, proctitle=None):
+    """Configure root logging with the reference's line format.
+
+    Args:
+      process_id: tag prepended to every record; defaults to
+        ``jax.process_index()`` when jax is importable, else 0.
+      proctitle: optional process title (reference uses setproctitle,
+        ``main_fedavg.py:281-283``); applied only if the library exists.
+    """
+    if process_id is None:
+        try:
+            import jax
+            process_id = jax.process_index()
+        except Exception:
+            process_id = 0
+    fmt = (str(process_id) +
+           " - %(asctime)s %(filename)s:%(lineno)d] %(message)s")
+    logging.basicConfig(level=level, format=fmt,
+                        datefmt="%a, %d %b %Y %H:%M:%S", force=True)
+    if proctitle:
+        try:
+            import setproctitle
+            setproctitle.setproctitle(proctitle)
+        except ImportError:
+            pass
+    return logging.getLogger()

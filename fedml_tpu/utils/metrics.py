@@ -1,0 +1,92 @@
+"""Metrics store: wandb when available/enabled, JSONL always.
+
+The reference logs ``{"Train/Acc","Train/Loss","Test/Acc","Test/Loss",
+"round"}`` dicts to wandb from the aggregator (``FedAVGAggregator.py:136-162``,
+``fedavg_api.py:172-210``) and its CI reads results back from
+``wandb/latest-run/files/wandb-summary.json`` (``CI-script-fedavg.sh:44``).
+This logger keeps that contract in a zero-egress environment: every
+``log()`` appends one JSON line to ``<run_dir>/metrics.jsonl`` and updates
+``<run_dir>/summary.json`` (last value per key -- the wandb-summary
+equivalent, so equivalence-style CI asserts read the same shape of file);
+wandb mirroring activates only if the package is importable and
+``enable_wandb`` is set.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+
+
+class MetricsLogger:
+    """Callable metrics sink: ``logger(dict)`` or ``logger.log(dict)``."""
+
+    def __init__(self, run_dir=None, enable_wandb=False, project="fedml_tpu",
+                 run_name=None, config=None):
+        self.run_dir = run_dir
+        self._jsonl = None
+        self._summary = {}
+        if run_dir is not None:
+            os.makedirs(run_dir, exist_ok=True)
+            self._jsonl = open(os.path.join(run_dir, "metrics.jsonl"), "a")
+            if config is not None:
+                with open(os.path.join(run_dir, "config.json"), "w") as f:
+                    json.dump(_jsonable(vars(config) if hasattr(config, "__dict__")
+                                        else dict(config)), f, indent=2)
+        self._wandb = None
+        if enable_wandb:
+            try:
+                import wandb
+                self._wandb = wandb
+                wandb.init(project=project, name=run_name,
+                           config=config if config is None else _jsonable(
+                               vars(config) if hasattr(config, "__dict__")
+                               else dict(config)))
+            except ImportError:
+                logging.info("wandb not installed; metrics go to JSONL only")
+
+    def log(self, metrics: dict):
+        record = _jsonable(metrics)
+        logging.info("%s", record)
+        if self._jsonl is not None:
+            self._jsonl.write(json.dumps({"_ts": time.time(), **record}) + "\n")
+            self._jsonl.flush()
+            self._summary.update(record)
+            with open(os.path.join(self.run_dir, "summary.json"), "w") as f:
+                json.dump(self._summary, f, indent=2)
+        if self._wandb is not None:
+            self._wandb.log(record)
+
+    __call__ = log
+
+    @property
+    def summary(self):
+        return dict(self._summary)
+
+    def close(self):
+        if self._jsonl is not None:
+            self._jsonl.close()
+            self._jsonl = None
+        if self._wandb is not None:
+            self._wandb.finish()
+            self._wandb = None
+
+
+def _jsonable(d):
+    return {str(k): _jsonable_value(v) for k, v in d.items()}
+
+
+def _jsonable_value(v):
+    if hasattr(v, "item") and getattr(v, "ndim", 1) == 0:
+        return v.item()
+    if hasattr(v, "tolist"):
+        return v.tolist()
+    if isinstance(v, dict):
+        return _jsonable(v)
+    if isinstance(v, (list, tuple)):
+        return [_jsonable_value(x) for x in v]
+    if isinstance(v, (int, float, str, bool, type(None))):
+        return v
+    return str(v)

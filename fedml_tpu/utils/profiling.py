@@ -1,0 +1,37 @@
+"""First-class tracing via ``jax.profiler`` (SURVEY.md section 5.1).
+
+The reference has no profiling beyond ad-hoc wall-clock logs of aggregation
+(``FedAVGAggregator.py:59,85-86``). On TPU, XLA traces are the primary
+performance tool, so round loops here can wrap themselves in
+``profile_trace`` (TensorBoard-viewable) and annotate each federated round
+as a profiler step.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir, enabled=True):
+    """Trace everything inside the block to ``log_dir`` (view in
+    TensorBoard's profile plugin). No-op when ``enabled`` is falsy so the
+    flag can be wired straight from argparse."""
+    if not enabled or log_dir is None:
+        yield
+        return
+    import jax
+    jax.profiler.start_trace(str(log_dir))
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        logging.info("profiler trace written to %s", log_dir)
+
+
+def annotate_step(round_idx):
+    """Label one federated round as a profiler step:
+    ``with annotate_step(r): round_fn(...)``."""
+    import jax
+    return jax.profiler.StepTraceAnnotation("fed_round", step_num=round_idx)
