@@ -144,16 +144,27 @@ class FedAvgAPI:
         return {"Test/Loss": float(totals["loss_sum"] / max(totals["count"], 1)),
                 "Test/Acc": float(totals["correct"] / max(totals["count"], 1))}
 
-    def train(self):
+    def train(self, on_round=None):
         """Full training loop (reference ``fedavg_api.py:40-81``): per-round
         cohort sampling, local training, aggregation; eval every
-        ``frequency_of_the_test`` rounds and on the final round."""
+        ``frequency_of_the_test`` rounds and on the final round. Starts at
+        ``self.round_idx`` so a checkpoint-restored API resumes mid-run.
+
+        ``on_round(api, metrics)`` is called after each round -- the
+        checkpoint/extra-eval hook used by the experiment mains. Each round
+        is annotated as a ``jax.profiler`` step so traces segment cleanly.
+        """
+        from fedml_tpu.utils.profiling import annotate_step
+
         freq = getattr(self.args, "frequency_of_the_test", 5)
-        for _ in range(self.args.comm_round):
-            metrics = self.train_one_round()
+        while self.round_idx < self.args.comm_round:
+            with annotate_step(self.round_idx):
+                metrics = self.train_one_round()
             last = self.round_idx == self.args.comm_round
             if self.round_idx % freq == 0 or last:
                 metrics.update(self.evaluate_global())
             self.metrics_logger(metrics)
             self.history.append(metrics)
+            if on_round is not None:
+                on_round(self, metrics)
         return self.global_state

@@ -16,17 +16,26 @@ def load_dataset(args, dataset_name):
 
     from fedml_tpu.data import synthetic
 
+    # synthetic sets honor optional size overrides (CI / bench knobs)
+    size_kw = {}
+    for k in ("n_train", "n_test", "image_size"):
+        v = getattr(args, k, None)
+        if v is not None:
+            size_kw[k] = v
+
     if dataset_name == "synthetic":
+        size_kw.pop("image_size", None)
         return synthetic.load_synthetic_federated(
             client_num=client_num, partition=partition,
-            partition_alpha=alpha, seed=seed)
+            partition_alpha=alpha, seed=seed, **size_kw)
     if dataset_name == "synthetic_images":
         return synthetic.load_synthetic_images(
             client_num=client_num, partition=partition,
-            partition_alpha=alpha, seed=seed)
+            partition_alpha=alpha, seed=seed, **size_kw)
     if dataset_name == "synthetic_sequences":
+        size_kw.pop("image_size", None)
         return synthetic.load_synthetic_sequences(
-            client_num=client_num, seed=seed)
+            client_num=client_num, seed=seed, **size_kw)
 
     if dataset_name == "mnist":
         from fedml_tpu.data.leaf import load_leaf_mnist

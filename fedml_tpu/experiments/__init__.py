@@ -1,0 +1,18 @@
+"""Experiment entry points (the reference's ``fedml_experiments/`` layer,
+SURVEY.md section 2.6).
+
+Each ``main_<algo>`` module exposes ``main(argv)`` with an
+argparse-compatible flag surface matching the reference's per-experiment
+mains (``main_fedavg.py:46-105`` and algorithm extras, section 5.6), so
+reference run commands translate 1:1:
+
+    python -m fedml_tpu.experiments.main_fedavg \
+        --model resnet56 --dataset cifar10 --client_num_in_total 10 \
+        --client_num_per_round 10 --comm_round 100 --epochs 20 \
+        --batch_size 64 --lr 0.001 --ci 0
+
+Unlike the reference there is no mpirun: "distributed" is ``--mesh N``
+(clients sharded over an N-device JAX mesh, aggregation over ICI); the
+default is the single-program simulation. ``--ci 1`` is the reference's
+fast-eval CI mode (``FedAVGAggregator.py:126-131``).
+"""

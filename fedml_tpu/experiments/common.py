@@ -1,0 +1,162 @@
+"""Shared experiment plumbing: flags, setup, and the checkpointed run loop.
+
+The canonical flag set mirrors the reference
+(``fedml_experiments/distributed/fedavg/main_fedavg.py:46-105``); TPU-native
+additions (``--mesh``, ``--run_dir``, ``--checkpoint_dir``, ``--resume``,
+``--profile_dir``) replace the GPU-placement flags
+(``--gpu_server_num/--gpu_num_per_server``), which are accepted but ignored
+so reference scripts still launch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import random
+
+import numpy as np
+
+
+def add_base_args(parser: argparse.ArgumentParser):
+    p = parser
+    p.add_argument("--model", type=str, default="lr",
+                   help="model name (models/factory.py)")
+    p.add_argument("--dataset", type=str, default="synthetic",
+                   help="dataset name (data/registry.py)")
+    p.add_argument("--data_dir", type=str, default=None)
+    p.add_argument("--partition_method", type=str, default="hetero",
+                   help="homo | hetero (LDA) | hetero-fix")
+    p.add_argument("--partition_alpha", type=float, default=0.5)
+    p.add_argument("--client_num_in_total", type=int, default=10)
+    p.add_argument("--client_num_per_round", type=int, default=10)
+    p.add_argument("--batch_size", type=int, default=32)
+    p.add_argument("--client_optimizer", type=str, default="sgd")
+    p.add_argument("--lr", type=float, default=0.03)
+    p.add_argument("--wd", type=float, default=0.0)
+    p.add_argument("--momentum", type=float, default=0.0)
+    p.add_argument("--epochs", type=int, default=1,
+                   help="local epochs per round")
+    p.add_argument("--comm_round", type=int, default=10)
+    p.add_argument("--is_mobile", type=int, default=0,
+                   help="accepted for parity; device bridge uses the MQTT "
+                        "comm backend regardless")
+    p.add_argument("--frequency_of_the_test", type=int, default=5)
+    p.add_argument("--gpu_server_num", type=int, default=1,
+                   help="ignored (no GPU placement on TPU)")
+    p.add_argument("--gpu_num_per_server", type=int, default=1,
+                   help="ignored (no GPU placement on TPU)")
+    p.add_argument("--ci", type=int, default=0)
+    p.add_argument("--seed", type=int, default=0)
+    # TPU-native controls
+    p.add_argument("--mesh", type=int, default=0,
+                   help="shard clients over an N-device mesh (0 = vmapped "
+                        "single-device simulation)")
+    p.add_argument("--run_dir", type=str, default=None,
+                   help="metrics/summary output dir (wandb-summary analog)")
+    p.add_argument("--enable_wandb", type=int, default=0)
+    p.add_argument("--checkpoint_dir", type=str, default=None)
+    p.add_argument("--save_frequency", type=int, default=10,
+                   help="checkpoint every N rounds")
+    p.add_argument("--resume", type=int, default=0,
+                   help="resume from latest checkpoint in --checkpoint_dir")
+    p.add_argument("--profile_dir", type=str, default=None,
+                   help="write a jax.profiler trace of the round loop here")
+    # synthetic-dataset size overrides (CI / bench knobs; ignored by
+    # file-backed loaders)
+    p.add_argument("--n_train", type=int, default=None)
+    p.add_argument("--n_test", type=int, default=None)
+    p.add_argument("--image_size", type=int, default=None)
+    return p
+
+
+def setup(args, run_name=None):
+    """Logging + seeds + metrics sink (reference ``main_fedavg.py:281-313``:
+    proctitle, logging format, wandb init on rank 0, fixed seeds)."""
+    from fedml_tpu.utils import MetricsLogger, init_logging
+
+    init_logging(proctitle=run_name)
+    logging.info("args = %s", vars(args))
+    random.seed(args.seed)
+    np.random.seed(args.seed)
+    logger = MetricsLogger(
+        run_dir=args.run_dir, enable_wandb=bool(args.enable_wandb),
+        run_name=run_name, config=args)
+    return logger
+
+
+def make_mesh(args):
+    if not getattr(args, "mesh", 0):
+        return None
+    import jax
+    from fedml_tpu.parallel.mesh import make_client_mesh
+    return make_client_mesh(args.mesh, devices=jax.devices()[:args.mesh])
+
+
+def load_dataset_and_model(args):
+    """Dataset switch + model factory (reference ``main_fedavg.py:108-252``)."""
+    from fedml_tpu.data.registry import load_dataset
+    from fedml_tpu.models.factory import create_model
+
+    dataset = load_dataset(args, args.dataset)
+    model = create_model(args, args.model, output_dim=dataset[7])
+    return dataset, model
+
+
+def make_spec(args, model, dataset):
+    """Task-spec selection by dataset, mirroring the reference's
+    dataset-keyed ModelTrainer choice
+    (``fedml_experiments/standalone/fedavg/main_fedavg.py:269-275``)."""
+    import jax.numpy as jnp
+    from fedml_tpu.algorithms import specs
+
+    example_x = jnp.asarray(dataset[2]["x"][:1])
+    name = args.dataset
+    if name in ("stackoverflow_nwp", "shakespeare", "fed_shakespeare",
+                "synthetic_sequences"):
+        return specs.make_seq_classification_spec(model, example_x)
+    if name == "stackoverflow_lr":
+        return specs.make_multilabel_spec(model, example_x)
+    return specs.make_classification_spec(model, example_x)
+
+
+def run_fedavg_family(api, args, logger):
+    """Checkpoint-wired wrapper around ``FedAvgAPI.train`` shared by every
+    FedAvg-family main: optional resume (restores model, server state, both
+    RNG streams, and round index in O(1)), per-N-rounds checkpoint saves,
+    and an optional profiler trace around the whole loop."""
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.utils import Checkpointer, profile_trace
+
+    ckpt = None
+    if args.checkpoint_dir:
+        ckpt = Checkpointer(args.checkpoint_dir)
+        ckpt.save_config(args)
+        if args.resume:
+            saved = ckpt.restore()
+            if saved is not None:
+                api.global_state = jax.tree.map(jnp.asarray,
+                                                saved["global_state"])
+                api.server_state = saved["server_state"]
+                if saved["rng"] is not None:
+                    api.rng = jnp.asarray(saved["rng"], dtype=jnp.uint32)
+                if saved["data_rng"] is not None:
+                    api._data_rng = saved["data_rng"]
+                api.round_idx = saved["round_idx"]
+                logging.info("resumed from round %d", api.round_idx)
+
+    def on_round(api_, metrics):
+        last = api_.round_idx == args.comm_round
+        if ckpt is not None and (api_.round_idx % args.save_frequency == 0
+                                 or last):
+            ckpt.save(api_.round_idx, api_.global_state,
+                      server_state=api_.server_state, rng=api_.rng,
+                      metric=metrics.get("Test/Acc"),
+                      data_rng=api_._data_rng)
+
+    with profile_trace(args.profile_dir, enabled=args.profile_dir is not None):
+        api.train(on_round=on_round)
+    if ckpt is not None:
+        ckpt.close()
+    return api.global_state

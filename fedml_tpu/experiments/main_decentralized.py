@@ -1,0 +1,45 @@
+"""Decentralized FL experiment main (reference
+``fedml_experiments/distributed/decentralized_demo/`` +
+``standalone/decentralized/``; topology-weighted gossip averaging per
+``fedml_core/distributed/topology/`` with DSGD / PushSum clients).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from fedml_tpu.experiments import common
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("DecentralizedFL-TPU")
+    common.add_base_args(parser)
+    parser.add_argument("--algorithm", type=str, default="dsgd",
+                        choices=["dsgd", "pushsum"])
+    parser.add_argument("--topology_neighbors", type=int, default=2)
+    parser.add_argument("--asymmetric", type=int, default=0,
+                        help="1 = directed topology (random edge deletion)")
+    args = parser.parse_args(argv)
+
+    logger = common.setup(args, run_name=f"Decentralized-{args.algorithm}")
+    dataset, model = common.load_dataset_and_model(args)
+    spec = common.make_spec(args, model, dataset)
+
+    from fedml_tpu.core.topology import (
+        AsymmetricTopologyManager, SymmetricTopologyManager)
+    n = len(dataset[5])
+    cls = AsymmetricTopologyManager if args.asymmetric else \
+        SymmetricTopologyManager
+    topology = cls(n, neighbor_num=args.topology_neighbors, seed=args.seed)
+    topology.generate_topology()
+
+    from fedml_tpu.algorithms.decentralized import DecentralizedFedAPI
+    api = DecentralizedFedAPI(dataset, spec, args, topology=topology,
+                              algorithm=args.algorithm, metrics_logger=logger)
+    states = api.train()
+    logger.close()
+    return api, states
+
+
+if __name__ == "__main__":
+    main()

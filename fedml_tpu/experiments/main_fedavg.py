@@ -1,0 +1,34 @@
+"""FedAvg experiment main (reference
+``fedml_experiments/distributed/fedavg/main_fedavg.py`` and
+``fedml_experiments/standalone/fedavg/main_fedavg.py`` -- one entry serves
+both paradigms: ``--mesh 0`` is the standalone simulation, ``--mesh N``
+shards clients over an N-device mesh).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from fedml_tpu.experiments import common
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("FedAvg-TPU")
+    common.add_base_args(parser)
+    args = parser.parse_args(argv)
+
+    logger = common.setup(args, run_name=f"FedAVG-r{args.comm_round}"
+                                         f"-e{args.epochs}-lr{args.lr}")
+    dataset, model = common.load_dataset_and_model(args)
+    spec = common.make_spec(args, model, dataset)
+
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    api = FedAvgAPI(dataset, spec, args, mesh=common.make_mesh(args),
+                    metrics_logger=logger)
+    state = common.run_fedavg_family(api, args, logger)
+    logger.close()
+    return api, state
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""Robust FedAvg experiment main (reference
+``fedml_experiments/distributed/fedavg_robust/main_fedavg_robust.py``;
+attack flags at ``:56-83``, defenses norm-clip + weak DP at
+``robust_aggregation.py:32-55``).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from fedml_tpu.experiments import common
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("FedAvgRobust-TPU")
+    common.add_base_args(parser)
+    # defense knobs (FedAvgRobustAggregator.py:10-11)
+    parser.add_argument("--norm_bound", type=float, default=30.0)
+    parser.add_argument("--stddev", type=float, default=0.025,
+                        help="weak-DP Gaussian noise std")
+    # threat-model knobs (main_fedavg_robust.py:56-83)
+    parser.add_argument("--poison_type", type=str, default="trigger",
+                        help="trigger backdoor pattern family")
+    parser.add_argument("--poison_frac", type=float, default=0.5)
+    parser.add_argument("--target_label", type=int, default=0)
+    parser.add_argument("--adversary_num", type=int, default=1)
+    args = parser.parse_args(argv)
+
+    logger = common.setup(args, run_name="FedAvgRobust")
+    dataset, model = common.load_dataset_and_model(args)
+    spec = common.make_spec(args, model, dataset)
+
+    from fedml_tpu.data.poison import poison_federated_dataset
+    dataset, poisoned_test = poison_federated_dataset(
+        dataset, adversary_clients=list(range(args.adversary_num)),
+        poison_frac=args.poison_frac, target_label=args.target_label,
+        seed=args.seed)
+
+    from fedml_tpu.algorithms.fedavg_robust import FedAvgRobustAPI
+    api = FedAvgRobustAPI(dataset, spec, args, mesh=common.make_mesh(args),
+                          metrics_logger=logger,
+                          poisoned_test_data=poisoned_test)
+    state = common.run_fedavg_family(api, args, logger)
+    backdoor = api.evaluate_backdoor()
+    logger(backdoor)
+    logger.close()
+    return api, state
+
+
+if __name__ == "__main__":
+    main()

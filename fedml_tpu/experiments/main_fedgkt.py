@@ -1,0 +1,43 @@
+"""FedGKT experiment main (reference
+``fedml_experiments/distributed/fedgkt/main_fedgkt.py``; client/server model
+pair flags at ``:37-43``, distillation knobs per ``GKTServerTrainer.py:48-49``).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from fedml_tpu.experiments import common
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("FedGKT-TPU")
+    common.add_base_args(parser)
+    parser.add_argument("--client_model", type=str, default="resnet5_56",
+                        choices=["resnet5_56", "resnet8_56"])
+    parser.add_argument("--server_blocks", type=int, default=9,
+                        help="blocks per server stage (9 -> ResNet-56 tail)")
+    parser.add_argument("--temperature", type=float, default=3.0)
+    parser.add_argument("--alpha_distill", type=float, default=1.0)
+    parser.add_argument("--server_epochs", type=int, default=1)
+    args = parser.parse_args(argv)
+
+    logger = common.setup(args, run_name="FedGKT")
+    from fedml_tpu.data.registry import load_dataset
+    from fedml_tpu.models import gkt
+
+    dataset = load_dataset(args, args.dataset)
+    client_model = getattr(gkt, args.client_model)(class_num=dataset[7])
+    server_model = gkt.GKTServerResNet(n=args.server_blocks,
+                                       num_classes=dataset[7])
+
+    from fedml_tpu.algorithms.fedgkt import FedGKTAPI
+    api = FedGKTAPI(dataset, client_model, server_model, args,
+                    metrics_logger=logger)
+    api.train()
+    logger.close()
+    return api, api.server_state
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""FedNAS experiment main (reference
+``fedml_experiments/distributed/fednas/main_fednas.py``; DARTS flags at
+``:44-99``; two stages: ``--stage search`` (bilevel architecture search)
+then ``--stage train`` (evaluate the derived genotype with federated
+training of the discrete network).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from fedml_tpu.experiments import common
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("FedNAS-TPU")
+    common.add_base_args(parser)
+    parser.add_argument("--stage", type=str, default="search",
+                        choices=["search", "train"])
+    parser.add_argument("--init_channels", type=int, default=16)
+    parser.add_argument("--layers", type=int, default=8)
+    parser.add_argument("--steps", type=int, default=4,
+                        help="intermediate nodes per search cell")
+    parser.add_argument("--arch_order", type=int, default=2,
+                        help="1 = first-order DARTS, 2 = unrolled bilevel")
+    parser.add_argument("--arch_lr", type=float, default=3e-4)
+    parser.add_argument("--genotype", type=str, default="DARTS_V1",
+                        help="train-stage genotype name (models.darts)")
+    parser.add_argument("--drop_path_prob", type=float, default=0.0)
+    args = parser.parse_args(argv)
+
+    logger = common.setup(args, run_name=f"FedNAS-{args.stage}")
+    from fedml_tpu.data.registry import load_dataset
+    dataset = load_dataset(args, args.dataset)
+
+    if args.stage == "search":
+        from fedml_tpu.algorithms.fednas import FedNASAPI
+        from fedml_tpu.models.darts import DARTSNetwork
+        model = DARTSNetwork(C=args.init_channels, layers=args.layers,
+                             num_classes=dataset[7], steps=args.steps)
+        api = FedNASAPI(dataset, args, model=model, metrics_logger=logger)
+        genotype = api.train()
+        logger({"genotype": str(genotype)})
+        logger.close()
+        return api, genotype
+
+    # train stage: federated training of the discrete network
+    import jax.numpy as jnp
+    from fedml_tpu.models import darts
+    from fedml_tpu.algorithms.specs import make_classification_spec
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+
+    genotype = getattr(darts, args.genotype)
+    model = darts.DARTSFixedNetwork(
+        genotype=genotype, C=args.init_channels, layers=args.layers,
+        num_classes=dataset[7], drop_path_prob=args.drop_path_prob)
+    spec = make_classification_spec(
+        model, jnp.asarray(dataset[2]["x"][:1]), name="fednas_train")
+    api = FedAvgAPI(dataset, spec, args, mesh=common.make_mesh(args),
+                    metrics_logger=logger)
+    state = common.run_fedavg_family(api, args, logger)
+    logger.close()
+    return api, state
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""FedNova experiment main (reference
+``fedml_experiments/standalone/fednova/``; normalized averaging per
+``fednova_trainer.py:97-109``).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from fedml_tpu.experiments import common
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("FedNova-TPU")
+    common.add_base_args(parser)
+    args = parser.parse_args(argv)
+
+    logger = common.setup(args, run_name="FedNova")
+    dataset, model = common.load_dataset_and_model(args)
+    spec = common.make_spec(args, model, dataset)
+
+    from fedml_tpu.algorithms.fednova import FedNovaAPI
+    api = FedNovaAPI(dataset, spec, args, mesh=common.make_mesh(args),
+                     metrics_logger=logger)
+    state = common.run_fedavg_family(api, args, logger)
+    logger.close()
+    return api, state
+
+
+if __name__ == "__main__":
+    main()

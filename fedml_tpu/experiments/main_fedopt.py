@@ -1,0 +1,35 @@
+"""FedOpt experiment main (reference
+``fedml_experiments/distributed/fedopt/main_fedopt.py``; server-optimizer
+flags at ``:54,60``).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from fedml_tpu.experiments import common
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("FedOpt-TPU")
+    common.add_base_args(parser)
+    parser.add_argument("--server_optimizer", type=str, default="sgd",
+                        help="sgd (FedAvgM) | adam (FedAdam) | adagrad | yogi")
+    parser.add_argument("--server_lr", type=float, default=0.1)
+    parser.add_argument("--server_momentum", type=float, default=0.9)
+    args = parser.parse_args(argv)
+
+    logger = common.setup(args, run_name=f"FedOpt-{args.server_optimizer}")
+    dataset, model = common.load_dataset_and_model(args)
+    spec = common.make_spec(args, model, dataset)
+
+    from fedml_tpu.algorithms.fedopt import FedOptAPI
+    api = FedOptAPI(dataset, spec, args, mesh=common.make_mesh(args),
+                    metrics_logger=logger)
+    state = common.run_fedavg_family(api, args, logger)
+    logger.close()
+    return api, state
+
+
+if __name__ == "__main__":
+    main()

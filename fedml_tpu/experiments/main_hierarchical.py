@@ -1,0 +1,35 @@
+"""Hierarchical FL experiment main (reference
+``fedml_experiments/standalone/hierarchical_fl/``; client->group->global
+two-tier averaging per ``group.py:24-46``).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from fedml_tpu.experiments import common
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("HierarchicalFL-TPU")
+    common.add_base_args(parser)
+    parser.add_argument("--group_num", type=int, default=2)
+    parser.add_argument("--group_comm_round", type=int, default=2,
+                        help="intra-group rounds per global round")
+    args = parser.parse_args(argv)
+
+    logger = common.setup(args, run_name="HierFL")
+    dataset, model = common.load_dataset_and_model(args)
+    spec = common.make_spec(args, model, dataset)
+
+    from fedml_tpu.algorithms.hierarchical import HierarchicalFedAvgAPI
+    api = HierarchicalFedAvgAPI(dataset, spec, args,
+                                mesh=common.make_mesh(args),
+                                metrics_logger=logger)
+    state = common.run_fedavg_family(api, args, logger)
+    logger.close()
+    return api, state
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""SplitNN experiment main (reference
+``fedml_experiments/distributed/split_nn/``; the model is cut into a
+client half producing activations and a server half producing logits,
+exchanged per batch -- ``split_nn/client_manager.py:35-70``,
+``server.py:40-60``).
+
+The default split pair is a conv stem (client) + dense head (server) for
+image datasets; ``--cut dense`` uses a dense stem for flat features.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import flax.linen as nn
+
+from fedml_tpu.experiments import common
+
+
+class ConvStem(nn.Module):
+    """Client half: feature extractor up to the cut layer."""
+    width: int = 32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.Conv(self.width, (3, 3), strides=2)(x))
+        x = nn.relu(nn.Conv(self.width * 2, (3, 3), strides=2)(x))
+        return x.reshape((x.shape[0], -1))
+
+
+class DenseStem(nn.Module):
+    width: int = 64
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.relu(nn.Dense(self.width)(x.reshape((x.shape[0], -1))))
+
+
+class DenseHead(nn.Module):
+    """Server half: activations -> logits."""
+    classes: int = 10
+    width: int = 128
+
+    @nn.compact
+    def __call__(self, acts):
+        return nn.Dense(self.classes)(nn.relu(nn.Dense(self.width)(acts)))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("SplitNN-TPU")
+    common.add_base_args(parser)
+    parser.add_argument("--cut", type=str, default="conv",
+                        choices=["conv", "dense"])
+    args = parser.parse_args(argv)
+
+    logger = common.setup(args, run_name="SplitNN")
+    from fedml_tpu.data.registry import load_dataset
+    dataset = load_dataset(args, args.dataset)
+    stem = ConvStem() if args.cut == "conv" else DenseStem()
+    head = DenseHead(classes=dataset[7])
+
+    from fedml_tpu.algorithms.splitnn import SplitNNAPI
+    api = SplitNNAPI(dataset, stem, head, args, metrics_logger=logger)
+    api.train()
+    logger.close()
+    return api, api.server_params
+
+
+if __name__ == "__main__":
+    main()

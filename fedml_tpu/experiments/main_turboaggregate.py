@@ -1,0 +1,34 @@
+"""TurboAggregate experiment main (reference
+``fedml_experiments/distributed/turboaggregate/``; secure-aggregation
+primitives per ``mpc_function.py:4-75``, plain weighted aggregate at
+``TA_Aggregator.py:56-85``).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from fedml_tpu.experiments import common
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("TurboAggregate-TPU")
+    common.add_base_args(parser)
+    parser.add_argument("--secure", type=int, default=1,
+                        help="1 = mask client payloads (additive secret "
+                             "sharing) before aggregation")
+    args = parser.parse_args(argv)
+
+    logger = common.setup(args, run_name="TurboAggregate")
+    dataset, model = common.load_dataset_and_model(args)
+    spec = common.make_spec(args, model, dataset)
+
+    from fedml_tpu.algorithms.turboaggregate import TurboAggregateAPI
+    api = TurboAggregateAPI(dataset, spec, args, metrics_logger=logger)
+    state = common.run_fedavg_family(api, args, logger)
+    logger.close()
+    return api, state
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Vertical FL experiment main (reference
+``fedml_experiments/distributed/classical_vertical_fl/`` and
+``standalone/classical_vertical_fl/``; guest/host protocol per
+``guest_trainer.py:59-80``).
+
+Features are split column-wise across ``--party_num`` parties (party 0 =
+guest holds the labels), matching the reference's lending-club / NUS-WIDE
+feature partition shape.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from fedml_tpu.experiments import common
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("VerticalFL-TPU")
+    common.add_base_args(parser)
+    parser.add_argument("--party_num", type=int, default=2)
+    parser.add_argument("--hidden_dim", type=int, default=16)
+    args = parser.parse_args(argv)
+
+    logger = common.setup(args, run_name="VFL")
+    from fedml_tpu.data.registry import load_dataset
+    from fedml_tpu.models.linear import LocalModel
+
+    dataset = load_dataset(args, args.dataset)
+    x_train = np.asarray(dataset[2]["x"], np.float32)
+    x_train = x_train.reshape((x_train.shape[0], -1))
+    y_train = (np.asarray(dataset[2]["y"]) % 2).astype(np.float32)
+    x_test = np.asarray(dataset[3]["x"], np.float32)
+    x_test = x_test.reshape((x_test.shape[0], -1))
+    y_test = (np.asarray(dataset[3]["y"]) % 2).astype(np.float32)
+
+    splits = np.array_split(np.arange(x_train.shape[1]), args.party_num)
+    party_data = [x_train[:, s] for s in splits]
+    test_party_data = [x_test[:, s] for s in splits]
+    party_models = [LocalModel(hidden_dims=(args.hidden_dim,), output_dim=1)
+                    for _ in range(args.party_num)]
+
+    from fedml_tpu.algorithms.vertical import VerticalFLAPI
+    api = VerticalFLAPI(party_models, party_data, y_train, args,
+                        test_party_data=test_party_data, test_labels=y_test)
+    history = api.fit()
+    for record in history:
+        logger(record)
+    logger.close()
+    return api, history
+
+
+if __name__ == "__main__":
+    main()

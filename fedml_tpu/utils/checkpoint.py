@@ -45,14 +45,21 @@ class Checkpointer:
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
 
     def save(self, round_idx: int, global_state, server_state=(),
-             rng=None, metric: Optional[float] = None) -> bool:
-        """Checkpoint one round. Returns True if orbax kept it."""
+             rng=None, metric: Optional[float] = None,
+             data_rng=None) -> bool:
+        """Checkpoint one round. Returns True if orbax kept it.
+
+        ``data_rng`` is the host-side ``np.random.Generator`` feeding batch
+        shuffles; its bit-generator state rides along so resume restores the
+        data stream in O(1) with no cohort replay."""
         payload = {
             "global_state": global_state,
             "server_state": _pack_aux(server_state),
             "rng": rng if rng is not None else jax.random.PRNGKey(0),
             "has_rng": np.asarray(rng is not None),
             "round_idx": np.asarray(round_idx),
+            "data_rng_state": _encode_json(
+                data_rng.bit_generator.state if data_rng is not None else None),
         }
         metrics = {"metric": float(metric)} if metric is not None else None
         saved = self._mgr.save(
@@ -72,12 +79,18 @@ class Checkpointer:
             return None
         payload = self._mgr.restore(step)
         has_rng = bool(np.asarray(payload.get("has_rng", True)))
+        rng_state = _decode_json(payload.get("data_rng_state"))
+        data_rng = None
+        if rng_state is not None:
+            data_rng = np.random.default_rng()
+            data_rng.bit_generator.state = rng_state
         return {
             "global_state": payload["global_state"],
             "server_state": _unpack_aux(payload["server_state"]),
             "rng": (jax.numpy.asarray(payload["rng"], dtype=jax.numpy.uint32)
                     if has_rng else None),
             "round_idx": int(np.asarray(payload["round_idx"])),
+            "data_rng": data_rng,
         }
 
     def latest_round(self) -> Optional[int]:
@@ -90,10 +103,12 @@ class Checkpointer:
 
     def save_config(self, args) -> None:
         """Config snapshot -- the ``parameters.txt`` of Saver
-        (``fedseg/utils.py:206-224``), as JSON."""
+        (``fedseg/utils.py:206-224``), as JSON (same codec as the
+        MetricsLogger's config.json so the two snapshots agree)."""
+        from fedml_tpu.utils.metrics import _jsonable
         d = vars(args) if hasattr(args, "__dict__") else dict(args)
         with open(os.path.join(self.directory, "parameters.json"), "w") as f:
-            json.dump({k: _jsonable(v) for k, v in d.items()}, f, indent=2)
+            json.dump(_jsonable(d), f, indent=2)
 
     def _update_best(self, round_idx, metric):
         """``best_pred.txt`` tracking across runs (``fedseg/utils.py:189-204``)."""
@@ -137,12 +152,16 @@ def _treedef_bytes(treedef):
     return pickle.dumps(treedef)
 
 
-def _jsonable(v):
-    if isinstance(v, (int, float, str, bool, type(None))):
-        return v
-    if hasattr(v, "tolist"):
-        return v.tolist()
-    return str(v)
+def _encode_json(obj) -> np.ndarray:
+    """JSON-able object -> uint8 array (orbax leaves must be arrays; RNG
+    bit-generator states contain 128-bit ints that need a text codec)."""
+    return np.frombuffer(json.dumps(obj).encode(), dtype=np.uint8).copy()
+
+
+def _decode_json(arr):
+    if arr is None:
+        return None
+    return json.loads(np.asarray(arr, dtype=np.uint8).tobytes().decode())
 
 
 __all__ = ["Checkpointer"]

@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Positional launch wrapper, signature-compatible with the reference's
+# run_fedavg_distributed_pytorch.sh:18-38 (mpirun replaced by a single
+# SPMD process; WORKER_NUM becomes the client-mesh size).
+#
+# sh run_fedavg.sh CLIENT_NUM WORKER_NUM MODEL DISTRIBUTION ROUND EPOCH \
+#                  BATCH_SIZE LR DATASET DATA_DIR CLIENT_OPTIMIZER CI
+
+CLIENT_NUM=${1:-10}
+WORKER_NUM=${2:-0}
+MODEL=${3:-resnet56}
+DISTRIBUTION=${4:-hetero}
+ROUND=${5:-100}
+EPOCH=${6:-20}
+BATCH_SIZE=${7:-64}
+LR=${8:-0.001}
+DATASET=${9:-cifar10}
+DATA_DIR=${10:-./data}
+CLIENT_OPTIMIZER=${11:-sgd}
+CI=${12:-0}
+
+python3 -m fedml_tpu.experiments.main_fedavg \
+  --client_num_in_total "$CLIENT_NUM" \
+  --client_num_per_round "$CLIENT_NUM" \
+  --mesh "$WORKER_NUM" \
+  --model "$MODEL" \
+  --partition_method "$DISTRIBUTION" \
+  --comm_round "$ROUND" \
+  --epochs "$EPOCH" \
+  --batch_size "$BATCH_SIZE" \
+  --lr "$LR" \
+  --dataset "$DATASET" \
+  --data_dir "$DATA_DIR" \
+  --client_optimizer "$CLIENT_OPTIMIZER" \
+  --ci "$CI"

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# FedNAS launch wrapper (reference run_fednas_search.sh). Stage is
+# "search" or "train".
+#
+# sh run_fednas.sh STAGE CLIENT_NUM ROUND EPOCH DATASET DATA_DIR
+
+STAGE=${1:-search}
+CLIENT_NUM=${2:-4}
+ROUND=${3:-50}
+EPOCH=${4:-5}
+DATASET=${5:-cifar10}
+DATA_DIR=${6:-./data}
+
+python3 -m fedml_tpu.experiments.main_fednas \
+  --stage "$STAGE" \
+  --client_num_in_total "$CLIENT_NUM" \
+  --client_num_per_round "$CLIENT_NUM" \
+  --comm_round "$ROUND" \
+  --epochs "$EPOCH" \
+  --dataset "$DATASET" \
+  --data_dir "$DATA_DIR"

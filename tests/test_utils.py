@@ -154,7 +154,7 @@ def test_checkpoint_resume_continues_training(tmp_path):
     ckpt = Checkpointer(str(tmp_path / "ckpt"))
     part = run(2)
     ckpt.save(part.round_idx, part.global_state, server_state=part.server_state,
-              rng=part.rng)
+              rng=part.rng, data_rng=part._data_rng)
     del part
 
     resumed = FedAvgAPI(dataset, spec, args)
@@ -163,11 +163,9 @@ def test_checkpoint_resume_continues_training(tmp_path):
     resumed.server_state = saved["server_state"]
     resumed.rng = jnp.asarray(saved["rng"], dtype=jnp.uint32)
     resumed.round_idx = saved["round_idx"]
-    # the host-side data stream must be re-advanced to the same point by
-    # replaying the consumed cohorts (deterministic: same seed, same rounds)
-    resumed._data_rng = np.random.default_rng(0)
-    for r in range(saved["round_idx"]):
-        resumed._cohort(r)
+    # host-side data stream restores in O(1) from the serialized
+    # bit-generator state -- no cohort replay
+    resumed._data_rng = saved["data_rng"]
     run(2, resumed)
     ckpt.close()
 
