@@ -1,0 +1,90 @@
+"""Centralized (non-FL) baseline trainer over the pooled dataset.
+
+Reference: ``fedml_api/centralized/centralized_trainer.py:9-60`` -- the
+baseline used by the CI equivalence checks: with full batch and one local
+epoch, FedAvg over all clients must match centralized training to 3
+decimals (``CI-script-fedavg.sh:42-47``). Implemented as a single "client"
+running the same jitted local-update program as FedAvg, so the equivalence
+is an algebraic identity of the shared engine, not a coincidence.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import jax
+import numpy as np
+
+from fedml_tpu.core.trainer import TrainSpec
+from fedml_tpu.parallel.engine import (
+    ClientUpdateConfig, make_client_update, make_eval_fn)
+from fedml_tpu.parallel.packing import pack_cohort, pack_eval
+
+
+class CentralizedTrainer:
+    """Epoch-loop trainer on the pooled (global) dataset.
+
+    Args mirror the FL APIs; ``epochs`` acts per ``train()`` call and
+    ``comm_round`` is the number of such calls so run lengths are directly
+    comparable to federated runs.
+    """
+
+    def __init__(self, dataset, spec: TrainSpec, args, metrics_logger=None):
+        (self.train_data_num, self.test_data_num, self.train_data_global,
+         self.test_data_global, _, _, _, self.class_num) = dataset
+        self.spec = spec
+        self.args = args
+        self.metrics_logger = metrics_logger or (lambda d: logging.info("%s", d))
+        cfg = ClientUpdateConfig(
+            optimizer=getattr(args, "client_optimizer", "sgd"),
+            lr=args.lr,
+            weight_decay=getattr(args, "wd", 0.0),
+            momentum=getattr(args, "momentum", 0.0))
+        self._update = jax.jit(make_client_update(spec, cfg))
+        self.eval_fn = make_eval_fn(spec)
+
+        seed = getattr(args, "seed", 0)
+        self.rng = jax.random.PRNGKey(seed)
+        self.global_state = spec.init_fn(jax.random.fold_in(self.rng, 0))
+        self._data_rng = np.random.default_rng(seed)
+        self.round_idx = 0
+        self.history = []
+
+    def train_one_round(self):
+        """One "round" = ``args.epochs`` epochs over the pooled data through
+        the same client-update program FedAvg uses."""
+        t0 = time.time()
+        packed = pack_cohort([self.train_data_global], self.args.batch_size,
+                             self.args.epochs, rng=self._data_rng)
+        one = jax.tree.map(lambda a: a[0], packed)
+        self.rng, rng = jax.random.split(self.rng)
+        new_state, _, metrics = self._update(self.global_state, one, rng)
+        jax.block_until_ready(new_state)
+        self.global_state = new_state
+        m = jax.tree.map(np.asarray, metrics)
+        out = {"round": self.round_idx,
+               "Train/Loss": float(m["loss_sum"].sum() / max(m["count"].sum(), 1)),
+               "Train/Acc": float(m["correct"].sum() / max(m["count"].sum(), 1)),
+               "round_time_s": time.time() - t0}
+        self.round_idx += 1
+        return out
+
+    def evaluate_global(self):
+        packed = pack_eval(self.test_data_global, self.args.batch_size)
+        m = jax.tree.map(np.asarray, self.eval_fn(self.global_state, packed))
+        return {"Test/Loss": float(m["loss_sum"] / max(m["count"], 1)),
+                "Test/Acc": float(m["correct"] / max(m["count"], 1))}
+
+    def train(self, on_round=None):
+        freq = getattr(self.args, "frequency_of_the_test", 5)
+        while self.round_idx < self.args.comm_round:
+            metrics = self.train_one_round()
+            last = self.round_idx == self.args.comm_round
+            if self.round_idx % freq == 0 or last:
+                metrics.update(self.evaluate_global())
+            self.metrics_logger(metrics)
+            self.history.append(metrics)
+            if on_round is not None:
+                on_round(self, metrics)
+        return self.global_state

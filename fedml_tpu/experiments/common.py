@@ -110,7 +110,13 @@ def make_spec(args, model, dataset):
     import jax.numpy as jnp
     from fedml_tpu.algorithms import specs
 
-    example_x = jnp.asarray(dataset[2]["x"][:1])
+    global_train = dataset[2]
+    if global_train is None or "x" not in global_train:
+        # loaders that keep data client-resident (e.g. Landmarks) carry no
+        # pooled train set; any client shard supplies the example shapes
+        global_train = next(d for d in dataset[5].values()
+                            if d is not None and len(d["y"]))
+    example_x = jnp.asarray(global_train["x"][:1])
     name = args.dataset
     if name in ("stackoverflow_nwp", "shakespeare", "fed_shakespeare",
                 "synthetic_sequences"):

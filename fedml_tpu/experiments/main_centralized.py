@@ -1,0 +1,31 @@
+"""Centralized baseline experiment main (reference
+``fedml_experiments/centralized/main.py`` ->
+``fedml_api/centralized/centralized_trainer.py:9-60``): non-FL training on
+the pooled dataset, for equivalence checks against federated runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from fedml_tpu.experiments import common
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("Centralized-TPU")
+    common.add_base_args(parser)
+    args = parser.parse_args(argv)
+
+    logger = common.setup(args, run_name="Centralized")
+    dataset, model = common.load_dataset_and_model(args)
+    spec = common.make_spec(args, model, dataset)
+
+    from fedml_tpu.algorithms.centralized import CentralizedTrainer
+    trainer = CentralizedTrainer(dataset, spec, args, metrics_logger=logger)
+    state = trainer.train()
+    logger.close()
+    return trainer, state
+
+
+if __name__ == "__main__":
+    main()

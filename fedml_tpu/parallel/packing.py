@@ -51,6 +51,10 @@ def pack_cohort(client_datasets, batch_size, epochs, rng=None, drop_last=False,
     """
     rng = rng or np.random.default_rng(0)
     C = len(client_datasets)
+    if batch_size in (-1, 0):
+        # reference full-batch convention (CI equivalence runs wire
+        # ``--batch_size -1`` through the run script, CI-script-fedavg.sh:42)
+        batch_size = max(1, max(len(d["y"]) for d in client_datasets))
     steps = [_steps_for(len(d["y"]), batch_size, epochs, drop_last)
              for d in client_datasets]
     S = max(steps)
@@ -94,6 +98,8 @@ def pack_eval(data, batch_size, pad_multiple=1):
     """Pack a flat eval set into ``[S, B]`` masked batches."""
     x, y = np.asarray(data["x"]), np.asarray(data["y"])
     n = len(y)
+    if batch_size in (-1, 0):
+        batch_size = max(1, n)
     S = max(1, math.ceil(n / batch_size))
     S = int(math.ceil(S / pad_multiple) * pad_multiple)
     xs = np.zeros((S, batch_size) + x.shape[1:], x.dtype)
