@@ -1,0 +1,154 @@
+"""Directory-tree image datasets: ImageNet (ILSVRC) and Google Landmarks
+(gld23k / gld160k).
+
+Parity: reference ``fedml_api/data_preprocessing/ImageNet/data_loader.py``
+(ImageFolder layout, LDA or homo partition over the pooled index) and
+``Landmarks/data_loader.py`` (CSV-mapped federated split: a
+``data_user_dict`` csv assigns each image to a natural client). Decoding
+uses PIL on the host; arrays are NHWC float32 in [0,1] normalized by
+ImageNet statistics.
+
+Both loaders return the 8-tuple contract. For pod-scale runs set
+``materialize=False`` to get per-client *manifests* (paths + labels)
+instead of in-memory arrays, and stream shards to device with
+``materialize_shard`` -- the full ILSVRC train set does not fit in host
+RAM (SURVEY.md section 7 "Hard parts" #2: async host staging).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+
+import numpy as np
+
+from fedml_tpu.core.partition import (
+    homo_partition, non_iid_partition_with_dirichlet_distribution)
+
+IMAGENET_MEAN = np.asarray([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.asarray([0.229, 0.224, 0.225], np.float32)
+
+
+def _decode(path, image_size):
+    from PIL import Image
+    with Image.open(path) as im:
+        im = im.convert("RGB").resize((image_size, image_size))
+        x = np.asarray(im, np.float32) / 255.0
+    return (x - IMAGENET_MEAN) / IMAGENET_STD
+
+
+def _scan_imagefolder(split_dir):
+    """ImageFolder layout: ``<split>/<class_name>/<img>``; classes sorted."""
+    classes = sorted(d for d in os.listdir(split_dir)
+                     if os.path.isdir(os.path.join(split_dir, d)))
+    paths, labels = [], []
+    for ci, cname in enumerate(classes):
+        cdir = os.path.join(split_dir, cname)
+        for name in sorted(os.listdir(cdir)):
+            paths.append(os.path.join(cdir, name))
+            labels.append(ci)
+    return paths, np.asarray(labels, np.int64), classes
+
+
+def materialize_shard(manifest, image_size=224):
+    """Decode one client's manifest ``{"paths", "y"}`` into arrays."""
+    x = np.stack([_decode(p, image_size) for p in manifest["paths"]]) \
+        if len(manifest["paths"]) else np.zeros(
+            (0, image_size, image_size, 3), np.float32)
+    return {"x": x, "y": np.asarray(manifest["y"], np.int64)}
+
+
+def load_imagenet_federated(data_dir, client_num=10, partition="hetero",
+                            partition_alpha=0.5, image_size=224,
+                            materialize=True, seed=0):
+    """ImageNet with LDA partitioning (reference
+    ``ImageNet/data_loader.py``): expects ``train/`` and ``val/`` in
+    ImageFolder layout."""
+    train_dir, val_dir = (os.path.join(data_dir, s) for s in ("train", "val"))
+    if not (os.path.isdir(train_dir) and os.path.isdir(val_dir)):
+        raise FileNotFoundError(
+            f"expected ImageFolder layout {data_dir}/{{train,val}}/<class>/; "
+            f"fetch ILSVRC (reference data/ImageNet/) first")
+    paths, y, classes = _scan_imagefolder(train_dir)
+    test_paths, y_test, _ = _scan_imagefolder(val_dir)
+    class_num = len(classes)
+
+    if partition == "homo":
+        parts = homo_partition(len(y), client_num, seed)
+    else:
+        parts = non_iid_partition_with_dirichlet_distribution(
+            y, client_num, class_num, partition_alpha, seed=seed)
+    test_parts = homo_partition(len(y_test), client_num, seed + 1)
+
+    def shard(idx, src_paths, src_y):
+        m = {"paths": [src_paths[i] for i in idx], "y": src_y[idx]}
+        return materialize_shard(m, image_size) if materialize else m
+
+    train_local = {c: shard(parts[c], paths, y) for c in range(client_num)}
+    test_local = {c: shard(test_parts[c], test_paths, y_test)
+                  for c in range(client_num)}
+    train_global = {"paths": paths, "y": y} if not materialize else \
+        materialize_shard({"paths": paths, "y": y}, image_size)
+    test_global = {"paths": test_paths, "y": y_test} if not materialize else \
+        materialize_shard({"paths": test_paths, "y": y_test}, image_size)
+    local_num = {c: len(train_local[c]["y"]) for c in range(client_num)}
+    return [len(y), len(y_test), train_global, test_global,
+            local_num, train_local, test_local, class_num]
+
+
+def _read_user_csv(path):
+    """Landmarks federated split csv: columns ``user_id,image_id,class``."""
+    users = {}
+    with open(path) as f:
+        reader = csv.DictReader(f)
+        for row in reader:
+            users.setdefault(row["user_id"], []).append(
+                (row["image_id"], int(row["class"])))
+    return users
+
+
+def load_landmarks_federated(data_dir, split="gld23k", image_size=224,
+                             materialize=True, client_num=None, seed=0):
+    """Google Landmarks with the natural per-photographer client keying
+    (reference ``Landmarks/data_loader.py``): ``<split>_user_dict.csv``
+    maps images to clients; images live in ``images/<image_id>.jpg``."""
+    csv_path = os.path.join(data_dir, f"{split}_user_dict.csv")
+    img_dir = os.path.join(data_dir, "images")
+    if not os.path.isfile(csv_path):
+        raise FileNotFoundError(
+            f"{csv_path} not found; fetch the landmarks split csvs "
+            f"(reference data/gld/) first")
+    users = _read_user_csv(csv_path)
+    user_ids = sorted(users)
+    if client_num is not None:
+        user_ids = user_ids[:client_num]
+
+    all_classes = sorted({cls for u in user_ids for _, cls in users[u]})
+    remap = {c: i for i, c in enumerate(all_classes)}
+
+    def shard(pairs):
+        m = {"paths": [os.path.join(img_dir, f"{img}.jpg")
+                       for img, _ in pairs],
+             "y": np.asarray([remap[c] for _, c in pairs], np.int64)}
+        return materialize_shard(m, image_size) if materialize else m
+
+    # Landmarks ships a central test csv; fall back to holding out the tail
+    # slice of each client (removed from that client's train shard)
+    test_csv = os.path.join(data_dir, f"{split}_test.csv")
+    train_pairs = {u: users[u] for u in user_ids}
+    if os.path.isfile(test_csv):
+        pairs = [(img, int(c)) for u, items in _read_user_csv(test_csv).items()
+                 for img, c in items]
+        pairs = [(img, c) for img, c in pairs if c in remap]
+        test_global = shard(pairs)
+    else:
+        k = max(1, min(len(users[u]) for u in user_ids) // 5)
+        test_global = shard([p for u in user_ids for p in users[u][-k:]])
+        train_pairs = {u: users[u][:-k] for u in user_ids}
+    train_local = {i: shard(train_pairs[u]) for i, u in enumerate(user_ids)}
+    test_local = {i: None for i in range(len(user_ids))}
+    local_num = {i: len(train_local[i]["y"]) for i in range(len(user_ids))}
+    n_train = sum(local_num.values())
+    train_global = None  # pooled decode is wasteful; clients carry the data
+    return [n_train, len(test_global["y"]), train_global, test_global,
+            local_num, train_local, test_local, len(all_classes)]

@@ -59,4 +59,16 @@ def load_dataset(args, dataset_name):
         from fedml_tpu.data.stackoverflow import load_stackoverflow
         return load_stackoverflow(data_dir, task=dataset_name.split("_")[1],
                                   client_num=client_num)
+    if dataset_name in ("imagenet", "ILSVRC2012"):
+        from fedml_tpu.data.imagefolder import load_imagenet_federated
+        return load_imagenet_federated(
+            data_dir, client_num=client_num, partition=partition,
+            partition_alpha=alpha,
+            image_size=getattr(args, "image_size", None) or 224, seed=seed)
+    if dataset_name in ("gld23k", "gld160k"):
+        from fedml_tpu.data.imagefolder import load_landmarks_federated
+        return load_landmarks_federated(
+            data_dir, split=dataset_name,
+            image_size=getattr(args, "image_size", None) or 224,
+            client_num=client_num, seed=seed)
     raise ValueError(f"unknown dataset: {dataset_name}")

@@ -17,6 +17,24 @@ import numpy as np
 from fedml_tpu.experiments import common
 
 
+def _load_vertical(args):
+    """Native vertical datasets (reference finance loaders)."""
+    from fedml_tpu.data import vertical_finance as vf
+    if args.dataset == "lending_club":
+        return vf.loan_load_two_party_data(args.data_dir) \
+            if args.party_num == 2 else \
+            vf.loan_load_three_party_data(args.data_dir)
+    if args.dataset == "nus_wide":
+        labels = ["person", "animal"]
+        xa, xb, y = vf.nus_wide_load_two_party_data(
+            args.data_dir, labels, dtype="Train")
+        xa_t, xb_t, y_t = vf.nus_wide_load_two_party_data(
+            args.data_dir, labels, dtype="Test")
+        return [xa, xb, y], [xa_t, xb_t, y_t]
+    return vf.load_synthetic_vertical(party_num=args.party_num,
+                                      seed=args.seed)
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser("VerticalFL-TPU")
     common.add_base_args(parser)
@@ -25,20 +43,26 @@ def main(argv=None):
     args = parser.parse_args(argv)
 
     logger = common.setup(args, run_name="VFL")
-    from fedml_tpu.data.registry import load_dataset
     from fedml_tpu.models.linear import LocalModel
 
-    dataset = load_dataset(args, args.dataset)
-    x_train = np.asarray(dataset[2]["x"], np.float32)
-    x_train = x_train.reshape((x_train.shape[0], -1))
-    y_train = (np.asarray(dataset[2]["y"]) % 2).astype(np.float32)
-    x_test = np.asarray(dataset[3]["x"], np.float32)
-    x_test = x_test.reshape((x_test.shape[0], -1))
-    y_test = (np.asarray(dataset[3]["y"]) % 2).astype(np.float32)
-
-    splits = np.array_split(np.arange(x_train.shape[1]), args.party_num)
-    party_data = [x_train[:, s] for s in splits]
-    test_party_data = [x_test[:, s] for s in splits]
+    if args.dataset in ("lending_club", "nus_wide", "synthetic_vertical"):
+        train, test = _load_vertical(args)
+        party_data, y_train = train[:-1], train[-1].reshape(-1)
+        test_party_data, y_test = test[:-1], test[-1].reshape(-1)
+        args.party_num = len(party_data)
+    else:
+        # any classification 8-tuple, features split column-wise
+        from fedml_tpu.data.registry import load_dataset
+        dataset = load_dataset(args, args.dataset)
+        x_train = np.asarray(dataset[2]["x"], np.float32)
+        x_train = x_train.reshape((x_train.shape[0], -1))
+        y_train = (np.asarray(dataset[2]["y"]) % 2).astype(np.float32)
+        x_test = np.asarray(dataset[3]["x"], np.float32)
+        x_test = x_test.reshape((x_test.shape[0], -1))
+        y_test = (np.asarray(dataset[3]["y"]) % 2).astype(np.float32)
+        splits = np.array_split(np.arange(x_train.shape[1]), args.party_num)
+        party_data = [x_train[:, s] for s in splits]
+        test_party_data = [x_test[:, s] for s in splits]
     party_models = [LocalModel(hidden_dims=(args.hidden_dim,), output_dim=1)
                     for _ in range(args.party_num)]
 

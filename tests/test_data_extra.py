@@ -1,0 +1,214 @@
+"""Tests for the streaming-UCI, vertical-finance, and directory-image
+loaders -- run against tiny generated fixtures (zero-egress environment),
+exercising the same parse paths real data takes."""
+
+import csv
+import os
+
+import numpy as np
+import pytest
+
+from fedml_tpu.data import uci, vertical_finance, imagefolder
+
+
+# ---------------------------------------------------------------- UCI stream
+
+class TestStreamingUCI:
+    def test_synthetic_stream_shapes_and_quota(self):
+        streams = uci.load_synthetic_stream(client_num=4, T=50, d=6)
+        assert set(streams) == {0, 1, 2, 3}
+        for d in streams.values():
+            assert d["x"].shape == (50, 6)
+            assert d["y"].shape == (50,)
+
+    def test_adversarial_split_clusters_by_feature_space(self):
+        """beta=1: every client's samples come from one k-means cluster, so
+        intra-client feature variance << global (the reference's adversarial
+        regime, read_csv_file_for_cluster)."""
+        rng = np.random.default_rng(0)
+        centers = np.asarray([[-10, 0], [10, 0], [0, 10]], np.float32)
+        x = np.concatenate([c + rng.normal(size=(60, 2)).astype(np.float32)
+                            for c in centers])
+        y = np.concatenate([np.full(60, i, np.float32) for i in range(3)])
+        perm = rng.permutation(len(y))
+        streams = uci.split_stream(x[perm], y[perm], client_num=3, beta=1.0)
+        for d in streams.values():
+            assert len(d["y"]) > 0
+            assert len(np.unique(d["y"])) == 1  # cluster == one blob
+
+    def test_stochastic_split_sequential_fill(self):
+        x = np.arange(40, dtype=np.float32).reshape(20, 2)
+        y = np.zeros(20, np.float32)
+        streams = uci.split_stream(x, y, client_num=4, beta=0.0)
+        # quota = 5 each, filled in stream order
+        assert all(len(streams[c]["y"]) == 5 for c in range(4))
+        np.testing.assert_array_equal(streams[0]["x"][:, 0],
+                                      np.arange(0, 10, 2, dtype=np.float32))
+
+    def test_susy_csv_parse(self, tmp_path):
+        path = tmp_path / "SUSY.csv"
+        rows = [[1.0] + list(np.arange(18) * 0.1), [0.0] + [2.0] * 18]
+        with open(path, "w") as f:
+            for r in rows:
+                f.write(",".join(str(v) for v in r) + "\n")
+        streams = uci.load_streaming_uci("susy", str(path), client_num=1,
+                                         sample_num_in_total=2)
+        assert streams[0]["x"].shape == (2, 18)
+        np.testing.assert_allclose(streams[0]["y"], [1.0, 0.0])
+
+    def test_room_occupancy_parse(self, tmp_path):
+        path = tmp_path / "datatraining.txt"
+        with open(path, "w") as f:
+            f.write('"id","date","Temperature","Humidity","Light","CO2","HumidityRatio","Occupancy"\n')
+            f.write('"1","2015-02-04 17:51:00",23.18,27.27,426,721.25,0.00479,1\n')
+            f.write('"2","2015-02-04 17:51:59",23.15,27.26,429,714,0.00478,0\n')
+        streams = uci.load_streaming_uci("room_occupancy", str(path),
+                                         client_num=1, sample_num_in_total=2)
+        assert streams[0]["x"].shape == (2, 5)
+        np.testing.assert_allclose(streams[0]["y"], [1.0, 0.0])
+
+    def test_missing_file_raises(self):
+        with pytest.raises(FileNotFoundError):
+            uci.load_streaming_uci("susy", "/nonexistent/SUSY.csv", 2, 10)
+
+    def test_sample_list_compat(self):
+        streams = uci.load_synthetic_stream(client_num=2, T=3, d=4)
+        lists = uci.as_sample_list(streams)
+        assert len(lists[0]) == 3
+        assert set(lists[0][0]) == {"x", "y"}
+
+
+# ------------------------------------------------------------ vertical finance
+
+class TestVerticalFinance:
+    def _loan_csv(self, tmp_path, n=50):
+        cols = (vertical_finance.QUALIFICATION_FEAT[:3] +
+                vertical_finance.LOAN_FEAT[:2] +
+                vertical_finance.DEBT_FEAT[:3] +
+                vertical_finance.REPAYMENT_FEAT[:2] +
+                vertical_finance.MULTI_ACC_FEAT[:2] +
+                vertical_finance.MAL_BEHAVIOR_FEAT[:2])
+        rng = np.random.default_rng(0)
+        path = tmp_path / "loan_processed.csv"
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(cols + ["target"])
+            for _ in range(n):
+                w.writerow(list(rng.normal(size=len(cols)).round(4)) +
+                           [int(rng.integers(0, 2))])
+        return tmp_path
+
+    def test_loan_two_party(self, tmp_path):
+        d = self._loan_csv(tmp_path)
+        train, test = vertical_finance.loan_load_two_party_data(str(d))
+        xa, xb, y = train
+        assert xa.shape == (40, 5)   # qualification+loan subset
+        assert xb.shape == (40, 9)   # debt+repayment+acc+behavior subset
+        assert y.shape == (40, 1)
+        assert test[0].shape[0] == 10
+
+    def test_loan_three_party(self, tmp_path):
+        d = self._loan_csv(tmp_path)
+        train, _ = vertical_finance.loan_load_three_party_data(str(d))
+        xa, xb, xc, y = train
+        assert xa.shape[1] == 5 and xb.shape[1] == 5 and xc.shape[1] == 4
+
+    def test_loan_missing_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            vertical_finance.loan_load_two_party_data(str(tmp_path))
+
+    def test_nus_wide_fixture(self, tmp_path):
+        n = 12
+        rng = np.random.default_rng(0)
+        lbl_dir = tmp_path / "Groundtruth" / "TrainTestLabels"
+        lbl_dir.mkdir(parents=True)
+        water = rng.integers(0, 2, n)
+        person = 1 - water
+        for name, v in [("person", person), ("water", water)]:
+            np.savetxt(lbl_dir / f"Labels_{name}_Train.txt", v, fmt="%d")
+        feat_dir = tmp_path / "Low_Level_Features"
+        feat_dir.mkdir()
+        np.savetxt(feat_dir / "Train_Normalized_CH.dat",
+                   rng.random((n, 4)), fmt="%.4f", delimiter=" ")
+        np.savetxt(feat_dir / "Train_Normalized_EDH.dat",
+                   rng.random((n, 3)), fmt="%.4f", delimiter=" ")
+        tag_dir = tmp_path / "NUS_WID_Tags"
+        tag_dir.mkdir()
+        np.savetxt(tag_dir / "Train_Tags1k.dat",
+                   rng.integers(0, 2, (n, 10)), fmt="%d", delimiter="\t")
+
+        xa, xb, y = vertical_finance.nus_wide_load_two_party_data(
+            str(tmp_path), ["person", "water"], dtype="Train")
+        assert xa.shape == (n, 7)   # concatenated feature files
+        assert xb.shape == (n, 10)
+        assert set(np.unique(y)) <= {0.0, 1.0}
+
+    def test_synthetic_vertical_parties(self):
+        train, test = vertical_finance.load_synthetic_vertical(
+            party_num=3, n=100)
+        assert len(train) == 4  # 3 parties + labels
+        assert train[0].shape[0] == 80 and test[0].shape[0] == 20
+
+
+# --------------------------------------------------------------- image folders
+
+def _write_png(path, color, size=8):
+    from PIL import Image
+    arr = np.full((size, size, 3), color, np.uint8)
+    Image.fromarray(arr).save(path)
+
+
+class TestImageFolder:
+    def _imagenet_tree(self, tmp_path, n_per_class=6):
+        for split in ("train", "val"):
+            for ci, cname in enumerate(["n01440764", "n01443537"]):
+                d = tmp_path / split / cname
+                d.mkdir(parents=True)
+                for i in range(n_per_class):
+                    _write_png(d / f"img_{i}.png", 40 * (ci + 1))
+        return tmp_path
+
+    def test_imagenet_homo_materialized(self, tmp_path):
+        root = self._imagenet_tree(tmp_path)
+        ds = imagefolder.load_imagenet_federated(
+            str(root), client_num=2, partition="homo", image_size=8)
+        assert ds[7] == 2
+        assert ds[0] == 12 and ds[1] == 12
+        assert ds[5][0]["x"].shape[1:] == (8, 8, 3)
+        assert sum(len(ds[5][c]["y"]) for c in range(2)) == 12
+
+    def test_imagenet_manifest_mode(self, tmp_path):
+        root = self._imagenet_tree(tmp_path)
+        ds = imagefolder.load_imagenet_federated(
+            str(root), client_num=2, partition="homo", image_size=8,
+            materialize=False)
+        m = ds[5][0]
+        assert "paths" in m
+        shard = imagefolder.materialize_shard(m, image_size=8)
+        assert shard["x"].shape == (len(m["y"]), 8, 8, 3)
+
+    def test_imagenet_missing_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            imagefolder.load_imagenet_federated(str(tmp_path))
+
+    def test_landmarks_csv_split(self, tmp_path):
+        img_dir = tmp_path / "images"
+        img_dir.mkdir()
+        rows = []
+        for u in range(3):
+            for i in range(6):
+                img = f"u{u}_i{i}"
+                _write_png(img_dir / f"{img}.jpg", 30 * u + 10)
+                rows.append((f"user{u}", img, u))
+        with open(tmp_path / "gld23k_user_dict.csv", "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["user_id", "image_id", "class"])
+            w.writerows(rows)
+        ds = imagefolder.load_landmarks_federated(
+            str(tmp_path), split="gld23k", image_size=8)
+        assert len(ds[5]) == 3          # natural client keying
+        assert ds[7] == 3               # remapped classes
+        # fallback test split is held OUT of train (k=1 per client here)
+        assert ds[5][0]["x"].shape == (5, 8, 8, 3)
+        assert len(ds[3]["y"]) == 3
+        assert ds[0] == 15
