@@ -19,7 +19,17 @@ def main(argv=None):
     parser.add_argument("--topology_neighbors", type=int, default=2)
     parser.add_argument("--asymmetric", type=int, default=0,
                         help="1 = directed topology (random edge deletion)")
+    parser.add_argument("--online", type=int, default=0,
+                        help="1 = streaming online learning over UCI-style "
+                             "streams (reference standalone/decentralized)")
+    parser.add_argument("--stream_length", type=int, default=200)
+    parser.add_argument("--time_varying", type=int, default=0)
+    parser.add_argument("--beta", type=float, default=0.0,
+                        help="adversarial (clustered) stream prefix fraction")
     args = parser.parse_args(argv)
+
+    if args.online:
+        return _online_main(args)
 
     logger = common.setup(args, run_name=f"Decentralized-{args.algorithm}")
     dataset, model = common.load_dataset_and_model(args)
@@ -39,6 +49,31 @@ def main(argv=None):
     states = api.train()
     logger.close()
     return api, states
+
+
+def _online_main(args):
+    """Streaming path: UCI csv when --data_dir points at one, synthetic
+    stream otherwise."""
+    logger = common.setup(args, run_name=f"DecOnline-{args.algorithm}")
+    from fedml_tpu.data import uci
+    import os
+    if args.data_dir and os.path.exists(args.data_dir):
+        streams = uci.load_streaming_uci(
+            args.dataset, args.data_dir, args.client_num_in_total,
+            args.stream_length * args.client_num_in_total,
+            beta=args.beta, seed=args.seed)
+    else:
+        streams = uci.load_synthetic_stream(
+            client_num=args.client_num_in_total, T=args.stream_length,
+            seed=args.seed)
+
+    from fedml_tpu.algorithms.decentralized_online import (
+        DecentralizedOnlineAPI)
+    api = DecentralizedOnlineAPI(streams, args, algorithm=args.algorithm,
+                                 metrics_logger=logger)
+    w = api.train()
+    logger.close()
+    return api, w
 
 
 if __name__ == "__main__":
