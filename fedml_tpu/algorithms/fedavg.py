@@ -109,6 +109,7 @@ class FedAvgAPI:
         jax.block_until_ready(self.global_state)
         dt = time.time() - t0
         m = jax.tree.map(np.asarray, info["metrics"])
+        self._last_metrics = m  # full summed-metrics pytree for subclasses
         train_metrics = {
             "round": self.round_idx,
             "Train/Loss": float(m["loss_sum"].sum() / max(m["count"].sum(), 1)),

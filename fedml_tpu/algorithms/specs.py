@@ -105,6 +105,50 @@ def make_seq_classification_spec(model, example_x, ignore_index=0,
                      name=name)
 
 
+def make_segmentation_spec(model, example_x, num_classes,
+                           ignore_index=255, name="segmentation"):
+    """Per-pixel cross-entropy over ``[B, H, W, C]`` logits with
+    ignore-label masking (reference FedSeg ``MyModelTrainer`` loss). Metrics
+    carry a summed ``[C, C]`` confusion matrix so the aggregator computes
+    mIoU/FWIoU exactly (``fedseg/utils.py:246-288``)."""
+    from fedml_tpu.core.seg_eval import confusion_matrix
+
+    def init_fn(rng):
+        variables = model.init(rng, example_x, train=False)
+        return dict(variables)
+
+    def _loss_and_metrics(logits, y, mask):
+        y = y.astype(jnp.int32)
+        pix_mask = ((y != ignore_index) & (y >= 0) &
+                    (y < num_classes)).astype(jnp.float32)
+        pix_mask = pix_mask * mask.reshape(mask.shape + (1,) * (y.ndim - 1))
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        y_safe = jnp.clip(y, 0, logits.shape[-1] - 1)
+        ll = jnp.take_along_axis(logp, y_safe[..., None], axis=-1)[..., 0]
+        count = jnp.sum(pix_mask)
+        loss = jnp.sum(-ll * pix_mask) / jnp.maximum(count, 1.0)
+        pred = jnp.argmax(logits, axis=-1)
+        correct = jnp.sum((pred == y) * pix_mask)
+        cm = confusion_matrix(jnp.where(pix_mask > 0, y, -1), pred,
+                              num_classes)
+        metrics = {"loss_sum": jnp.sum(-ll * pix_mask), "correct": correct,
+                   "count": count, "confusion": cm}
+        return loss, metrics
+
+    def loss_fn(state, batch, rng, train):
+        logits, new_state = _apply_model(model, state, batch["x"], rng, train)
+        loss, metrics = _loss_and_metrics(logits, batch["y"], batch["mask"])
+        return loss, (new_state, metrics)
+
+    def metrics_fn(state, batch):
+        logits, _ = _apply_model(model, state, batch["x"], None, False)
+        _, metrics = _loss_and_metrics(logits, batch["y"], batch["mask"])
+        return metrics
+
+    return TrainSpec(init_fn=init_fn, loss_fn=loss_fn, metrics_fn=metrics_fn,
+                     name=name)
+
+
 def make_multilabel_spec(model, example_x, name="tag_prediction"):
     """Sigmoid BCE multilabel (reference ``my_model_trainer_tag_prediction.py``
     for stackoverflow_lr: BCELoss + top-k precision/recall style counts)."""

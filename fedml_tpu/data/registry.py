@@ -36,6 +36,15 @@ def load_dataset(args, dataset_name):
         size_kw.pop("image_size", None)
         return synthetic.load_synthetic_sequences(
             client_num=client_num, seed=seed, **size_kw)
+    if dataset_name == "synthetic_segmentation":
+        return synthetic.load_synthetic_segmentation(
+            client_num=client_num, seed=seed, **size_kw)
+    if dataset_name in ("pascal_voc", "coco_seg"):
+        from fedml_tpu.data.voc import load_voc_federated
+        return load_voc_federated(
+            data_dir, client_num=client_num, partition=partition,
+            partition_alpha=alpha,
+            image_size=getattr(args, "image_size", None) or 513, seed=seed)
 
     if dataset_name == "mnist":
         from fedml_tpu.data.leaf import load_leaf_mnist

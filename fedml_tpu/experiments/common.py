@@ -103,6 +103,16 @@ def load_dataset_and_model(args):
     return dataset, model
 
 
+def example_train_data(dataset):
+    """Pooled train set, or any client shard for loaders that keep data
+    client-resident (Landmarks, VOC) and carry ``train_global=None``."""
+    global_train = dataset[2]
+    if global_train is None or "x" not in global_train:
+        global_train = next(d for d in dataset[5].values()
+                            if d is not None and len(d["y"]))
+    return global_train
+
+
 def make_spec(args, model, dataset):
     """Task-spec selection by dataset, mirroring the reference's
     dataset-keyed ModelTrainer choice
@@ -110,13 +120,7 @@ def make_spec(args, model, dataset):
     import jax.numpy as jnp
     from fedml_tpu.algorithms import specs
 
-    global_train = dataset[2]
-    if global_train is None or "x" not in global_train:
-        # loaders that keep data client-resident (e.g. Landmarks) carry no
-        # pooled train set; any client shard supplies the example shapes
-        global_train = next(d for d in dataset[5].values()
-                            if d is not None and len(d["y"]))
-    example_x = jnp.asarray(global_train["x"][:1])
+    example_x = jnp.asarray(example_train_data(dataset)["x"][:1])
     name = args.dataset
     if name in ("stackoverflow_nwp", "shakespeare", "fed_shakespeare",
                 "synthetic_sequences"):
@@ -158,7 +162,8 @@ def run_fedavg_family(api, args, logger):
                                  or last):
             ckpt.save(api_.round_idx, api_.global_state,
                       server_state=api_.server_state, rng=api_.rng,
-                      metric=metrics.get("Test/Acc"),
+                      metric=metrics.get(
+                          getattr(api_, "checkpoint_metric", "Test/Acc")),
                       data_rng=api_._data_rng)
 
     with profile_trace(args.profile_dir, enabled=args.profile_dir is not None):

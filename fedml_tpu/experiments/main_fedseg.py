@@ -1,0 +1,47 @@
+"""FedSeg experiment main (reference ``fedml_experiments/distributed/
+fedseg/``; DeepLab-style args: ``--backbone``, ``--outstride``, LR
+scheduler flags per ``fedseg/utils.py:114-165``).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax.numpy as jnp
+
+from fedml_tpu.experiments import common
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("FedSeg-TPU")
+    common.add_base_args(parser)
+    parser.add_argument("--backbone", type=str, default="resnet",
+                        choices=["resnet", "mobilenet"])
+    parser.add_argument("--outstride", type=int, default=16, choices=[8, 16])
+    parser.add_argument("--lr_scheduler", type=str, default="poly",
+                        choices=["cos", "poly", "step"])
+    parser.add_argument("--lr_step", type=int, default=0)
+    parser.add_argument("--warmup_epochs", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    logger = common.setup(args, run_name=f"FedSeg-{args.backbone}")
+    from fedml_tpu.data.registry import load_dataset
+    from fedml_tpu.models.deeplab import DeepLab
+    from fedml_tpu.algorithms.specs import make_segmentation_spec
+    from fedml_tpu.algorithms.fedseg import FedSegAPI
+
+    dataset = load_dataset(args, args.dataset)
+    model = DeepLab(num_classes=dataset[7], backbone=args.backbone,
+                    output_stride=args.outstride)
+    example = jnp.asarray(common.example_train_data(dataset)["x"][:1])
+    spec = make_segmentation_spec(model, example, num_classes=dataset[7])
+
+    api = FedSegAPI(dataset, spec, args, mesh=common.make_mesh(args),
+                    metrics_logger=logger)
+    state = common.run_fedavg_family(api, args, logger)
+    logger.close()
+    return api, state
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""DeepLab-v3+-style semantic segmentation model in Flax.
+
+Parity target: the reference FedSeg experiments parameterize a
+DeepLab-style net by ``--backbone`` and ``--outstride``
+(``fedml_api/distributed/fedseg`` args; SURVEY.md section 2.2). This is a
+TPU-first re-design, not a port: NHWC layout, atrous (dilated) convs for
+the output stride, an ASPP pyramid with global pooling, and a light
+decoder with an encoder skip -- all static shapes so XLA tiles every conv
+onto the MXU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _bilinear(x, hw):
+    import jax
+    return jax.image.resize(x, (x.shape[0], hw[0], hw[1], x.shape[-1]),
+                            method="bilinear")
+
+
+class _ConvBlock(nn.Module):
+    features: int
+    kernel: int = 3
+    strides: int = 1
+    dilation: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.features, (self.kernel, self.kernel),
+                    strides=self.strides,
+                    kernel_dilation=(self.dilation, self.dilation),
+                    padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype)(x)
+        return nn.relu(x)
+
+
+class _Backbone(nn.Module):
+    """Small dilated residual encoder. ``output_stride`` 16 or 8 controls
+    where striding stops and dilation takes over (DeepLab's atrous trick)."""
+    width: int = 32
+    output_stride: int = 16
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        blk = partial(_ConvBlock, dtype=self.dtype)
+        x = blk(self.width, strides=2)(x, train)            # /2
+        low = blk(self.width * 2, strides=2)(x, train)      # /4 (skip)
+        x = blk(self.width * 4, strides=2)(low, train)      # /8
+        if self.output_stride == 16:
+            x = blk(self.width * 8, strides=2)(x, train)    # /16
+            x = blk(self.width * 8, dilation=2)(x, train)
+        else:  # output_stride 8: dilate instead of stride
+            x = blk(self.width * 8, dilation=2)(x, train)
+            x = blk(self.width * 8, dilation=4)(x, train)
+        return x, low
+
+
+class ASPP(nn.Module):
+    """Atrous spatial pyramid pooling: parallel dilated 3x3s + 1x1 + global
+    pooling, concatenated and projected."""
+    features: int = 128
+    rates: tuple = (6, 12, 18)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        blk = partial(_ConvBlock, dtype=self.dtype)
+        branches = [blk(self.features, kernel=1)(x, train)]
+        for r in self.rates:
+            branches.append(blk(self.features, dilation=r)(x, train))
+        gp = jnp.mean(x, axis=(1, 2), keepdims=True)
+        gp = blk(self.features, kernel=1)(gp, train)
+        gp = jnp.broadcast_to(gp, branches[0].shape)
+        x = jnp.concatenate(branches + [gp], axis=-1)
+        return blk(self.features, kernel=1)(x, train)
+
+
+class DeepLab(nn.Module):
+    """Encoder + ASPP + decoder-with-skip; logits upsampled to input size.
+
+    Flags mirror the reference (``--backbone`` width preset,
+    ``--outstride`` in {8, 16}).
+    """
+    num_classes: int = 21
+    backbone: str = "resnet"     # "resnet" (width 32) | "mobilenet" (width 16)
+    output_stride: int = 16
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        width = 32 if self.backbone == "resnet" else 16
+        feats, low = _Backbone(width=width, output_stride=self.output_stride,
+                               dtype=self.dtype)(x, train)
+        feats = ASPP(features=width * 4, dtype=self.dtype)(feats, train)
+        # decoder: upsample to the skip's resolution, fuse, refine
+        feats = _bilinear(feats, low.shape[1:3])
+        low = _ConvBlock(width, kernel=1, dtype=self.dtype)(low, train)
+        feats = jnp.concatenate([feats, low], axis=-1)
+        feats = _ConvBlock(width * 4, dtype=self.dtype)(feats, train)
+        logits = nn.Conv(self.num_classes, (1, 1), dtype=jnp.float32)(feats)
+        return _bilinear(logits, x.shape[1:3])

@@ -1,0 +1,46 @@
+"""Learning-rate schedules with the reference FedSeg semantics.
+
+Parity: ``fedml_api/distributed/fedseg/utils.py:114-165`` ``LR_Scheduler``:
+  step:   lr * 0.1^(epoch // lr_step)
+  cos:    0.5 * lr * (1 + cos(pi * T / N))
+  poly:   lr * (1 - T/N)^0.9
+with linear warmup over ``warmup_epochs`` epochs, where T is the global
+iteration and N = num_epochs * iters_per_epoch. Returned as an optax-style
+``fn(step) -> lr`` usable directly as ``ClientUpdateConfig.lr`` (the local
+optimizer is rebuilt each federated round, so the schedule spans one
+round's local training -- exactly the reference trainer's behavior).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def make_lr_schedule(mode, base_lr, num_epochs, iters_per_epoch,
+                     lr_step=0, warmup_epochs=0):
+    if mode == "step" and not lr_step:
+        raise ValueError("step mode requires lr_step")
+    N = max(1, num_epochs * iters_per_epoch)
+    warmup_iters = warmup_epochs * iters_per_epoch
+
+    def schedule(step):
+        # clamp past the horizon: cos would otherwise climb back toward
+        # base_lr and poly would go negative for T > N
+        T = jnp.minimum(jnp.asarray(step, jnp.float32), float(N))
+        epoch = jnp.floor(T / iters_per_epoch)
+        if mode == "cos":
+            lr = 0.5 * base_lr * (1 + jnp.cos(jnp.pi * T / N))
+        elif mode == "poly":
+            lr = base_lr * jnp.power(jnp.clip(1.0 - T / N, 0.0, 1.0), 0.9)
+        elif mode == "step":
+            lr = base_lr * jnp.power(0.1, jnp.floor(epoch / lr_step))
+        else:
+            raise ValueError(f"unknown schedule mode {mode}")
+        if warmup_iters > 0:
+            lr = jnp.where(T < warmup_iters, lr * T / warmup_iters, lr)
+        return lr
+
+    return schedule
+
+
+__all__ = ["make_lr_schedule"]
