@@ -17,9 +17,11 @@ import numpy as np
 
 from fedml_tpu.core.trainer import TrainSpec
 from fedml_tpu.parallel.engine import (
-    ClientUpdateConfig, make_sim_round, make_sharded_round, make_eval_fn)
+    ClientUpdateConfig, make_indexed_sim_round, make_sim_round,
+    make_sharded_round, make_eval_fn)
 from fedml_tpu.parallel.mesh import shard_cohort
-from fedml_tpu.parallel.packing import pack_cohort, pack_eval
+from fedml_tpu.parallel.packing import (
+    pack_cohort, pack_eval, pack_schedule, stack_clients)
 
 
 def client_sampling(round_idx, client_num_in_total, client_num_per_round):
@@ -76,6 +78,31 @@ class FedAvgAPI:
             self.round_fn = make_sharded_round(spec, cfg, mesh, payload_fn,
                                                server_fn)
         self.eval_fn = make_eval_fn(spec)
+
+        # Device-resident data path (single-chip): upload every client's
+        # padded shard to HBM once; per-round host work shrinks to an index
+        # schedule. Auto-enabled when the stacked arrays fit the cap.
+        self.device_data = None
+        if mesh is None and getattr(args, "device_resident", "auto"):
+            C = len(self.train_data_local_dict)
+            n_max = max(1, max(len(d["y"])
+                               for d in self.train_data_local_dict.values()))
+            x0 = np.asarray(self.train_data_local_dict[0]["x"])
+            y0 = np.asarray(self.train_data_local_dict[0]["y"])
+            row = (int(np.prod(x0.shape[1:], dtype=np.int64)) * x0.dtype.itemsize
+                   + int(np.prod(y0.shape[1:], dtype=np.int64) or 1)
+                   * y0.dtype.itemsize)
+            cap = float(getattr(args, "device_data_cap_gb", 2.0)) * 1e9
+            if C * n_max * row <= cap:
+                import jax.numpy as jnp
+                stacked = stack_clients(
+                    [self.train_data_local_dict[i] for i in range(C)])
+                self.device_data = {"x": jnp.asarray(stacked["x"]),
+                                    "y": jnp.asarray(stacked["y"])}
+                self._client_ns = stacked["n"]
+                self.indexed_round_fn = make_indexed_sim_round(
+                    spec, cfg, payload_fn, server_fn,
+                    client_chunk=getattr(args, "client_chunk", None))
         self.server_state = server_state if server_state is not None else ()
 
         seed = getattr(args, "seed", 0)
@@ -102,10 +129,29 @@ class FedAvgAPI:
 
     def train_one_round(self):
         t0 = time.time()
-        _, packed = self._cohort(self.round_idx)
         self.rng, round_rng = jax.random.split(self.rng)
-        self.global_state, self.server_state, info = self.round_fn(
-            self.global_state, self.server_state, packed, round_rng)
+        if self.device_data is not None:
+            import jax.numpy as jnp
+            client_indexes = client_sampling(
+                self.round_idx, len(self.train_data_local_dict),
+                self.args.client_num_per_round)
+            logging.info("client_indexes = %s", client_indexes)
+            ns = [self._client_ns[i] for i in client_indexes]
+            if sum(ns) == 0:
+                raise ValueError(f"round {self.round_idx}: every sampled "
+                                 f"client has an empty shard")
+            sched = pack_schedule(ns, self.args.batch_size, self.args.epochs,
+                                  rng=self._data_rng)
+            sel = jnp.asarray(np.asarray(client_indexes, np.int32))
+            dd = {"x": self.device_data["x"][sel],
+                  "y": self.device_data["y"][sel]}
+            sched = {k: jnp.asarray(v) for k, v in sched.items()}
+            self.global_state, self.server_state, info = self.indexed_round_fn(
+                self.global_state, self.server_state, dd, sched, round_rng)
+        else:
+            _, packed = self._cohort(self.round_idx)
+            self.global_state, self.server_state, info = self.round_fn(
+                self.global_state, self.server_state, packed, round_rng)
         jax.block_until_ready(self.global_state)
         dt = time.time() - t0
         m = jax.tree.map(np.asarray, info["metrics"])

@@ -1,0 +1,137 @@
+"""Native host-runtime: builds and loads the C++ packing shim via ctypes.
+
+Compiled lazily on first use with the system ``g++`` (no pybind11 in the
+image -- plain C ABI + ctypes). The build artifact is cached next to the
+source keyed by a source hash, so rebuilds happen only when packing.cpp
+changes. Every entry point degrades gracefully: if the toolchain or
+compile is unavailable, ``load_native()`` returns None and callers use the
+pure-Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import tempfile
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "packing.cpp")
+_lib = None
+_tried = False
+
+
+def _build(src, out):
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           src, "-o", out]
+    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+
+
+def load_native():
+    """Return the ctypes library, building if needed; None when unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("FEDML_TPU_NO_NATIVE"):
+        return None
+    try:
+        with open(_SRC, "rb") as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:16]
+        # per-user cache dir (NOT a world-writable shared /tmp path, where
+        # another user could pre-plant a .so at the predictable name);
+        # build to a unique temp name then atomically rename so concurrent
+        # processes never load a half-written library
+        cache_dir = os.path.join(
+            os.environ.get("XDG_CACHE_HOME",
+                           os.path.join(os.path.expanduser("~"), ".cache")),
+            "fedml_tpu")
+        os.makedirs(cache_dir, exist_ok=True)
+        so_path = os.path.join(cache_dir, f"packing_{tag}.so")
+        if not os.path.exists(so_path):
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=cache_dir)
+            os.close(fd)
+            try:
+                _build(_SRC, tmp)
+                os.replace(tmp, so_path)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+        lib = ctypes.CDLL(so_path)
+        lib.pack_schedule.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_float)]
+        lib.pack_gather.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p]
+        _lib = lib
+    except Exception as e:  # missing g++, sandboxed tmp, bad build, ...
+        logging.info("native packing unavailable (%s); using Python path", e)
+        _lib = None
+    return _lib
+
+
+def native_pack_schedule(ns, batch_size, epochs, S, seed):
+    """C++-backed schedule generation (no data movement). Returns the
+    ``{"idx", "mask", "n"}`` dict or None when the library is unavailable."""
+    import numpy as np
+
+    lib = load_native()
+    if lib is None:
+        return None
+    C = len(ns)
+    B = batch_size
+    n = np.asarray(ns, np.int64)
+    idx = np.zeros((C, S, B), np.int64)
+    mask = np.zeros((C, S, B), np.float32)
+    lib.pack_schedule(
+        n.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), C, S, B, epochs,
+        ctypes.c_uint64(seed),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        mask.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    return {"idx": idx.astype(np.int32), "mask": mask,
+            "n": n.astype(np.float32)}
+
+
+def native_pack_cohort(client_datasets, batch_size, epochs, S, seed):
+    """C++-backed pack: schedule + gather for the ``x``/``y`` arrays.
+    Returns the packed dict or None if the native library is unavailable or
+    the inputs aren't contiguous same-dtype arrays."""
+    import numpy as np
+
+    lib = load_native()
+    if lib is None:
+        return None
+    C = len(client_datasets)
+    xs0 = np.asarray(client_datasets[0]["x"])
+    ys0 = np.asarray(client_datasets[0]["y"])
+    B = batch_size
+
+    n = np.asarray([len(d["y"]) for d in client_datasets], np.int64)
+    idx = np.zeros((C, S, B), np.int64)
+    mask = np.zeros((C, S, B), np.float32)
+    lib.pack_schedule(
+        n.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), C, S, B, epochs,
+        ctypes.c_uint64(seed),
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        mask.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+
+    out = {"mask": mask, "n": n.astype(np.float32), "idx": idx.astype(np.int32)}
+    for key, proto in (("x", xs0), ("y", ys0)):
+        arrs = [np.ascontiguousarray(np.asarray(d[key], proto.dtype))
+                for d in client_datasets]
+        row_bytes = int(np.prod(proto.shape[1:], dtype=np.int64) *
+                        proto.dtype.itemsize)
+        dst = np.zeros((C, S, B) + proto.shape[1:], proto.dtype)
+        ptrs = (ctypes.c_void_p * C)(
+            *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrs])
+        lib.pack_gather(
+            ptrs, idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            mask.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            C, S, B, row_bytes, dst.ctypes.data_as(ctypes.c_void_p))
+        out[key] = dst
+    return out

@@ -1,0 +1,153 @@
+// Native host-runtime for cohort packing: the host-side hot path between
+// federated rounds (fedml_tpu/parallel/packing.py).
+//
+// The reference framework has no first-party native code (SURVEY.md
+// section 2 note) -- its equivalent cost centers are pickle-over-MPI and
+// CPU tensor averaging. In the TPU design the device does the math and the
+// host's per-round job is staging: building per-client shuffled batch
+// schedules and gathering ragged client samples into dense [C, S, B, ...]
+// arrays. That gather is pure memory movement -- this C++ does it with raw
+// memcpy over a precomputed schedule, parallelized across clients.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in the image).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// xoshiro256** -- small, fast, public-domain PRNG family; seeded per client.
+struct Rng {
+  uint64_t s[4];
+  explicit Rng(uint64_t seed) {
+    // splitmix64 seeding
+    uint64_t z = seed;
+    for (int i = 0; i < 4; i++) {
+      z += 0x9e3779b97f4a7c15ULL;
+      uint64_t t = z;
+      t = (t ^ (t >> 30)) * 0xbf58476d1ce4e5b9ULL;
+      t = (t ^ (t >> 27)) * 0x94d049bb133111ebULL;
+      s[i] = t ^ (t >> 31);
+    }
+  }
+  static uint64_t rotl(uint64_t x, int k) { return (x << k) | (x >> (64 - k)); }
+  uint64_t next() {
+    uint64_t r = rotl(s[1] * 5, 7) * 9;
+    uint64_t t = s[1] << 17;
+    s[2] ^= s[0]; s[3] ^= s[1]; s[1] ^= s[2]; s[0] ^= s[3];
+    s[2] ^= t; s[3] = rotl(s[3], 45);
+    return r;
+  }
+  // unbiased bounded draw (Lemire)
+  uint64_t bounded(uint64_t n) {
+    uint64_t x = next();
+    __uint128_t m = (__uint128_t)x * n;
+    uint64_t l = (uint64_t)m;
+    if (l < n) {
+      uint64_t t = (0 - n) % n;
+      while (l < t) { x = next(); m = (__uint128_t)x * n; l = (uint64_t)m; }
+    }
+    return (uint64_t)(m >> 64);
+  }
+};
+
+void shuffle_idx(std::vector<int64_t>& v, Rng& rng) {
+  for (size_t i = v.size(); i > 1; i--) {
+    size_t j = (size_t)rng.bounded(i);
+    std::swap(v[i - 1], v[j]);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Build the per-client epoch/batch index schedule + mask.
+//   n[c]      : client sample counts                    [C]
+//   idx_out   : int64 slot -> local sample index        [C, S, B]
+//   mask_out  : float32 slot validity                   [C, S, B]
+// Semantics match packing.pack_cohort: per epoch a fresh permutation,
+// ceil(n/B) batches per epoch (last ragged), tiny clients reuse the
+// epoch's head, steps beyond the client's schedule fully masked.
+void pack_schedule(const int64_t* n, int64_t C, int64_t S, int64_t B,
+                   int64_t epochs, uint64_t seed, int64_t* idx_out,
+                   float* mask_out) {
+  auto work = [&](int64_t c) {
+    Rng rng(seed * 0x9e3779b97f4a7c15ULL + (uint64_t)c + 1);
+    int64_t nc = n[c];
+    int64_t* idx = idx_out + c * S * B;
+    float* mask = mask_out + c * S * B;
+    std::memset(idx, 0, sizeof(int64_t) * S * B);
+    std::memset(mask, 0, sizeof(float) * S * B);
+    if (nc <= 0) return;
+    std::vector<int64_t> order(nc);
+    int64_t per_epoch = std::max<int64_t>(1, (nc + B - 1) / B);
+    int64_t s = 0;
+    for (int64_t e = 0; e < epochs; e++) {
+      for (int64_t i = 0; i < nc; i++) order[i] = i;
+      shuffle_idx(order, rng);
+      for (int64_t b = 0; b < per_epoch && s < S; b++, s++) {
+        int64_t lo = b * B;
+        int64_t k = std::min(B, nc - lo);
+        if (k <= 0) { lo = 0; k = std::min(B, nc); }  // tiny client reuse
+        for (int64_t t = 0; t < k; t++) {
+          idx[s * B + t] = order[lo + t];
+          mask[s * B + t] = 1.0f;
+        }
+      }
+    }
+  };
+  int64_t nthreads = std::min<int64_t>(
+      C, std::max(1u, std::thread::hardware_concurrency()));
+  if (nthreads <= 1 || C == 1) {
+    for (int64_t c = 0; c < C; c++) work(c);
+    return;
+  }
+  std::vector<std::thread> pool;
+  for (int64_t t = 0; t < nthreads; t++) {
+    pool.emplace_back([&, t]() {
+      for (int64_t c = t; c < C; c += nthreads) work(c);
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+// Gather client rows into the dense cohort tensor.
+//   srcs[c]  : pointer to client c's contiguous [n_c, row_bytes] data
+//   idx/mask : the schedule from pack_schedule                [C, S, B]
+//   out      : [C, S, B, row_bytes]  (row_bytes = product of trailing dims
+//              x element size; masked slots left zeroed by caller memset)
+void pack_gather(const uint8_t* const* srcs, const int64_t* idx,
+                 const float* mask, int64_t C, int64_t S, int64_t B,
+                 int64_t row_bytes, uint8_t* out) {
+  auto work = [&](int64_t c) {
+    const uint8_t* src = srcs[c];
+    for (int64_t s = 0; s < S; s++) {
+      for (int64_t b = 0; b < B; b++) {
+        int64_t slot = (c * S + s) * B + b;
+        if (mask[slot] > 0.0f) {
+          std::memcpy(out + slot * row_bytes, src + idx[slot] * row_bytes,
+                      (size_t)row_bytes);
+        }
+      }
+    }
+  };
+  int64_t nthreads = std::min<int64_t>(
+      C, std::max(1u, std::thread::hardware_concurrency()));
+  if (nthreads <= 1 || C == 1) {
+    for (int64_t c = 0; c < C; c++) work(c);
+    return;
+  }
+  std::vector<std::thread> pool;
+  for (int64_t t = 0; t < nthreads; t++) {
+    pool.emplace_back([&, t]() {
+      for (int64_t c = t; c < C; c += nthreads) work(c);
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+}  // extern "C"

@@ -16,6 +16,7 @@ cohort max-steps bucket changes, not per client.
 from __future__ import annotations
 
 import math
+import os
 
 import numpy as np
 
@@ -30,7 +31,7 @@ def _steps_for(n, batch_size, epochs, drop_last=False):
 
 
 def pack_cohort(client_datasets, batch_size, epochs, rng=None, drop_last=False,
-                step_bucket=8, return_indices=False):
+                step_bucket=8, return_indices=False, native="auto"):
     """Pack a cohort's datasets into dense arrays for one federated round.
 
     Args:
@@ -59,6 +60,26 @@ def pack_cohort(client_datasets, batch_size, epochs, rng=None, drop_last=False,
              for d in client_datasets]
     S = max(steps)
     S = int(math.ceil(S / step_bucket) * step_bucket)
+
+    # Exactly ONE draw from the caller's generator regardless of which
+    # implementation runs below: the checkpointable host stream advances
+    # identically on every machine (native or python, any core count), so
+    # cross-machine resume keeps a consistent RNG trajectory. (Shuffle
+    # *realizations* differ between the native and python PRNGs; the
+    # native gate is per-machine-stable, so same-machine resume is exact.)
+    seed = int(rng.integers(0, 2 ** 63 - 1))
+    use_native = native is True or (
+        # the threaded gather only beats numpy's fancy indexing when there
+        # are cores to spread it over
+        native == "auto" and (os.cpu_count() or 1) >= 4)
+    if use_native and not drop_last:
+        from fedml_tpu.native import native_pack_cohort
+        out = native_pack_cohort(client_datasets, batch_size, epochs, S, seed)
+        if out is not None:
+            if not return_indices:
+                out.pop("idx")
+            return out
+    rng = np.random.default_rng(seed)
 
     x0 = np.asarray(client_datasets[0]["x"])
     y0 = np.asarray(client_datasets[0]["y"])
@@ -92,6 +113,83 @@ def pack_cohort(client_datasets, batch_size, epochs, rng=None, drop_last=False,
     if return_indices:
         out["idx"] = slot_idx
     return out
+
+
+def stack_clients(client_datasets, n_max=None):
+    """Pad-and-stack client shards into device-uploadable arrays.
+
+    Returns ``{"x": [C, n_max, ...], "y": [C, n_max, ...], "n": [C]}`` --
+    uploaded to HBM ONCE; afterwards every round needs only a (tiny) index
+    schedule from ``pack_schedule``. Padding rows are zeros; they are never
+    addressed by a valid schedule slot.
+    """
+    C = len(client_datasets)
+    if n_max is None:
+        n_max = max(1, max(len(d["y"]) for d in client_datasets))
+    x0 = np.asarray(client_datasets[0]["x"])
+    y0 = np.asarray(client_datasets[0]["y"])
+    xs = np.zeros((C, n_max) + x0.shape[1:], x0.dtype)
+    ys = np.zeros((C, n_max) + y0.shape[1:], y0.dtype)
+    n = np.zeros((C,), np.float32)
+    for c, d in enumerate(client_datasets):
+        k = len(d["y"])
+        n[c] = k
+        xs[c, :k] = np.asarray(d["x"])
+        ys[c, :k] = np.asarray(d["y"])
+    return {"x": xs, "y": ys, "n": n}
+
+
+def pack_schedule(ns, batch_size, epochs, rng=None, drop_last=False,
+                  step_bucket=8, native="auto"):
+    """Index schedule only -- no data movement.
+
+    Args: ``ns`` per-client sample counts. Returns ``{"idx": [C, S, B]
+    int32, "mask": [C, S, B] float32, "n": [C] float32}`` with the same
+    epoch/batch semantics as ``pack_cohort``. The C++ shim generates it
+    when available; the numpy fallback shares semantics (shuffles differ --
+    different RNG families -- but both are seeded from the same host
+    generator so runs stay reproducible/resumable).
+    """
+    rng = rng or np.random.default_rng(0)
+    ns = [int(v) for v in ns]
+    C = len(ns)
+    if batch_size in (-1, 0):
+        batch_size = max(1, max(ns))
+    S = max(_steps_for(n, batch_size, epochs, drop_last) for n in ns)
+    S = int(math.ceil(S / step_bucket) * step_bucket)
+    B = batch_size
+
+    # one-draw contract and native gate identical to pack_cohort's, so the
+    # two functions consume the host RNG the same way and produce the same
+    # schedules on a given machine -- keeping schedule-equality invariants
+    # (hierarchical 1-group == fedavg) across data paths
+    seed = int(rng.integers(0, 2 ** 63 - 1))
+    use_native = native is True or (
+        native == "auto" and (os.cpu_count() or 1) >= 4)
+    if use_native and not drop_last:
+        from fedml_tpu.native import native_pack_schedule
+        out = native_pack_schedule(ns, B, epochs, S, seed)
+        if out is not None:
+            return out
+    rng = np.random.default_rng(seed)
+
+    idx = np.zeros((C, S, B), np.int32)
+    mask = np.zeros((C, S, B), np.float32)
+    for c, n_c in enumerate(ns):
+        if n_c == 0:
+            continue
+        s = 0
+        for _ in range(epochs):
+            order = rng.permutation(n_c)
+            for b in range(_per_epoch_steps(n_c, B, drop_last)):
+                sel = order[b * B:(b + 1) * B]
+                if len(sel) == 0:
+                    sel = order[:min(n_c, B)]
+                idx[c, s, :len(sel)] = sel
+                mask[c, s, :len(sel)] = 1.0
+                s += 1
+    return {"idx": idx, "mask": mask,
+            "n": np.asarray(ns, np.float32)}
 
 
 def pack_eval(data, batch_size, pad_multiple=1):
