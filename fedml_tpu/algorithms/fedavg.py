@@ -29,11 +29,12 @@ def client_sampling(round_idx, client_num_in_total, client_num_per_round):
     ``FedAVGAggregator._client_sampling`` (``FedAVGAggregator.py:89-97``):
     reseeding with the round index makes runs reproducible and lets A/B runs
     pick identical client subsets."""
-    if client_num_in_total == client_num_per_round:
+    num_clients = min(client_num_per_round, client_num_in_total)
+    if client_num_in_total == num_clients:
         return list(range(client_num_in_total))
     np.random.seed(round_idx)
     return list(np.random.choice(range(client_num_in_total),
-                                 client_num_per_round, replace=False))
+                                 num_clients, replace=False))
 
 
 class FedAvgAPI:
