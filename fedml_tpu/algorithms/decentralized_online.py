@@ -88,9 +88,16 @@ class DecentralizedOnlineAPI:
                 W_t = self.W[perm][:, perm]
             else:
                 W_t = self.W
-            # gossip-mix then local gradient step (reference order:
-            # neighbor averaging of pushed models, then SGD on own sample)
-            w_mixed = W_t @ (w - lr * grad)
+            # local gradient step, then PUSH-based gossip: sender i ships
+            # x_i weighted by ITS row entry W[i, j], receiver j sums --
+            # x' = W^T x (``client_dsgd.py:78-103``: topo_weight is the
+            # sender's row value). For the column-stochastic PushSum matrix
+            # the push form is x' = W x by construction. Row-form W @ x
+            # (in-neighbor averaging) is the OTHER reference DSGD
+            # (decentralized_framework) and lives in decentralized.py.
+            stepped = w - lr * grad
+            w_mixed = (W_t @ stepped if algorithm == "pushsum"
+                       else W_t.T @ stepped)
             if algorithm == "pushsum":
                 omega = W_t @ omega
             return (w_mixed, omega, key), (loss, correct)
@@ -118,7 +125,10 @@ class DecentralizedOnlineAPI:
         self.history = {
             "Online/AvgLoss": float(losses.mean()),
             "Online/AvgAcc": float(corrects.mean()),
-            "Online/Regret": float(losses.sum(0).mean()),
+            # reference ``cal_regret`` (decentralized_fl_api.py:11-17):
+            # cumulative loss / (client_number * (t+1)) at the final step
+            "Online/Regret": float(losses.sum() /
+                                   (losses.shape[1] * losses.shape[0])),
             "Online/FinalConsensus": float(
                 np.linalg.norm(self.w - self.w.mean(0, keepdims=True)) /
                 max(1, self.n_nodes)),

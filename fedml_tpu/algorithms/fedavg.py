@@ -17,7 +17,7 @@ import numpy as np
 
 from fedml_tpu.core.trainer import TrainSpec
 from fedml_tpu.parallel.engine import (
-    ClientUpdateConfig, make_indexed_sim_round, make_sim_round,
+    ClientUpdateConfig, WaveRunner, make_indexed_sim_round, make_sim_round,
     make_sharded_round, make_eval_fn)
 from fedml_tpu.parallel.mesh import shard_cohort
 from fedml_tpu.parallel.packing import (
@@ -84,7 +84,10 @@ class FedAvgAPI:
         # padded shard to HBM once; per-round host work shrinks to an index
         # schedule. Auto-enabled when the stacked arrays fit the cap.
         self.device_data = None
-        if mesh is None and getattr(args, "device_resident", "auto"):
+        device_resident = getattr(args, "device_resident", "auto")
+        if str(device_resident).lower() in ("0", "false", "none", ""):
+            device_resident = False
+        if mesh is None and device_resident:
             C = len(self.train_data_local_dict)
             n_max = max(1, max(len(d["y"])
                                for d in self.train_data_local_dict.values()))
@@ -101,6 +104,11 @@ class FedAvgAPI:
                 self.device_data = {"x": jnp.asarray(stacked["x"]),
                                     "y": jnp.asarray(stacked["y"])}
                 self._client_ns = stacked["n"]
+                # wave path (default): size-sorted waves w/ dynamic trip
+                # count; flat path kept for A/B (--wave_mode 0)
+                self.wave_runner = WaveRunner(
+                    spec, cfg, payload_fn, server_fn,
+                    client_chunk=getattr(args, "client_chunk", 8) or 8)
                 self.indexed_round_fn = make_indexed_sim_round(
                     spec, cfg, payload_fn, server_fn,
                     client_chunk=getattr(args, "client_chunk", None))
@@ -143,12 +151,19 @@ class FedAvgAPI:
                                  f"client has an empty shard")
             sched = pack_schedule(ns, self.args.batch_size, self.args.epochs,
                                   rng=self._data_rng)
-            sel = jnp.asarray(np.asarray(client_indexes, np.int32))
-            dd = {"x": self.device_data["x"][sel],
-                  "y": self.device_data["y"][sel]}
-            sched = {k: jnp.asarray(v) for k, v in sched.items()}
-            self.global_state, self.server_state, info = self.indexed_round_fn(
-                self.global_state, self.server_state, dd, sched, round_rng)
+            if getattr(self.args, "wave_mode", 1):
+                (self.global_state, self.server_state,
+                 info) = self.wave_runner.run_round(
+                    self.global_state, self.server_state, self.device_data,
+                    client_indexes, sched, round_rng)
+            else:
+                sel = jnp.asarray(np.asarray(client_indexes, np.int32))
+                dd = {"x": self.device_data["x"][sel],
+                      "y": self.device_data["y"][sel]}
+                sched = {k: jnp.asarray(v) for k, v in sched.items()}
+                (self.global_state, self.server_state,
+                 info) = self.indexed_round_fn(
+                    self.global_state, self.server_state, dd, sched, round_rng)
         else:
             _, packed = self._cohort(self.round_idx)
             self.global_state, self.server_state, info = self.round_fn(

@@ -7,20 +7,30 @@ temperature at ``GKTServerTrainer.py:48-49``).
 
 TPU re-design: the client phase is the engine's vmapped local training with a
 distillation-augmented loss; the feature-extraction pass and the server phase
-are jitted scans. The server model trains on the pooled feature tensor --
-which on a mesh shards over the ``model`` axis (the reference used
-``nn.DataParallel`` over 4 GPUs, ``GKTServerTrainer.py:28-29``).
+are jitted scans. Pass ``mesh=`` (with a ``model`` axis,
+``parallel.mesh.make_client_mesh(1, n)``) and the server phase runs under
+``shard_map``: each step's sample batch splits over the ``model`` axis,
+gradients are ``psum``-averaged and BN statistics ``pmean``-merged across
+shards -- the TPU-native form of the reference's ``nn.DataParallel`` over 4
+GPUs (``GKTServerTrainer.py:28-29``). ``evaluate()`` is one jitted program
+scoring the combined edge->server pipeline over EVERY client's own
+extractor and local test shard (the reference server tests on each
+client's uploaded test features, ``GKTServerTrainer.py:216-244``).
 """
 
 from __future__ import annotations
+
+import logging
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from jax.sharding import PartitionSpec as P
 
 from fedml_tpu.parallel.engine import ClientUpdateConfig, make_optimizer
-from fedml_tpu.parallel.packing import pack_cohort
+from fedml_tpu.parallel.mesh import MODEL_AXIS
+from fedml_tpu.parallel.packing import pack_cohort, pack_eval
 
 
 def kl_divergence(student_logits, teacher_logits, T):
@@ -44,12 +54,22 @@ class FedGKTAPI:
     default 1.0), ``epochs`` (client), ``server_epochs``."""
 
     def __init__(self, dataset, client_model, server_model, args,
-                 metrics_logger=None):
+                 mesh=None, metrics_logger=None):
         (_, _, _, self.test_data_global, _, self.train_data_local_dict,
          self.test_data_local_dict, self.class_num) = dataset
         self.args = args
         self.client_model = client_model
         self.server_model = server_model
+        self.mesh = None
+        if mesh is not None and MODEL_AXIS in mesh.axis_names:
+            n_shards = mesh.shape[MODEL_AXIS]
+            if n_shards > 1 and args.batch_size % n_shards:
+                logging.warning(
+                    "fedgkt: batch_size %d not divisible by %d model "
+                    "shards; server phase runs unsharded",
+                    args.batch_size, n_shards)
+            elif n_shards > 1:
+                self.mesh = mesh
         self.metrics_logger = metrics_logger or (lambda d: None)
         self.n_clients = len(self.train_data_local_dict)
         self.T = getattr(args, "temperature", 3.0)
@@ -91,6 +111,7 @@ class FedGKTAPI:
 
         self._client_round = jax.jit(self._make_client_round())
         self._server_round = jax.jit(self._make_server_round())
+        self._eval_fn = None  # built lazily (jitted all-client pipeline)
 
     # -- client phase ------------------------------------------------------
     def _make_client_round(self):
@@ -159,13 +180,18 @@ class FedGKTAPI:
     def _make_server_round(self):
         sm, T, alpha = self.server_model, self.T, self.alpha
         tx = self.server_tx
+        mesh = self.mesh
 
         n_epochs = self.server_epochs  # static under jit
+        sharded = mesh is not None
 
         def server_round(server_state, server_opt, feats, client_logits,
                          ys, masks):
             """feats [C,S,B,h,w,c] pooled over clients; trains with
-            CE + KL vs client logits, returns per-batch server logits."""
+            CE + KL vs client logits, returns per-batch server logits.
+            Under shard_map the B axis arrives pre-split over the ``model``
+            mesh axis; sums/grads/BN stats are psum/pmean-merged so every
+            shard steps identically (DataParallel semantics)."""
             C, S = feats.shape[0], feats.shape[1]
             flat = lambda a: a.reshape((C * S,) + a.shape[2:])
             fb, lb, yb, mb = flat(feats), flat(client_logits), flat(ys), flat(masks)
@@ -183,21 +209,34 @@ class FedGKTAPI:
                                                mutable=["batch_stats"])
                         ce = _masked_ce(logits, y, m)
                         kl = kl_divergence(logits, cl, T) * m
-                        count = jnp.maximum(jnp.sum(m), 1.0)
-                        loss = (jnp.sum(ce) + alpha * jnp.sum(kl)) / count
+                        # SUM form: normalized after the (possibly psummed)
+                        # count so sharded and unsharded grads agree
+                        loss_sum = jnp.sum(ce) + alpha * jnp.sum(kl)
                         new_st = dict(st)
-                        new_st["batch_stats"] = mut["batch_stats"]
-                        return loss, new_st
+                        if "batch_stats" in mut:
+                            new_st["batch_stats"] = mut["batch_stats"]
+                        return loss_sum, (new_st, jnp.sum(m))
 
-                    (loss, new_st), grads = jax.value_and_grad(
+                    (_, (new_st, cnt)), grads = jax.value_and_grad(
                         loss_fn, has_aux=True)(state["params"])
+                    if sharded:
+                        cnt = jax.lax.psum(cnt, MODEL_AXIS)
+                        grads = jax.tree.map(
+                            lambda g: jax.lax.psum(g, MODEL_AXIS), grads)
+                        if "batch_stats" in new_st:
+                            new_st = dict(new_st)
+                            new_st["batch_stats"] = jax.tree.map(
+                                lambda s: jax.lax.pmean(s, MODEL_AXIS),
+                                new_st["batch_stats"])
+                    grads = jax.tree.map(
+                        lambda g: g / jnp.maximum(cnt, 1.0), grads)
                     updates, new_opt = tx.update(grads, opt, state["params"])
                     new_params = optax.apply_updates(state["params"], updates)
                     new_state = dict(new_st); new_state["params"] = new_params
-                    valid = jnp.sum(m) > 0
+                    valid = cnt > 0
                     out = jax.tree.map(lambda a, b: jnp.where(valid, a, b),
                                        (new_state, new_opt), (state, opt))
-                    return out, loss
+                    return out, ()
 
                 (state, opt), _ = jax.lax.scan(step, (state, opt),
                                                (fb, lb, yb, mb))
@@ -216,7 +255,18 @@ class FedGKTAPI:
             out_logits = out_logits.reshape((C, S) + out_logits.shape[1:])
             return server_state, server_opt, out_logits
 
-        return server_round
+        if not sharded:
+            return server_round
+
+        # batch-dim sharding over the `model` axis: [C,S,B,...] splits on
+        # axis 2; model/optimizer state replicated; logits return sharded
+        # on their B axis and reassemble transparently
+        data_spec = P(None, None, MODEL_AXIS)
+        return jax.shard_map(
+            server_round, mesh=mesh,
+            in_specs=(P(), P(), data_spec, data_spec, data_spec, data_spec),
+            out_specs=(P(), P(), data_spec),
+            check_vma=False)
 
     def train_one_round(self):
         packed = pack_cohort(
@@ -246,24 +296,64 @@ class FedGKTAPI:
         self.metrics_logger(out)
         return out
 
-    def evaluate(self):
-        """End-to-end eval: client 0's edge model -> server model (reference
-        evaluates the combined pipeline on the server)."""
-        from fedml_tpu.parallel.packing import pack_eval
-        packed = pack_eval(self.test_data_global, self.args.batch_size)
-        cstate = jax.tree.map(lambda v: v[0], self.client_states)
+    def _make_eval(self):
+        cm, sm = self.client_model, self.server_model
 
-        correct = total = 0.0
-        for s in range(packed["mask"].shape[0]):
-            x = jnp.asarray(packed["x"][s])
-            y = np.asarray(packed["y"][s])
-            m = np.asarray(packed["mask"][s])
-            feats, _ = self.client_model.apply(cstate, x, train=False)
-            logits = np.asarray(
-                self.server_model.apply(self.server_state, feats, train=False))
-            correct += float((((logits.argmax(-1)) == y) * m).sum())
-            total += float(m.sum())
-        return {"Test/Acc": correct / max(total, 1)}
+        @jax.jit
+        def eval_fn(client_states, server_state, data):
+            def one_client(cstate, d):
+                def step(_, batch):
+                    feats, _l = cm.apply(cstate, batch["x"], train=False)
+                    logits = sm.apply(server_state, feats, train=False)
+                    correct = jnp.sum(
+                        (jnp.argmax(logits, -1) == batch["y"]) * batch["mask"])
+                    return _, {"correct": correct,
+                               "count": jnp.sum(batch["mask"])}
+
+                _, ms = jax.lax.scan(step, 0, d)
+                return jax.tree.map(jnp.sum, ms)
+
+            ms = jax.vmap(one_client)(client_states, data)
+            return jax.tree.map(jnp.sum, ms)
+
+        return eval_fn
+
+    def evaluate(self):
+        """End-to-end eval of the combined edge->server pipeline, one jitted
+        program over ALL clients: each client's own extractor feeds the
+        server model on that client's local test shard (reference
+        ``GKTServerTrainer`` tests on every client's uploaded test
+        features). Falls back to the global test set routed through every
+        extractor when local shards are absent."""
+        if self._eval_fn is None:
+            self._eval_fn = self._make_eval()
+        shards, sel = [], []
+        for i in range(self.n_clients):
+            d = self.test_data_local_dict.get(i)
+            if d is not None and len(d["y"]):
+                shards.append(d)
+                sel.append(i)
+        if not shards:
+            shards = [self.test_data_global] * self.n_clients
+            sel = list(range(self.n_clients))
+        packs = [pack_eval(d, self.args.batch_size) for d in shards]
+        S = max(p["mask"].shape[0] for p in packs)
+
+        def pad(p):
+            w = S - p["mask"].shape[0]
+            return {k: np.concatenate(
+                [v, np.zeros((w,) + v.shape[1:], v.dtype)]) if w else v
+                for k, v in p.items()}
+
+        data = jax.tree.map(lambda *xs: jnp.asarray(np.stack(xs)),
+                            *[pad(p) for p in packs])
+        states = jax.tree.map(lambda v: v[np.asarray(sel)],
+                              self.client_states)
+        m = jax.tree.map(np.asarray,
+                         self._eval_fn(states, self.server_state, data))
+        return {"Test/Acc": float(m["correct"] / max(m["count"], 1)),
+                "Test/Samples": float(m["count"]),
+                "Test/Correct": float(m["correct"])}
 
     def train(self):
         for _ in range(self.args.comm_round):

@@ -29,7 +29,7 @@ def _apply_model(model, state, x, rng, train):
 
 
 def make_classification_spec(model, example_x, num_classes=None,
-                             name="classification"):
+                             name="classification", augment_fn=None):
     """Softmax cross-entropy classification over ``[B, C]`` logits.
 
     Applying log_softmax to whatever the model emits reproduces the reference
@@ -37,6 +37,9 @@ def make_classification_spec(model, example_x, num_classes=None,
     ``lr.py:10-11``). Metrics are *sums* (loss-weighted, correct, count);
     divide on host -- matching the reference's test accumulation
     (``my_model_trainer_classification.py`` test loop).
+
+    ``augment_fn(x, rng)``: optional on-device train-time augmentation
+    (``fedml_tpu.data.augment``), applied per step inside client updates.
     """
 
     def init_fn(rng):
@@ -65,7 +68,7 @@ def make_classification_spec(model, example_x, num_classes=None,
         return metrics
 
     return TrainSpec(init_fn=init_fn, loss_fn=loss_fn, metrics_fn=metrics_fn,
-                     name=name)
+                     name=name, augment_fn=augment_fn)
 
 
 def make_seq_classification_spec(model, example_x, ignore_index=0,

@@ -60,8 +60,16 @@ class TrainSpec:
     loss_fn(state, batch, rng, train: bool) -> (loss, (new_model_state, metrics))
         ``batch`` is ``{"x","y","mask"}``; masked samples contribute zero.
     metrics_fn(state, batch) -> dict of summed metrics (e.g. correct-count)
+    augment_fn(x, rng) -> x
+        optional on-device train-time data augmentation, applied to each
+        batch inside ``client_update`` before the loss (the TPU-resident
+        replacement for the reference's torchvision transform pipeline,
+        ``fedml_api/data_preprocessing/cifar10/data_loader.py:57-76`` --
+        host dataloaders re-augment every epoch on CPU; here the raw shard
+        lives in HBM once and augmentation fuses into the step program).
     """
     init_fn: Callable[..., Any]
     loss_fn: Callable[..., Any]
     metrics_fn: Optional[Callable[..., Any]] = None
     name: str = "model"
+    augment_fn: Optional[Callable[..., Any]] = None

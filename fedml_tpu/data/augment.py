@@ -1,0 +1,84 @@
+"""On-device train-time augmentation (CIFAR recipe: crop / flip / Cutout).
+
+Reference behavior replaced
+(``fedml_api/data_preprocessing/cifar10/data_loader.py:57-76``, identical in
+cifar100/cinic10): torchvision ``RandomCrop(32, padding=4)`` +
+``RandomHorizontalFlip`` + normalize + ``Cutout(16)`` applied per-sample on
+the host dataloader every epoch. TPU design: shards are uploaded to HBM once
+already normalized; the random crop/flip/cutout run *inside* the jitted
+training step on the batch (``TrainSpec.augment_fn`` seam, applied by every
+``client_update`` variant in ``parallel/engine.py``), so augmentation fuses
+into the step program and adds zero host<->device traffic.
+
+All three transforms are shape-static: crop is a vmapped
+``dynamic_slice`` over a padded batch, flip a ``where`` on the reversed
+tensor, Cutout a coordinate-mask multiply (the clipped-box semantics of the
+reference's ``Cutout.__call__`` -- boxes shrink at the borders). Cutout runs
+after normalization in the reference pipeline, so zeroing normalized values
+here matches exactly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def make_cifar_augment(pad: int = 4, cutout_length: int = 16,
+                       hflip: bool = True, pad_fill=None):
+    """Build ``augment_fn(x, rng) -> x`` for ``[B, H, W, C]`` image batches.
+
+    ``pad=4`` random crop + horizontal flip (train transforms of every CIFAR
+    family loader in the reference) + ``Cutout(cutout_length)`` (the
+    reference applies it for cifar10/100/cinic10; pass 0 to disable).
+
+    ``pad_fill``: border value for the crop padding, in the space ``x``
+    lives in. The reference crops RAW pixels with black borders and
+    normalizes after, so pre-normalized shards must pass the normalized
+    black level ``(0 - mean) / std`` per channel (see
+    ``fedml_tpu.data.cifar.normalized_black``); the default 0.0 is correct
+    only for data whose zero already means black.
+    """
+    fill = None if pad_fill is None else jnp.asarray(pad_fill)
+
+    def augment(x, rng):
+        B, H, W, C = x.shape
+        k_crop_y, k_crop_x, k_flip, k_cut_y, k_cut_x = jax.random.split(rng, 5)
+
+        # RandomCrop(H, padding=pad): pad with the border fill, then
+        # per-sample offset crop. Padding runs in fill-shifted space so a
+        # per-channel fill works with a single zero-pad.
+        if pad:
+            xs = x if fill is None else x - fill.astype(x.dtype)
+            xp = jnp.pad(xs, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+            if fill is not None:
+                xp = xp + fill.astype(x.dtype)
+            oy = jax.random.randint(k_crop_y, (B,), 0, 2 * pad + 1)
+            ox = jax.random.randint(k_crop_x, (B,), 0, 2 * pad + 1)
+
+            def crop(img, oy, ox):
+                return jax.lax.dynamic_slice(img, (oy, ox, 0), (H, W, C))
+
+            x = jax.vmap(crop)(xp, oy, ox)
+
+        if hflip:
+            flip = jax.random.bernoulli(k_flip, 0.5, (B,))
+            x = jnp.where(flip[:, None, None, None], x[:, :, ::-1, :], x)
+
+        if cutout_length:
+            cy = jax.random.randint(k_cut_y, (B,), 0, H)
+            cx = jax.random.randint(k_cut_x, (B,), 0, W)
+            half = cutout_length // 2
+            y1, y2 = jnp.clip(cy - half, 0, H), jnp.clip(cy + half, 0, H)
+            x1, x2 = jnp.clip(cx - half, 0, W), jnp.clip(cx + half, 0, W)
+            ys = jnp.arange(H)[None, :, None]
+            xs = jnp.arange(W)[None, None, :]
+            inside = ((ys >= y1[:, None, None]) & (ys < y2[:, None, None]) &
+                      (xs >= x1[:, None, None]) & (xs < x2[:, None, None]))
+            x = x * (1.0 - inside[..., None].astype(x.dtype))
+        return x
+
+    return augment
+
+
+__all__ = ["make_cifar_augment"]

@@ -5,8 +5,10 @@ Parity: reference ``fedml_api/data_preprocessing/cifar10/data_loader.py:
 partitions over the pooled train set, per-channel normalization with the
 dataset's statistics. Raw data is read from the standard python pickle
 batches (cifar) or ``.npz`` dumps (cinic10); augmentation (random crop /
-flip / Cutout, reference ``:57-76``) runs on-device in the engine's
-augmentation hook rather than in the host loader.
+flip / Cutout, reference ``:57-76``) runs on-device via
+``fedml_tpu.data.augment.make_cifar_augment`` wired into
+``TrainSpec.augment_fn`` (see ``experiments/common.py make_spec``) rather
+than in the host loader.
 """
 
 from __future__ import annotations
@@ -25,6 +27,16 @@ _STATS = {
     "cifar100": ([0.5071, 0.4865, 0.4409], [0.2673, 0.2564, 0.2762], 100),
     "cinic10": ([0.4789, 0.4723, 0.4305], [0.2421, 0.2383, 0.2587], 10),
 }
+
+
+def normalized_black(dataset_name):
+    """Per-channel value of a BLACK pixel after this dataset's
+    normalization: ``(0 - mean) / std``. The reference's RandomCrop pads
+    raw pixels with black BEFORE normalize
+    (``data_loader.py:57-76``); shards here are stored normalized, so the
+    on-device crop must pad with this value to match."""
+    mean, std, _ = _STATS[dataset_name]
+    return [-m / s for m, s in zip(mean, std)]
 
 
 def _load_cifar10_raw(data_dir):

@@ -37,6 +37,13 @@ def _decode(path, image_size):
     return (x - IMAGENET_MEAN) / IMAGENET_STD
 
 
+# torchvision ImageFolder's accepted extensions (its loader is what the
+# reference wraps); non-image strays (.DS_Store, README, checksums) are
+# skipped instead of aborting the whole load at decode time
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp")
+
+
 def _scan_imagefolder(split_dir):
     """ImageFolder layout: ``<split>/<class_name>/<img>``; classes sorted."""
     classes = sorted(d for d in os.listdir(split_dir)
@@ -45,6 +52,8 @@ def _scan_imagefolder(split_dir):
     for ci, cname in enumerate(classes):
         cdir = os.path.join(split_dir, cname)
         for name in sorted(os.listdir(cdir)):
+            if not name.lower().endswith(IMG_EXTENSIONS):
+                continue
             paths.append(os.path.join(cdir, name))
             labels.append(ci)
     return paths, np.asarray(labels, np.int64), classes

@@ -48,10 +48,26 @@ def add_base_args(parser: argparse.ArgumentParser):
                    help="ignored (no GPU placement on TPU)")
     p.add_argument("--ci", type=int, default=0)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--data_augmentation", type=int, default=1,
+                   help="train-time crop/flip/Cutout for the CIFAR family "
+                        "(on-device; reference data_loader.py:57-76). "
+                        "Default on, matching the reference transforms; "
+                        "0 disables (CI equivalence runs)")
     # TPU-native controls
     p.add_argument("--mesh", type=int, default=0,
                    help="shard clients over an N-device mesh (0 = vmapped "
                         "single-device simulation)")
+    p.add_argument("--wave_mode", type=int, default=1,
+                   help="device-resident rounds: 1 = size-sorted waves "
+                        "with dynamic trip counts (default), 0 = flat "
+                        "single-program round (A/B / debugging)")
+    p.add_argument("--client_chunk", type=int, default=8,
+                   help="clients per concurrent wave on the device-"
+                        "resident path (HBM activation knob)")
+    p.add_argument("--device_resident", type=str, default="auto",
+                   help="auto | 0: keep client shards resident in HBM "
+                        "when they fit (single-chip path)")
+    p.add_argument("--device_data_cap_gb", type=float, default=2.0)
     p.add_argument("--run_dir", type=str, default=None,
                    help="metrics/summary output dir (wandb-summary analog)")
     p.add_argument("--enable_wandb", type=int, default=0)
@@ -127,7 +143,18 @@ def make_spec(args, model, dataset):
         return specs.make_seq_classification_spec(model, example_x)
     if name == "stackoverflow_lr":
         return specs.make_multilabel_spec(model, example_x)
-    return specs.make_classification_spec(model, example_x)
+    augment_fn = None
+    if (getattr(args, "data_augmentation", 0)
+            and name in ("cifar10", "cifar100", "cinic10")):
+        from fedml_tpu.data.augment import make_cifar_augment
+        from fedml_tpu.data.cifar import normalized_black
+        # crop/flip for all three; Cutout(16) as in the reference pipeline;
+        # crop borders filled with the normalized black level since shards
+        # are stored post-normalization
+        augment_fn = make_cifar_augment(pad=4, cutout_length=16,
+                                        pad_fill=normalized_black(name))
+    return specs.make_classification_spec(model, example_x,
+                                          augment_fn=augment_fn)
 
 
 def run_fedavg_family(api, args, logger):
@@ -144,7 +171,7 @@ def run_fedavg_family(api, args, logger):
         ckpt = Checkpointer(args.checkpoint_dir)
         ckpt.save_config(args)
         if args.resume:
-            saved = ckpt.restore()
+            saved = ckpt.restore(server_state_template=api.server_state)
             if saved is not None:
                 api.global_state = jax.tree.map(jnp.asarray,
                                                 saved["global_state"])

@@ -29,6 +29,11 @@ def _build(src, out):
     subprocess.run(cmd, check=True, capture_output=True, timeout=120)
 
 
+def native_available() -> bool:
+    """True iff the C++ shim is built and loadable on this machine."""
+    return load_native() is not None
+
+
 def load_native():
     """Return the ctypes library, building if needed; None when unavailable."""
     global _lib, _tried

@@ -104,6 +104,10 @@ def make_client_update(spec: TrainSpec, cfg: ClientUpdateConfig):
             params, rest, opt_state = carry
             batch, step_idx = xs
             step_rng = jax.random.fold_in(rng, step_idx)
+            if spec.augment_fn is not None:
+                batch = dict(batch)
+                batch["x"] = spec.augment_fn(
+                    batch["x"], jax.random.fold_in(step_rng, 13))
 
             def loss_wrapper(p):
                 state = dict(rest)
@@ -159,6 +163,9 @@ def make_indexed_client_update(spec: TrainSpec, cfg: ClientUpdateConfig):
                      "y": jnp.take(data["y"], idx_b, axis=0),
                      "mask": mask_b}
             step_rng = jax.random.fold_in(rng, step_idx)
+            if spec.augment_fn is not None:
+                batch["x"] = spec.augment_fn(
+                    batch["x"], jax.random.fold_in(step_rng, 13))
 
             def loss_wrapper(p):
                 state = dict(rest)
@@ -186,6 +193,222 @@ def make_indexed_client_update(spec: TrainSpec, cfg: ClientUpdateConfig):
         return local_state, aux, metrics_sum
 
     return client_update
+
+
+def make_loop_client_update(spec: TrainSpec, cfg: ClientUpdateConfig):
+    """Per-client local training as a ``fori_loop`` with a DYNAMIC trip count.
+
+    ``fn(global_state, data, sched, steps, rng) -> (local_state, aux,
+    metrics_sum)``. Unlike :func:`make_indexed_client_update`'s fixed-length
+    ``scan``, the step loop runs exactly ``steps`` iterations where ``steps``
+    is a *traced scalar* -- so one compiled program serves every wave length,
+    and steps past a wave's true maximum are never executed at all (instead
+    of executing fully-masked fwd+bwd no-ops). Metrics accumulate as running
+    sums in the carry; schedule rows are fetched with ``dynamic_index_in_dim``.
+    """
+    optimizer = make_optimizer(cfg)
+
+    def client_update(global_state, data, sched, steps, rng):
+        params, rest = _split_state(global_state)
+        opt_state = optimizer.init(params)
+
+        def batch_at(i):
+            idx_b = jax.lax.dynamic_index_in_dim(
+                sched["idx"], i, axis=0, keepdims=False)
+            mask_b = jax.lax.dynamic_index_in_dim(
+                sched["mask"], i, axis=0, keepdims=False)
+            return {"x": jnp.take(data["x"], idx_b, axis=0),
+                    "y": jnp.take(data["y"], idx_b, axis=0),
+                    "mask": mask_b}
+
+        def grad_at(params, rest, batch, step_rng):
+            if spec.augment_fn is not None:
+                batch = dict(batch)
+                batch["x"] = spec.augment_fn(
+                    batch["x"], jax.random.fold_in(step_rng, 13))
+
+            def loss_wrapper(p):
+                state = dict(rest)
+                state["params"] = p
+                return spec.loss_fn(state, batch, step_rng, True)
+
+            return jax.value_and_grad(loss_wrapper, has_aux=True)(params)
+
+        # metric-structure discovery: abstract-eval one step, carry zeros
+        metrics0 = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype),
+            jax.eval_shape(
+                lambda: grad_at(params, rest, batch_at(0), rng))[0][1][1])
+
+        def body(i, carry):
+            params, rest, opt_state, msum = carry
+            batch = batch_at(i)
+            step_rng = jax.random.fold_in(rng, i)
+            (_, (new_state, metrics)), grads = grad_at(
+                params, rest, batch, step_rng)
+            updates, new_opt = optimizer.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            new_rest = {k: new_state[k] for k in rest}
+            valid = jnp.sum(batch["mask"]) > 0
+            params, rest, opt_state = _tree_select(
+                valid, (new_params, new_rest, new_opt),
+                (params, rest, opt_state))
+            msum = jax.tree.map(jnp.add, msum, metrics)
+            return (params, rest, opt_state, msum)
+
+        params, rest, _, msum = jax.lax.fori_loop(
+            0, steps, body, (params, rest, opt_state, metrics0))
+        local_state = dict(rest)
+        local_state["params"] = params
+        steps_done = jnp.sum(jnp.any(sched["mask"] > 0, axis=-1))
+        aux = {"n": sched["n"], "steps": steps_done}
+        return local_state, aux, msum
+
+    return client_update
+
+
+class WaveRunner:
+    """Size-sorted wave execution of a federated round over device-resident
+    data -- the throughput path for single-chip cohorts.
+
+    The flat ``make_indexed_sim_round`` pads every client to the cohort-max
+    step count, so under a skewed LDA partition most clients burn most steps
+    on fully-masked fwd+bwd no-ops. Here the cohort is sorted by true step
+    count and dispatched in waves of ``client_chunk`` clients; each wave runs
+    one jitted program whose ``fori_loop`` trip count is the *wave* maximum
+    (a traced scalar -- no recompilation across waves or rounds). Weighted
+    payload sums accumulate on device across waves; a final jitted step
+    normalizes and applies ``server_fn``. Total executed steps drop from
+    ``C x S_max`` to ``sum_w k x S_w`` -- the padding-waste fix for the
+    reference's straggler problem (its MPI path simply blocks on the slowest
+    client process, ``FedAVGAggregator.py:58-87``).
+
+    Consumes the SAME ``pack_schedule`` output (same host-RNG draw) as the
+    flat path, so switching paths never perturbs the data stream, and
+    checkpoints resume across either.
+    """
+
+    def __init__(self, spec: TrainSpec, cfg: ClientUpdateConfig,
+                 payload_fn=None, server_fn=None, client_chunk=8):
+        self.payload_fn = payload_fn or _default_payload
+        self.server_fn = server_fn or _default_server
+        self.client_chunk = int(client_chunk or 8)
+        client_update = make_loop_client_update(spec, cfg)
+        payload_fn_ = self.payload_fn
+        server_fn_ = self.server_fn
+
+        @jax.jit
+        def wave_fn(global_state, device_x, device_y, ids, sched, steps, rngs):
+            data = {"x": jnp.take(device_x, ids, axis=0),
+                    "y": jnp.take(device_y, ids, axis=0)}
+            local_states, aux, metrics = jax.vmap(
+                client_update, in_axes=(None, 0, 0, None, 0))(
+                    global_state, data, sched, steps, rngs)
+            payloads = jax.vmap(payload_fn_, in_axes=(0, None, 0))(
+                local_states, global_state, aux)
+            w = aux["n"].astype(jnp.float32)
+            pay_sum = jax.tree.map(
+                lambda x: jnp.tensordot(w, x.astype(jnp.float32), axes=(0, 0)),
+                payloads)
+            metrics_sum = jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics)
+            return pay_sum, jnp.sum(w), metrics_sum, aux
+
+        @jax.jit
+        def add_fn(a, b):
+            return jax.tree.map(jnp.add, a, b)
+
+        @jax.jit
+        def finish_fn(global_state, server_state, pay_sum, w_sum, dtypes, rng):
+            # weighted mean over the accumulated sums. NOTE: unlike
+            # pytree.tree_weighted_mean there is no uniform fallback here --
+            # an all-empty cohort (w_sum == 0) yields a zero payload, so
+            # callers MUST fail fast on empty cohorts before dispatch
+            # (FedAvgAPI.train_one_round raises; direct users take note)
+            avg = jax.tree.map(
+                lambda s, d: (s / jnp.maximum(w_sum, 1e-12)).astype(d.dtype),
+                pay_sum, dtypes)
+            return server_fn_(global_state, avg, server_state, rng)
+
+        self._wave_fn = wave_fn
+        self._add_fn = add_fn
+        self._finish_fn = finish_fn
+        self._dtypes = None
+
+    def _payload_dtypes(self, global_state):
+        if self._dtypes is None:
+            aux = {"n": jax.ShapeDtypeStruct((), jnp.float32),
+                   "steps": jax.ShapeDtypeStruct((), jnp.int32)}
+            shapes = jax.eval_shape(self.payload_fn, global_state,
+                                    global_state, aux)
+            self._dtypes = jax.tree.map(
+                lambda s: jnp.zeros((), s.dtype), shapes)
+        return self._dtypes
+
+    def run_round(self, global_state, server_state, device_data, ids, sched,
+                  rng):
+        """One federated round.
+
+        Args:
+          device_data: ``{"x": [N_rows, ...], "y": [N_rows, ...]}`` full
+            client shards resident in HBM (``stack_clients`` output).
+          ids: cohort client rows into ``device_data`` (cohort order).
+          sched: full packed schedule (``pack_schedule`` output, numpy,
+            cohort order) -- ``{"idx" [C,S,B], "mask" [C,S,B], "n" [C]}``.
+          rng: round PRNG key; per-client keys derive exactly as in the flat
+            paths (``split(fold_in(rng, 1), C)`` indexed by cohort slot), so
+            wave and flat trajectories agree to float reassociation.
+        """
+        import numpy as np
+
+        mask = np.asarray(sched["mask"])
+        C = mask.shape[0]
+        steps_per_client = (mask.sum(axis=2) > 0).sum(axis=1).astype(np.int64)
+        order = np.argsort(-steps_per_client, kind="stable")
+        chunk = min(self.client_chunk, C)
+        all_rngs = np.asarray(jax.random.split(jax.random.fold_in(rng, 1), C))
+        ids = np.asarray(ids, np.int32)
+        sched_idx = np.asarray(sched["idx"])
+        sched_n = np.asarray(sched["n"], np.float32)
+
+        acc = None
+        wave_aux, wave_pos = [], []
+        for w0 in range(0, C, chunk):
+            pos = order[w0:w0 + chunk]
+            k = len(pos)
+            trip = int(steps_per_client[pos].max())
+            w_idx, w_mask = sched_idx[pos], mask[pos]
+            w_n, w_ids, w_rngs = sched_n[pos], ids[pos], all_rngs[pos]
+            if k < chunk:  # pad the ragged last wave -> one stable jit shape
+                pad = chunk - k
+                zpad = lambda a: np.concatenate(
+                    [a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+                w_idx, w_mask, w_n = zpad(w_idx), zpad(w_mask), zpad(w_n)
+                w_ids = np.concatenate([w_ids, np.zeros(pad, w_ids.dtype)])
+                w_rngs = np.concatenate([w_rngs, w_rngs[:1].repeat(pad, 0)])
+            ws = {"idx": jnp.asarray(w_idx), "mask": jnp.asarray(w_mask),
+                  "n": jnp.asarray(w_n)}
+            pay_sum, w_sum, metrics_sum, aux = self._wave_fn(
+                global_state, device_data["x"], device_data["y"],
+                jnp.asarray(w_ids), ws, jnp.int32(trip), jnp.asarray(w_rngs))
+            part = (pay_sum, w_sum, metrics_sum)
+            acc = part if acc is None else self._add_fn(acc, part)
+            wave_aux.append(aux)
+            wave_pos.append(pos)
+
+        pay_sum, w_sum, metrics_sum = acc
+        new_global, new_server_state = self._finish_fn(
+            global_state, server_state, pay_sum, w_sum,
+            self._payload_dtypes(global_state), jax.random.fold_in(rng, 2))
+
+        # gather per-client aux back into cohort order (host, post-dispatch)
+        aux_out = {"n": np.zeros(C, np.float32),
+                   "steps": np.zeros(C, np.int64)}
+        for pos, aux in zip(wave_pos, wave_aux):
+            k = len(pos)
+            aux_out["n"][pos] = np.asarray(aux["n"])[:k]
+            aux_out["steps"][pos] = np.asarray(aux["steps"])[:k]
+        return new_global, new_server_state, {"aux": aux_out,
+                                              "metrics": metrics_sum}
 
 
 def make_indexed_sim_round(spec: TrainSpec, cfg: ClientUpdateConfig,

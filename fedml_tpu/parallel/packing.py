@@ -21,6 +21,30 @@ import os
 import numpy as np
 
 
+def packing_backend(native="auto") -> str:
+    """Resolve which schedule generator runs: ``"native"`` (C++ shim) or
+    ``"python"`` (numpy).
+
+    The choice is EXPLICIT and machine-stable: ``auto`` means "native iff
+    the shim built/loaded", overridable by the ``FEDML_TPU_PACKING`` env var
+    or a ``native=True/False`` argument -- never by ``os.cpu_count()`` (a
+    round-1 advisor finding: a load-dependent gate made shuffle
+    realizations machine-dependent in a way nothing recorded). The resolved
+    name is checkpointed alongside the data-RNG state so resume detects a
+    backend switch instead of silently changing schedules (the two
+    backends use different PRNG families).
+    """
+    if native is True:
+        return "native"
+    if native is False:
+        return "python"
+    env = os.environ.get("FEDML_TPU_PACKING", "auto").lower()
+    if env in ("native", "python"):
+        return env
+    from fedml_tpu.native import native_available
+    return "native" if native_available() else "python"
+
+
 def _per_epoch_steps(n, batch_size, drop_last=False):
     per_epoch = n // batch_size if drop_last else math.ceil(n / batch_size)
     return max(1, per_epoch)
@@ -63,16 +87,12 @@ def pack_cohort(client_datasets, batch_size, epochs, rng=None, drop_last=False,
 
     # Exactly ONE draw from the caller's generator regardless of which
     # implementation runs below: the checkpointable host stream advances
-    # identically on every machine (native or python, any core count), so
-    # cross-machine resume keeps a consistent RNG trajectory. (Shuffle
-    # *realizations* differ between the native and python PRNGs; the
-    # native gate is per-machine-stable, so same-machine resume is exact.)
+    # identically everywhere, so resume keeps a consistent RNG trajectory.
+    # (Shuffle *realizations* differ between the native and python PRNG
+    # families; ``packing_backend`` makes the choice explicit and
+    # checkpoint-verified rather than machine-load-dependent.)
     seed = int(rng.integers(0, 2 ** 63 - 1))
-    use_native = native is True or (
-        # the threaded gather only beats numpy's fancy indexing when there
-        # are cores to spread it over
-        native == "auto" and (os.cpu_count() or 1) >= 4)
-    if use_native and not drop_last:
+    if packing_backend(native) == "native" and not drop_last:
         from fedml_tpu.native import native_pack_cohort
         out = native_pack_cohort(client_datasets, batch_size, epochs, S, seed)
         if out is not None:
@@ -159,14 +179,12 @@ def pack_schedule(ns, batch_size, epochs, rng=None, drop_last=False,
     S = int(math.ceil(S / step_bucket) * step_bucket)
     B = batch_size
 
-    # one-draw contract and native gate identical to pack_cohort's, so the
-    # two functions consume the host RNG the same way and produce the same
-    # schedules on a given machine -- keeping schedule-equality invariants
-    # (hierarchical 1-group == fedavg) across data paths
+    # one-draw contract and backend resolution identical to pack_cohort's,
+    # so the two functions consume the host RNG the same way and produce
+    # the same schedules on a given machine -- keeping schedule-equality
+    # invariants (hierarchical 1-group == fedavg) across data paths
     seed = int(rng.integers(0, 2 ** 63 - 1))
-    use_native = native is True or (
-        native == "auto" and (os.cpu_count() or 1) >= 4)
-    if use_native and not drop_last:
+    if packing_backend(native) == "native" and not drop_last:
         from fedml_tpu.native import native_pack_schedule
         out = native_pack_schedule(ns, B, epochs, S, seed)
         if out is not None:

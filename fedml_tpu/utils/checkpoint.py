@@ -20,6 +20,8 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
+_NO_TEMPLATE = object()  # sentinel: "caller supplied no template"
+
 
 class Checkpointer:
     """Orbax-backed checkpoint manager with Saver-parity extras."""
@@ -51,7 +53,10 @@ class Checkpointer:
 
         ``data_rng`` is the host-side ``np.random.Generator`` feeding batch
         shuffles; its bit-generator state rides along so resume restores the
-        data stream in O(1) with no cohort replay."""
+        data stream in O(1) with no cohort replay. The resolved packing
+        backend (native C++ vs numpy -- different shuffle PRNG families)
+        rides too, so restore can detect a backend switch."""
+        from fedml_tpu.parallel.packing import packing_backend
         payload = {
             "global_state": global_state,
             "server_state": _pack_aux(server_state),
@@ -60,6 +65,7 @@ class Checkpointer:
             "round_idx": np.asarray(round_idx),
             "data_rng_state": _encode_json(
                 data_rng.bit_generator.state if data_rng is not None else None),
+            "packing_backend": _encode_json(packing_backend()),
         }
         metrics = {"metric": float(metric)} if metric is not None else None
         saved = self._mgr.save(
@@ -69,10 +75,19 @@ class Checkpointer:
             self._update_best(round_idx, metric)
         return saved
 
-    def restore(self, round_idx: Optional[int] = None) -> Optional[dict]:
+    def restore(self, round_idx: Optional[int] = None,
+                server_state_template=_NO_TEMPLATE) -> Optional[dict]:
         """Restore a round (latest if None). Returns
         ``{"global_state","server_state","rng","round_idx"}`` or None when
-        the directory has no checkpoints (fresh start)."""
+        the directory has no checkpoints (fresh start).
+
+        ``server_state_template``: a pytree with the expected server-state
+        structure (e.g. the API's freshly-initialized ``server_state``).
+        Required when the saved state has a custom pytree structure (optax
+        namedtuple states); simple containers (dict/list/tuple/None)
+        restore without it. Structure is rebuilt from a JSON description --
+        never unpickled -- so a tampered checkpoint directory cannot
+        execute code at restore time."""
         self._mgr.wait_until_finished()
         step = round_idx if round_idx is not None else self._mgr.latest_step()
         if step is None:
@@ -84,13 +99,24 @@ class Checkpointer:
         if rng_state is not None:
             data_rng = np.random.default_rng()
             data_rng.bit_generator.state = rng_state
+        from fedml_tpu.parallel.packing import packing_backend
+        saved_backend = _decode_json(payload.get("packing_backend"))
+        if saved_backend is not None and saved_backend != packing_backend():
+            import logging
+            logging.warning(
+                "checkpoint was written with packing_backend=%s but this "
+                "machine resolves %s: batch shuffles will differ after "
+                "resume (set FEDML_TPU_PACKING=%s to match)",
+                saved_backend, packing_backend(), saved_backend)
         return {
             "global_state": payload["global_state"],
-            "server_state": _unpack_aux(payload["server_state"]),
+            "server_state": _unpack_aux(payload["server_state"],
+                                        server_state_template),
             "rng": (jax.numpy.asarray(payload["rng"], dtype=jax.numpy.uint32)
                     if has_rng else None),
             "round_idx": int(np.asarray(payload["round_idx"])),
             "data_rng": data_rng,
+            "packing_backend": saved_backend,
         }
 
     def latest_round(self) -> Optional[int]:
@@ -129,27 +155,87 @@ class Checkpointer:
         self._mgr.close()
 
 
+def _encode_structure(tree):
+    """JSON-able structural description of a pytree, leaf slots numbered in
+    ``jax.tree.flatten`` order. Custom registered nodes (optax namedtuple
+    states etc.) are marked opaque -- they restore only via a caller-supplied
+    template. Replaces the earlier pickled-treedef codec: unpickling a
+    treedef from a shared checkpoint dir was an arbitrary-code-execution
+    hole (round-1 advisor finding)."""
+    import itertools
+
+    counter = itertools.count()
+    opaque = [False]
+
+    def enc(node):
+        if node is None:
+            return {"t": "none"}
+        if jax.tree_util.all_leaves([node]):
+            return {"t": "leaf", "i": next(counter)}
+        if isinstance(node, dict) and type(node) is dict:
+            keys = sorted(node)  # jax flattens dicts in sorted-key order
+            return {"t": "dict", "k": list(keys),
+                    "c": [enc(node[k]) for k in keys]}
+        if type(node) is list:
+            return {"t": "list", "c": [enc(v) for v in node]}
+        if type(node) is tuple:
+            return {"t": "tuple", "c": [enc(v) for v in node]}
+        opaque[0] = True
+        return {"t": "opaque", "cls": type(node).__name__}
+
+    return enc(tree), opaque[0]
+
+
+def _decode_structure(enc, leaves):
+    def dec(d):
+        t = d["t"]
+        if t == "none":
+            return None
+        if t == "leaf":
+            return leaves[d["i"]]
+        if t == "dict":
+            return {k: dec(c) for k, c in zip(d["k"], d["c"])}
+        if t == "list":
+            return [dec(c) for c in d["c"]]
+        if t == "tuple":
+            return tuple(dec(c) for c in d["c"])
+        raise ValueError(f"opaque pytree node {d.get('cls')}")
+    return dec(enc)
+
+
 def _pack_aux(tree) -> dict:
     """Orbax needs non-empty array pytrees; arbitrary aux state (possibly an
-    empty tuple) rides as leaves + treedef-repr pair."""
+    empty tuple) rides as numbered leaves + a JSON structure description
+    (no pickle anywhere in the checkpoint codec)."""
     leaves, treedef = jax.tree.flatten(tree)
+    enc, opaque = _encode_structure(tree)
     return {"leaves": {str(i): leaf for i, leaf in enumerate(leaves)},
             "n": np.asarray(len(leaves)),
-            "_treedef": np.frombuffer(
-                _treedef_bytes(treedef), dtype=np.uint8).copy()}
+            "_structure": _encode_json(
+                {"repr": str(treedef), "enc": enc, "opaque": opaque})}
 
 
-def _unpack_aux(packed):
-    import pickle
+def _unpack_aux(packed, template=_NO_TEMPLATE):
     n = int(np.asarray(packed["n"]))
     leaves = [packed["leaves"][str(i)] for i in range(n)]
-    treedef = pickle.loads(np.asarray(packed["_treedef"]).tobytes())
-    return jax.tree.unflatten(treedef, leaves)
-
-
-def _treedef_bytes(treedef):
-    import pickle
-    return pickle.dumps(treedef)
+    if "_structure" not in packed:
+        raise ValueError(
+            "checkpoint uses the old pickled-treedef codec; refusing to "
+            "unpickle (re-save with this version, or restore leaves "
+            "manually)")
+    meta = _decode_json(packed["_structure"])
+    if template is not _NO_TEMPLATE:
+        treedef = jax.tree.structure(template)
+        if str(treedef) != meta["repr"]:
+            raise ValueError(
+                f"server_state_template structure {treedef} does not match "
+                f"checkpointed structure {meta['repr']}")
+        return jax.tree.unflatten(treedef, leaves)
+    if not meta["opaque"]:
+        return _decode_structure(meta["enc"], leaves)
+    raise ValueError(
+        "checkpointed server_state contains custom pytree nodes "
+        f"({meta['repr']}); pass server_state_template= to restore()")
 
 
 def _encode_json(obj) -> np.ndarray:

@@ -191,6 +191,17 @@ class TestImageFolder:
         with pytest.raises(FileNotFoundError):
             imagefolder.load_imagenet_federated(str(tmp_path))
 
+    def test_stray_non_image_files_skipped(self, tmp_path):
+        """A .DS_Store / README / checksum file in a class dir must be
+        ignored, not abort the load (round-1 advisor finding)."""
+        root = self._imagenet_tree(tmp_path)
+        (root / "train" / "n01440764" / ".DS_Store").write_bytes(b"\x00junk")
+        (root / "train" / "n01440764" / "README.txt").write_text("notes")
+        (root / "val" / "n01443537" / "checksums.md5").write_text("abc")
+        ds = imagefolder.load_imagenet_federated(
+            str(root), client_num=2, partition="homo", image_size=8)
+        assert ds[0] == 12 and ds[1] == 12  # counts unchanged by strays
+
     def test_landmarks_csv_split(self, tmp_path):
         img_dir = tmp_path / "images"
         img_dir.mkdir()

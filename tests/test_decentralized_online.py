@@ -39,6 +39,53 @@ def test_second_half_beats_first_half():
     assert losses[T // 2:].mean() < losses[:T // 2].mean()
 
 
+def test_regret_matches_cal_regret_normalization():
+    """Online/Regret must equal cumulative loss / (N * T) -- the reference
+    ``cal_regret`` (decentralized_fl_api.py:11-17) at the final step."""
+    streams = uci.load_synthetic_stream(client_num=4, T=200, d=8, seed=2)
+    api = DecentralizedOnlineAPI(streams, _args(), algorithm="dsgd")
+    api.train()
+    assert np.isclose(api.history["Online/Regret"],
+                      api.history["Online/AvgLoss"], rtol=1e-6)
+    assert api.history["Online/Regret"] < 5.0  # per-step scale, not summed
+
+
+def test_dsgd_push_mixing_is_column_application():
+    """The streaming reference gossips push-style: receiver j sums
+    SENDER-row weights -- x' = W^T x (client_dsgd.py:78-103, topo_weight
+    is the sender's row entry). One API step from w0=0 must equal the
+    numpy replication with W^T, and differ from row mixing when W is
+    asymmetric (row-normalized, non-uniform degrees)."""
+    from fedml_tpu.core.topology import SymmetricTopologyManager
+
+    streams = uci.load_synthetic_stream(client_num=3, T=2, d=4, seed=3)
+
+    class FixedTopo(SymmetricTopologyManager):
+        def generate_topology(self):
+            # symmetric support, non-uniform degrees -> row-normalized W
+            # is ASYMMETRIC, so W @ x != W.T @ x
+            support = np.array([[1, 1, 1], [1, 1, 0], [1, 0, 1]], np.float32)
+            self.topology = support / support.sum(1, keepdims=True)
+            return self.topology
+
+    api = DecentralizedOnlineAPI(streams, _args(lr=0.5),
+                                 topology=FixedTopo(3), algorithm="dsgd")
+    api.train()
+    W = np.asarray(api.W)
+
+    # numpy replication: predict-then-update, push mixing
+    w = np.zeros((3, 4), np.float32)
+    x = np.asarray(np.stack([streams[i]["x"][:2] for i in range(3)]))
+    y = np.asarray(np.stack([streams[i]["y"][:2] for i in range(3)]))
+    for t in range(2):
+        logits = (w * x[:, t]).sum(1)
+        probs = 1 / (1 + np.exp(-logits))
+        grad = (probs - y[:, t])[:, None] * x[:, t]
+        w = W.T @ (w - 0.5 * grad)
+    np.testing.assert_allclose(api.w, w, rtol=1e-4, atol=1e-5)
+    assert not np.allclose(W, W.T)  # the test would be vacuous otherwise
+
+
 def test_pushsum_directed_reaches_consensus():
     streams = uci.load_synthetic_stream(client_num=5, T=300, d=6, seed=2)
     api = DecentralizedOnlineAPI(streams, _args(lr=0.2),

@@ -89,6 +89,74 @@ class TestFedGKT:
         ev = api.evaluate()
         assert 0.0 <= ev["Test/Acc"] <= 1.0
 
+    def test_gkt_server_phase_shards_over_model_axis(self):
+        """mesh(1, 8): server training batch splits over the ``model`` axis
+        with psum'd grads. With a BN-free server model the sharded phase
+        must match the unsharded one numerically (exact DataParallel grad
+        parity; VERDICT round-2 item 7). BN models shard too but -- as with
+        torch DataParallel -- normalize per shard, so only the BN-free case
+        admits an equality oracle."""
+        import flax.linen as nn
+        from fedml_tpu.parallel.mesh import make_client_mesh
+
+        class MLPServer(nn.Module):
+            num_classes: int = 10
+
+            @nn.compact
+            def __call__(self, feats, train=False):
+                x = feats.reshape((feats.shape[0], -1))
+                x = nn.relu(nn.Dense(32)(x))
+                return nn.Dense(self.num_classes)(x)
+
+        ds = load_synthetic_images(client_num=2, n_train=64, n_test=32,
+                                   image_size=8, seed=0)
+        mesh = make_client_mesh(1, 8)
+        plain = FedGKTAPI(ds, resnet5_56(class_num=10), MLPServer(),
+                          _args(batch_size=8, epochs=1))
+        shard = FedGKTAPI(ds, resnet5_56(class_num=10), MLPServer(),
+                          _args(batch_size=8, epochs=1), mesh=mesh)
+        assert shard.mesh is not None
+        m_p = plain.train_one_round()
+        m_s = shard.train_one_round()
+        np.testing.assert_allclose(m_p["Train/Loss"], m_s["Train/Loss"],
+                                   rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(plain.server_state["params"]),
+                        jax.tree.leaves(shard.server_state["params"])):
+            # psum reassociation: tiny float drift, no structural divergence
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4)
+        # BN server model: shards run and evaluate (per-shard statistics)
+        bn = FedGKTAPI(ds, resnet5_56(class_num=10),
+                       GKTServerResNet(n=1, num_classes=10),
+                       _args(batch_size=8, epochs=1), mesh=mesh)
+        bn.train_one_round()
+        ev = bn.evaluate()
+        assert 0.0 <= ev["Test/Acc"] <= 1.0
+
+    def test_gkt_eval_uses_every_clients_extractor(self):
+        """evaluate() must route each client's local test shard through
+        that client's own edge model (one jitted program), not client 0
+        only (VERDICT round-2 item 7)."""
+        ds = load_synthetic_images(client_num=3, n_train=96, n_test=48,
+                                   image_size=8, seed=1)
+        api = FedGKTAPI(ds, resnet5_56(class_num=10),
+                        GKTServerResNet(n=1, num_classes=10),
+                        _args(batch_size=8, epochs=2, lr=0.1))
+        for _ in range(5):  # enough rounds that predictions are not a
+            api.train_one_round()  # constant class (which would make the
+        base = api.evaluate()      # perturbation check below vacuous)
+        # every client's local test shard is scored, not one global pass
+        assert base["Test/Samples"] == sum(
+            len(ds[6][i]["y"]) for i in range(3))
+        # zeroing client 2's extractor must change the combined pipeline's
+        # predictions (a client-0-only eval is invariant to this);
+        # deterministic under fixed seeds
+        api.client_states = jax.tree.map(
+            lambda v: v.at[2].set(jnp.zeros_like(v[2])), api.client_states)
+        moved = api.evaluate()
+        assert moved["Test/Correct"] != base["Test/Correct"]
+        assert moved["Test/Samples"] == base["Test/Samples"]
+
     def test_gkt_models_shapes(self):
         x = jnp.zeros((2, 32, 32, 3))
         for maker, blocks in ((resnet5_56, 1), (resnet8_56, 2)):
