@@ -19,7 +19,7 @@ from fedml_tpu.core.trainer import TrainSpec
 from fedml_tpu.parallel.engine import (
     ClientUpdateConfig, WaveRunner, make_indexed_sim_round, make_sim_round,
     make_sharded_round, make_eval_fn)
-from fedml_tpu.parallel.mesh import shard_cohort
+from fedml_tpu.parallel.mesh import shard_cohort  # noqa: F401 (re-export)
 from fedml_tpu.parallel.packing import (
     pack_cohort, pack_eval, pack_schedule, stack_clients)
 
@@ -133,7 +133,10 @@ class FedAvgAPI:
         packed = pack_cohort(datasets, self.args.batch_size, self.args.epochs,
                              rng=self._data_rng)
         if self.mesh is not None:
-            packed = shard_cohort(self.mesh, packed)
+            # multi-host: every process packed the identical cohort (same
+            # seeded RNG stream); each contributes its local shards
+            from fedml_tpu.parallel.multihost import global_cohort
+            packed = global_cohort(self.mesh, packed)
         return client_indexes, packed
 
     def train_one_round(self):
@@ -170,7 +173,8 @@ class FedAvgAPI:
                 self.global_state, self.server_state, packed, round_rng)
         jax.block_until_ready(self.global_state)
         dt = time.time() - t0
-        m = jax.tree.map(np.asarray, info["metrics"])
+        from fedml_tpu.parallel.multihost import gather_metrics
+        m = gather_metrics(info["metrics"])
         self._last_metrics = m  # full summed-metrics pytree for subclasses
         train_metrics = {
             "round": self.round_idx,

@@ -88,17 +88,36 @@ def add_base_args(parser: argparse.ArgumentParser):
 
 def setup(args, run_name=None):
     """Logging + seeds + metrics sink (reference ``main_fedavg.py:281-313``:
-    proctitle, logging format, wandb init on rank 0, fixed seeds)."""
+    proctitle, logging format, wandb init on rank 0, fixed seeds). Also
+    brings up ``jax.distributed`` when the multi-host env vars are set
+    (``FEDML_TPU_COORDINATOR`` et al. -- the mpirun-hostfile analog,
+    SURVEY.md section 2.8); metrics sink writes on process 0 only, as the
+    reference inits wandb on rank 0."""
+    from fedml_tpu.parallel.multihost import (
+        is_primary, maybe_initialize_distributed)
     from fedml_tpu.utils import MetricsLogger, init_logging
 
+    proc, nproc = maybe_initialize_distributed()
     init_logging(proctitle=run_name)
-    logging.info("args = %s", vars(args))
+    logging.info("args = %s (process %d/%d)", vars(args), proc, nproc)
     random.seed(args.seed)
     np.random.seed(args.seed)
+    if not is_primary():
+        return _LogOnlySink()  # rank>0: no files; same call/close surface
     logger = MetricsLogger(
         run_dir=args.run_dir, enable_wandb=bool(args.enable_wandb),
         run_name=run_name, config=args)
     return logger
+
+
+class _LogOnlySink:
+    """Non-primary metrics sink: MetricsLogger call surface, no files."""
+
+    def __call__(self, d):
+        logging.info("%s", d)
+
+    def close(self, *a, **kw):
+        return None
 
 
 def make_mesh(args):
@@ -166,11 +185,17 @@ def run_fedavg_family(api, args, logger):
     import jax.numpy as jnp
     from fedml_tpu.utils import Checkpointer, profile_trace
 
+    from fedml_tpu.parallel.multihost import is_primary, sync
+
+    # EVERY process restores (round_idx / RNG streams / states must agree
+    # across ranks or the SPMD schedules diverge); only process 0 SAVES.
     ckpt = None
     if args.checkpoint_dir:
         ckpt = Checkpointer(args.checkpoint_dir)
-        ckpt.save_config(args)
+        if is_primary():
+            ckpt.save_config(args)
         if args.resume:
+            sync("pre-restore")  # saves from a prior run are fully flushed
             saved = ckpt.restore(server_state_template=api.server_state)
             if saved is not None:
                 api.global_state = jax.tree.map(jnp.asarray,
@@ -185,10 +210,15 @@ def run_fedavg_family(api, args, logger):
 
     def on_round(api_, metrics):
         last = api_.round_idx == args.comm_round
-        if ckpt is not None and (api_.round_idx % args.save_frequency == 0
-                                 or last):
-            ckpt.save(api_.round_idx, api_.global_state,
-                      server_state=api_.server_state, rng=api_.rng,
+        if (ckpt is not None and is_primary()
+                and (api_.round_idx % args.save_frequency == 0 or last)):
+            # the round's outputs are replicated pytrees, so EVERYTHING in
+            # the payload converts to host numpy locally -- a primary-only
+            # save never needs a cross-process orbax collective
+            to_np = lambda t: jax.tree.map(np.asarray, t)
+            ckpt.save(api_.round_idx, to_np(api_.global_state),
+                      server_state=to_np(api_.server_state),
+                      rng=np.asarray(api_.rng),
                       metric=metrics.get(
                           getattr(api_, "checkpoint_metric", "Test/Acc")),
                       data_rng=api_._data_rng)

@@ -38,7 +38,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from fedml_tpu.core import pytree
 from fedml_tpu.core.trainer import TrainSpec
-from fedml_tpu.parallel.mesh import CLIENT_AXIS
+from fedml_tpu.parallel.mesh import CLIENT_AXIS, zero_pad_leading
 
 
 @dataclasses.dataclass(frozen=True)
@@ -380,10 +380,9 @@ class WaveRunner:
             w_n, w_ids, w_rngs = sched_n[pos], ids[pos], all_rngs[pos]
             if k < chunk:  # pad the ragged last wave -> one stable jit shape
                 pad = chunk - k
-                zpad = lambda a: np.concatenate(
-                    [a, np.zeros((pad,) + a.shape[1:], a.dtype)])
-                w_idx, w_mask, w_n = zpad(w_idx), zpad(w_mask), zpad(w_n)
-                w_ids = np.concatenate([w_ids, np.zeros(pad, w_ids.dtype)])
+                from fedml_tpu.parallel.mesh import zero_pad_leading
+                w_idx, w_mask, w_n, w_ids = zero_pad_leading(
+                    (w_idx, w_mask, w_n, w_ids), pad)
                 w_rngs = np.concatenate([w_rngs, w_rngs[:1].repeat(pad, 0)])
             ws = {"idx": jnp.asarray(w_idx), "mask": jnp.asarray(w_mask),
                   "n": jnp.asarray(w_n)}
@@ -439,14 +438,12 @@ def make_indexed_sim_round(spec: TrainSpec, cfg: ClientUpdateConfig,
         chunk = client_chunk
         if chunk is not None and chunk < C:
             # pad the cohort to a chunk multiple with fully-masked dummy
-            # clients (n=0, zero weight) so the memory knob works for any
-            # cohort size
+            # clients (the shared zero_pad_leading invariant) so the
+            # memory knob works for any cohort size
             pad = (-C) % chunk
             if pad:
-                zpad = lambda a: jnp.concatenate(
-                    [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)])
-                device_data = jax.tree.map(zpad, device_data)
-                sched_p = jax.tree.map(zpad, sched)
+                device_data = zero_pad_leading(device_data, pad, jnp)
+                sched_p = zero_pad_leading(sched, pad, jnp)
                 rngs_p = jnp.concatenate([rngs, rngs[:1].repeat(pad, 0)])
             else:
                 sched_p, rngs_p = sched, rngs

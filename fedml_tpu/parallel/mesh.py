@@ -46,7 +46,34 @@ def replicated_sharding(mesh):
     return NamedSharding(mesh, P())
 
 
+def zero_pad_leading(tree, pad, xp=np):
+    """Zero-pad every leaf's leading (client) axis by ``pad`` rows.
+
+    THE dummy-client invariant, shared by every engine path (WaveRunner
+    waves, the flat indexed round's chunk padding, mesh sharding): padded
+    clients carry ``n``=0 and fully-masked schedules, so they are
+    zero-weight in aggregation and every training step they touch is
+    guarded to a no-op. ``xp`` selects numpy (host) or jax.numpy
+    (inside jit)."""
+    if not pad:
+        return tree
+    z = lambda a: xp.concatenate(
+        [a, xp.zeros((pad,) + a.shape[1:], a.dtype)])
+    return jax.tree.map(z, tree)
+
+
+def pad_cohort_to_multiple(cohort_data, multiple):
+    """Pad the cohort's client axis to a multiple of ``multiple`` with
+    zero-weight dummy clients, so cohorts that don't divide the mesh still
+    shard (``shard_map`` needs even shards)."""
+    C = len(next(iter(cohort_data.values())))
+    cohort_data = {k: np.asarray(v) for k, v in cohort_data.items()}
+    return zero_pad_leading(cohort_data, (-C) % multiple)
+
+
 def shard_cohort(mesh, cohort_data):
-    """Place a packed cohort dict (leading axis = clients) onto the mesh."""
+    """Place a packed cohort dict (leading axis = clients) onto the mesh,
+    padding to the mesh's client-axis size first when needed."""
+    cohort_data = pad_cohort_to_multiple(cohort_data, mesh.shape[CLIENT_AXIS])
     sh = client_sharding(mesh)
     return jax.tree.map(lambda x: jax.device_put(x, sh), cohort_data)

@@ -1,0 +1,141 @@
+"""Multi-host execution: jax.distributed + global-array round control.
+
+Reference behavior being replaced (SURVEY.md section 2.8): the reference
+scales past one machine with ``mpirun -hostfile mpi_host_file`` launching
+one torch process per client and moving pickled state_dicts over MPI
+(``fedml_experiments/distributed/fedavg/run_fedavg_distributed_pytorch.sh:
+18-38``, ``fedml_core/distributed/communication/mpi/com_manager.py``).
+TPU-native design: every host runs the SAME SPMD program over one global
+``clients`` mesh; aggregation collectives ride ICI within a slice and DCN
+across hosts, with no user-visible message passing. This module is the
+(thin) control plane that makes the engine's ``make_sharded_round`` span
+processes:
+
+- ``maybe_initialize_distributed()``: env-driven ``jax.distributed``
+  bring-up (no-op single-process, so every entry point calls it
+  unconditionally).
+- ``global_cohort()``: build the globally-sharded cohort arrays from each
+  host's full cohort copy (FL cohorts are small host-side; every process
+  packs the identical schedule because packing RNG is seeded identically).
+- ``gather_metrics()`` / ``is_primary()``: read back client-sharded round
+  outputs and gate logging/checkpointing to rank 0 (the reference runs
+  wandb on rank 0 only).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+
+def maybe_initialize_distributed():
+    """Initialize ``jax.distributed`` from environment variables.
+
+    Recognized (first match wins):
+      - ``FEDML_TPU_COORDINATOR`` + ``FEDML_TPU_NUM_PROCESSES`` +
+        ``FEDML_TPU_PROCESS_ID``: explicit bring-up (the mpirun-hostfile
+        analog; works on CPU hosts and TPU pods alike).
+      - ``JAX_COORDINATOR_ADDRESS``: defer to jax's own auto-detection
+        (TPU pod metadata, SLURM, etc.) via argument-less initialize().
+
+    Returns ``(process_index, process_count)``. Safe to call multiple
+    times and in single-process runs (returns ``(0, 1)``).
+    """
+    import jax
+
+    coord = os.environ.get("FEDML_TPU_COORDINATOR")
+    nproc = os.environ.get("FEDML_TPU_NUM_PROCESSES")
+    if coord and nproc and int(nproc) > 1:
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=int(nproc),
+                process_id=int(os.environ["FEDML_TPU_PROCESS_ID"]))
+            logging.info("jax.distributed: process %d/%s via %s",
+                         jax.process_index(), nproc, coord)
+        except RuntimeError as e:  # already initialized
+            logging.debug("jax.distributed.initialize skipped: %s", e)
+    elif os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        try:
+            jax.distributed.initialize()
+        except RuntimeError as e:
+            logging.debug("jax.distributed.initialize skipped: %s", e)
+    return jax.process_index(), jax.process_count()
+
+
+def is_primary() -> bool:
+    import jax
+    return jax.process_index() == 0
+
+
+def global_cohort(mesh, cohort_data):
+    """Place a host-replicated packed cohort onto a (possibly multi-host)
+    mesh, sharded over the ``clients`` axis.
+
+    Every process holds the full cohort in host memory and contributes the
+    shards its local devices own (``jax.make_array_from_callback``) -- the
+    schedule is identical on all processes because the packing RNG stream
+    is seeded identically, so no host<->host data exchange is needed
+    (contrast: the reference unicasts per-client pickles from rank 0).
+    Single-process meshes take the plain ``device_put`` path.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from fedml_tpu.parallel.mesh import (
+        CLIENT_AXIS, pad_cohort_to_multiple, shard_cohort)
+
+    if jax.process_count() == 1:
+        return shard_cohort(mesh, cohort_data)
+    cohort_data = pad_cohort_to_multiple(cohort_data,
+                                         mesh.shape[CLIENT_AXIS])
+
+    def place(x):
+        x = np.asarray(x)
+        sh = NamedSharding(mesh, P(CLIENT_AXIS))
+        return jax.make_array_from_callback(x.shape, sh,
+                                            lambda idx: x[idx])
+
+    return jax.tree.map(place, cohort_data)
+
+
+def gather_metrics(tree):
+    """Fetch round outputs to every host as numpy.
+
+    Replicated leaves read locally; client-sharded leaves are
+    all-gathered across processes (``multihost_utils.process_allgather``
+    -- the DCN collective replacing MPI gather-to-rank-0)."""
+    import jax
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return jax.tree.map(np.asarray, tree)
+
+    from jax.experimental import multihost_utils
+
+    def fetch(x):
+        if not hasattr(x, "sharding"):
+            return np.asarray(x)
+        if x.sharding.is_fully_replicated:
+            return np.asarray(
+                multihost_utils.global_array_to_host_local_array(
+                    x, x.sharding.mesh,
+                    jax.sharding.PartitionSpec()))
+        return np.asarray(multihost_utils.process_allgather(
+            x, tiled=True))
+
+    return jax.tree.map(fetch, tree)
+
+
+def sync(tag: str = "fedml_tpu"):
+    """Cross-process barrier (reference: MPI barrier between rounds)."""
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(tag)
+
+
+__all__ = ["maybe_initialize_distributed", "is_primary", "global_cohort",
+           "gather_metrics", "sync"]

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Fast CI tier -- the runnable analog of the reference's CI scripts
+# (CI-script-fedavg.sh:31-58: a short federated run plus the
+# federated==centralized equivalence asserts), targeted at < 2 minutes on
+# a CPU host. The full suite (including the slow-marked algorithm-family
+# integration tests) is `python -m pytest tests/ -q`.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== fast test tier (engine / core / utils / native / data-extra / online) =="
+python -m pytest tests/ -q -m "not slow" -p no:cacheprovider
+
+echo "== equivalence asserts (federated == centralized; wave == flat) =="
+python -m pytest tests/test_engine.py::TestFederatedEqualsCentralized \
+    tests/test_engine.py::TestWaveRunner -q -p no:cacheprovider
+
+echo "== CLI smoke: --ci equivalence run (reference CI-script-fedavg.sh) =="
+python - <<'EOF'
+import jax
+jax.config.update("jax_platforms", "cpu")  # CI hosts have no TPU tunnel
+from fedml_tpu.experiments import main_fedavg
+main_fedavg.main([
+    "--dataset", "synthetic", "--model", "lr", "--comm_round", "2",
+    "--epochs", "1", "--client_num_in_total", "4",
+    "--client_num_per_round", "4", "--batch_size", "-1", "--ci", "1"])
+print("CI CLI smoke: OK")
+EOF
+
+echo "ci.sh: all green"

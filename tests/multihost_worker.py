@@ -1,0 +1,79 @@
+"""Worker for the 2-process multi-host test (not a pytest module).
+
+Usage: python multihost_worker.py <process_id> <num_processes> <port>
+
+Each process brings up jax.distributed on the CPU platform with 4 local
+virtual devices (so 2 processes form one GLOBAL 8-device ``clients`` mesh),
+runs one sharded FedAvg round on an identical seeded cohort, and prints the
+replicated result checksum -- which the parent asserts is identical across
+processes and to a single-process 8-device run of the same round
+(SURVEY.md section 2.8; reference multi-host entry:
+``run_fedavg_distributed_pytorch.sh:18-38``).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo root (script runs from tests/)
+
+
+def main():
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["FEDML_TPU_COORDINATOR"] = f"localhost:{port}"
+    os.environ["FEDML_TPU_NUM_PROCESSES"] = str(nproc)
+    os.environ["FEDML_TPU_PROCESS_ID"] = str(pid)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from fedml_tpu.parallel.multihost import (
+        gather_metrics, maybe_initialize_distributed)
+
+    idx, count = maybe_initialize_distributed()
+    assert count == nproc, (idx, count)
+    devices = jax.devices()
+    assert len(devices) == 4 * nproc, devices
+
+    import numpy as np
+
+    from fedml_tpu.algorithms.specs import make_classification_spec
+    from fedml_tpu.models.linear import LogisticRegression
+    from fedml_tpu.parallel.engine import (
+        ClientUpdateConfig, make_sharded_round)
+    from fedml_tpu.parallel.mesh import make_client_mesh
+    from fedml_tpu.parallel.multihost import global_cohort
+    from fedml_tpu.parallel.packing import pack_cohort
+
+    import jax.numpy as jnp
+
+    model = LogisticRegression(num_classes=10, apply_sigmoid=False)
+    spec = make_classification_spec(model, jnp.zeros((1, 60)))
+    state = spec.init_fn(jax.random.PRNGKey(7))
+
+    rnd = np.random.default_rng(3)
+    clients = [{"x": rnd.normal(size=(n, 60)).astype(np.float32),
+                "y": rnd.integers(0, 10, n).astype(np.int64)}
+               for n in (16, 8, 24, 12, 16, 8, 8, 20)]
+    packed = pack_cohort(clients, batch_size=8, epochs=1,
+                         rng=np.random.default_rng(5))
+
+    mesh = make_client_mesh(len(devices), devices=devices)
+    sharded = global_cohort(mesh, packed)
+    round_fn = make_sharded_round(
+        spec, ClientUpdateConfig(lr=0.3), mesh)
+    new_state, _, info = round_fn(state, (), sharded, jax.random.PRNGKey(5))
+    jax.block_until_ready(new_state)
+
+    out = gather_metrics(new_state)
+    m = gather_metrics(info["metrics"])
+    checksum = float(sum(np.float64(x).sum() for x in jax.tree.leaves(out)))
+    print(f"RESULT process={idx} count={float(m['count'].sum()):.0f} "
+          f"checksum={checksum:.10e}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

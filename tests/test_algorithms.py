@@ -18,6 +18,8 @@ from fedml_tpu.data import load_synthetic_federated
 from fedml_tpu.data.poison import poison_federated_dataset
 from fedml_tpu.data.synthetic import load_synthetic_images
 
+pytestmark = pytest.mark.slow
+
 
 def _args(**kw):
     base = dict(client_num_per_round=6, comm_round=3, epochs=1, batch_size=16,

@@ -1,6 +1,7 @@
 """On-device augmentation (crop/flip/Cutout) -- reference
 ``fedml_api/data_preprocessing/cifar10/data_loader.py:57-76``."""
 
+import pytest
 import types
 
 import jax
@@ -12,6 +13,8 @@ from fedml_tpu.algorithms.fedavg import FedAvgAPI
 from fedml_tpu.algorithms.specs import make_classification_spec
 from fedml_tpu.data.augment import make_cifar_augment
 from fedml_tpu.data.synthetic import load_synthetic_images
+
+pytestmark = pytest.mark.slow
 
 
 def test_crop_flip_cutout_shapes_and_ranges():

@@ -2,6 +2,7 @@
 invariant (reference ``CI-script-fedavg.sh:42-47``: full-batch 1-epoch
 FedAvg over all clients must equal centralized training to 3 decimals)."""
 
+import pytest
 import types
 
 import jax
@@ -13,6 +14,8 @@ from fedml_tpu.algorithms.centralized import CentralizedTrainer
 from fedml_tpu.algorithms.fedavg import FedAvgAPI
 from fedml_tpu.algorithms.specs import make_classification_spec
 from fedml_tpu.data.synthetic import load_synthetic_federated
+
+pytestmark = pytest.mark.slow
 
 
 def _args(**kw):

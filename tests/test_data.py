@@ -8,6 +8,8 @@ from fedml_tpu.data import load_dataset, load_synthetic_federated
 from fedml_tpu.data.shakespeare import (
     to_ids, preprocess_snippets, VOCAB_SIZE, BOS_ID, EOS_ID, PAD_ID)
 
+pytestmark = pytest.mark.slow
+
 
 def _args(**kw):
     import types

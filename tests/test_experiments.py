@@ -7,6 +7,8 @@ import os
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 
 TINY = ["--client_num_in_total", "4", "--client_num_per_round", "2",
         "--comm_round", "2", "--epochs", "1", "--batch_size", "8",

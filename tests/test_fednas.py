@@ -17,6 +17,8 @@ from fedml_tpu.models.darts import (
     DARTS_V1, DARTSFixedNetwork, DARTSNetwork, Genotype, PRIMITIVES,
     derive_genotype, n_edges)
 
+pytestmark = pytest.mark.slow
+
 
 def tiny_dataset(n_clients=2, n=24, classes=4, hw=8, seed=0):
     rng = np.random.default_rng(seed)

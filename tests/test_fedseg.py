@@ -12,6 +12,8 @@ import pytest
 from fedml_tpu.core.seg_eval import Evaluator, confusion_matrix
 from fedml_tpu.utils.schedules import make_lr_schedule
 
+pytestmark = pytest.mark.slow
+
 
 def _args(**kw):
     base = dict(client_num_in_total=4, client_num_per_round=2, comm_round=2,

@@ -6,6 +6,8 @@ import pytest
 from fedml_tpu import models
 from fedml_tpu.core.pytree import tree_count_params
 
+pytestmark = pytest.mark.slow
+
 
 def _init(model, x, **kw):
     variables = model.init(jax.random.PRNGKey(0), x, **kw)

@@ -1,0 +1,99 @@
+"""Multi-host control plane: 2 real processes x 4 virtual CPU devices form
+one global 8-device mesh; the sharded round must agree bit-for-bit with the
+single-process run (VERDICT round-2 item 4; SURVEY.md section 2.8)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _single_process_reference():
+    """The same round on this process's 8-device CPU mesh."""
+    from fedml_tpu.algorithms.specs import make_classification_spec
+    from fedml_tpu.models.linear import LogisticRegression
+    from fedml_tpu.parallel.engine import (
+        ClientUpdateConfig, make_sharded_round)
+    from fedml_tpu.parallel.mesh import make_client_mesh, shard_cohort
+    from fedml_tpu.parallel.packing import pack_cohort
+
+    model = LogisticRegression(num_classes=10, apply_sigmoid=False)
+    spec = make_classification_spec(model, jnp.zeros((1, 60)))
+    state = spec.init_fn(jax.random.PRNGKey(7))
+    rnd = np.random.default_rng(3)
+    clients = [{"x": rnd.normal(size=(n, 60)).astype(np.float32),
+                "y": rnd.integers(0, 10, n).astype(np.int64)}
+               for n in (16, 8, 24, 12, 16, 8, 8, 20)]
+    packed = pack_cohort(clients, batch_size=8, epochs=1,
+                         rng=np.random.default_rng(5))
+    mesh = make_client_mesh(8)
+    round_fn = make_sharded_round(spec, ClientUpdateConfig(lr=0.3), mesh)
+    new_state, _, info = round_fn(state, (), shard_cohort(mesh, packed),
+                                  jax.random.PRNGKey(5))
+    checksum = float(sum(np.float64(np.asarray(x)).sum()
+                         for x in jax.tree.leaves(new_state)))
+    count = float(np.asarray(info["metrics"]["count"]).sum())
+    return checksum, count
+
+
+def test_two_process_round_matches_single_process():
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(i), "2", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=os.path.dirname(os.path.dirname(worker)))
+        for i in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=420)
+        outs.append(out)
+        assert p.returncode == 0, out[-2000:]
+    results = {}
+    for out in outs:
+        line = [ln for ln in out.splitlines() if ln.startswith("RESULT")]
+        assert line, out[-2000:]
+        parts = dict(kv.split("=") for kv in line[0].split()[1:])
+        results[int(parts["process"])] = (float(parts["checksum"]),
+                                          float(parts["count"]))
+    assert set(results) == {0, 1}
+    # both processes computed the identical replicated result
+    assert results[0] == results[1]
+    ref_checksum, ref_count = _single_process_reference()
+    assert results[0][1] == ref_count == 112.0  # every sample trained once
+    np.testing.assert_allclose(results[0][0], ref_checksum, rtol=1e-6)
+
+
+def test_multihost_helpers_single_process():
+    """Single-process semantics: initialize is a no-op, global_cohort
+    places on-device, gather_metrics is numpy conversion."""
+    from fedml_tpu.parallel.mesh import make_client_mesh
+    from fedml_tpu.parallel.multihost import (
+        gather_metrics, global_cohort, is_primary,
+        maybe_initialize_distributed, sync)
+
+    idx, count = maybe_initialize_distributed()
+    assert (idx, count) == (0, 1)
+    assert is_primary()
+    sync("test")  # no-op
+    mesh = make_client_mesh(8)
+    data = {"x": np.arange(16, dtype=np.float32).reshape(8, 2)}
+    placed = global_cohort(mesh, data)
+    np.testing.assert_array_equal(np.asarray(placed["x"]), data["x"])
+    got = gather_metrics({"a": jnp.ones(3)})
+    assert isinstance(got["a"], np.ndarray)

@@ -21,6 +21,8 @@ from fedml_tpu.models.gkt import (
 from fedml_tpu.data import load_synthetic_federated
 from fedml_tpu.data.synthetic import load_synthetic_images
 
+pytestmark = pytest.mark.slow
+
 
 def _args(**kw):
     base = dict(client_num_per_round=4, comm_round=2, epochs=1, batch_size=16,
