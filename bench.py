@@ -145,8 +145,11 @@ def main():
     p.add_argument("--batch_size", type=int, default=64)
     p.add_argument("--client_chunk", type=int, default=8,
                    help="clients per concurrent wave (HBM activation knob)")
+    p.add_argument("--mode", type=int, default=2, choices=(0, 1, 2),
+                   help="2 = packed lanes (one dispatch, LPT-balanced; "
+                        "default), 1 = size-sorted waves, 0 = flat")
     p.add_argument("--flat", action="store_true",
-                   help="use the flat single-program round instead of waves")
+                   help="shorthand for --mode 0")
     p.add_argument("--no_degrade", action="store_true",
                    help="fail hard instead of walking the degrade ladder")
     args = p.parse_args()
@@ -154,28 +157,35 @@ def main():
     import jax
 
     device = jax.devices()[0]
-    wave_mode = 0 if args.flat else 1
+    mode = 0 if args.flat else args.mode
 
-    # degrade ladder: flagship first; on failure shrink concurrency, then
-    # local epochs (never retrying a concurrency level above the user's
-    # cap) -- every rung is reported honestly in degraded_config
-    ladder = [dict(epochs=args.epochs, client_chunk=args.client_chunk)]
+    # degrade ladder: flagship first (packed lanes); on failure fall back
+    # to waves, then shrink concurrency, then local epochs (never retrying
+    # a concurrency level above the user's cap) -- every rung is reported
+    # honestly in degraded_config
+    ladder = [dict(epochs=args.epochs, client_chunk=args.client_chunk,
+                   wave_mode=mode)]
     if not args.no_degrade:
+        if mode == 2:  # lanes failed -> try waves at the same shape
+            ladder.append(dict(epochs=args.epochs,
+                               client_chunk=args.client_chunk, wave_mode=1))
         for chunk in (4, 2, 1):
             if chunk < args.client_chunk:
-                ladder.append(dict(epochs=args.epochs, client_chunk=chunk))
+                ladder.append(dict(epochs=args.epochs, client_chunk=chunk,
+                                   wave_mode=1))
         for ep in (10, 5, 1):
             if ep < args.epochs:
                 ladder.append(dict(epochs=ep,
-                                   client_chunk=min(4, args.client_chunk)))
+                                   client_chunk=min(4, args.client_chunk),
+                                   wave_mode=1))
         if args.epochs > 1 and args.client_chunk > 1:
-            ladder.append(dict(epochs=1, client_chunk=1))  # last resort
+            ladder.append(dict(epochs=1, client_chunk=1, wave_mode=1))
 
     failures, meas, used = [], None, None
     for rung in ladder:
         try:
             meas = measure(args, rung["epochs"], rung["client_chunk"],
-                           wave_mode)
+                           rung["wave_mode"])
             used = rung
             break
         except Exception:
@@ -230,9 +240,12 @@ def main():
     # report ANY deviation from the requested first rung (including a
     # chunk-only degrade, which keeps the workload flagship-comparable but
     # must still be visible), and every failed rung along the way
+    result["exec_mode"] = {2: "lanes", 1: "waves", 0: "flat"}[
+        used["wave_mode"]]
     if used != ladder[0] and not args.smoke:
         result["degraded_config"] = {
             "epochs": used["epochs"], "client_chunk": used["client_chunk"],
+            "wave_mode": used["wave_mode"],
             "flagship_epochs": FLAGSHIP_EPOCHS}
     if failures:
         result["failed_configs"] = [f["config"] for f in failures]
@@ -240,8 +253,8 @@ def main():
         result["partial_rounds_error"] = meas["partial_error"][-400:]
     print(json.dumps(result))
     print(f"# times={[round(t, 2) for t in meas['times']]} "
-          f"train_acc={meas['train_acc']:.3f} wave_mode={wave_mode}",
-          file=sys.stderr)
+          f"train_acc={meas['train_acc']:.3f} "
+          f"wave_mode={used['wave_mode']}", file=sys.stderr)
 
 
 if __name__ == "__main__":

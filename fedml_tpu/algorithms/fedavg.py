@@ -17,8 +17,8 @@ import numpy as np
 
 from fedml_tpu.core.trainer import TrainSpec
 from fedml_tpu.parallel.engine import (
-    ClientUpdateConfig, WaveRunner, make_indexed_sim_round, make_sim_round,
-    make_sharded_round, make_eval_fn)
+    ClientUpdateConfig, LaneRunner, WaveRunner, make_indexed_sim_round,
+    make_sim_round, make_sharded_round, make_eval_fn)
 from fedml_tpu.parallel.mesh import shard_cohort  # noqa: F401 (re-export)
 from fedml_tpu.parallel.packing import (
     pack_cohort, pack_eval, pack_schedule, stack_clients)
@@ -104,11 +104,15 @@ class FedAvgAPI:
                 self.device_data = {"x": jnp.asarray(stacked["x"]),
                                     "y": jnp.asarray(stacked["y"])}
                 self._client_ns = stacked["n"]
-                # wave path (default): size-sorted waves w/ dynamic trip
-                # count; flat path kept for A/B (--wave_mode 0)
+                # execution modes for device-resident rounds
+                # (--wave_mode): 2 = packed lanes (one dispatch, LPT-
+                # balanced, zero padded compute), 1 = size-sorted waves
+                # (default), 0 = flat single program (A/B / debugging)
+                chunk = getattr(args, "client_chunk", 8) or 8
                 self.wave_runner = WaveRunner(
-                    spec, cfg, payload_fn, server_fn,
-                    client_chunk=getattr(args, "client_chunk", 8) or 8)
+                    spec, cfg, payload_fn, server_fn, client_chunk=chunk)
+                self.lane_runner = LaneRunner(
+                    spec, cfg, payload_fn, server_fn, n_lanes=chunk)
                 self.indexed_round_fn = make_indexed_sim_round(
                     spec, cfg, payload_fn, server_fn,
                     client_chunk=getattr(args, "client_chunk", None))
@@ -154,7 +158,13 @@ class FedAvgAPI:
                                  f"client has an empty shard")
             sched = pack_schedule(ns, self.args.batch_size, self.args.epochs,
                                   rng=self._data_rng)
-            if getattr(self.args, "wave_mode", 1):
+            mode = int(getattr(self.args, "wave_mode", 1))
+            if mode == 2:
+                (self.global_state, self.server_state,
+                 info) = self.lane_runner.run_round(
+                    self.global_state, self.server_state, self.device_data,
+                    client_indexes, sched, round_rng)
+            elif mode == 1:
                 (self.global_state, self.server_state,
                  info) = self.wave_runner.run_round(
                     self.global_state, self.server_state, self.device_data,

@@ -57,8 +57,9 @@ def add_base_args(parser: argparse.ArgumentParser):
     p.add_argument("--mesh", type=int, default=0,
                    help="shard clients over an N-device mesh (0 = vmapped "
                         "single-device simulation)")
-    p.add_argument("--wave_mode", type=int, default=1,
-                   help="device-resident rounds: 1 = size-sorted waves "
+    p.add_argument("--wave_mode", type=int, default=1, choices=(0, 1, 2),
+                   help="device-resident rounds: 2 = packed lanes (one "
+                        "dispatch, LPT-balanced), 1 = size-sorted waves "
                         "with dynamic trip counts (default), 0 = flat "
                         "single-program round (A/B / debugging)")
     p.add_argument("--client_chunk", type=int, default=8,
@@ -210,11 +211,13 @@ def run_fedavg_family(api, args, logger):
 
     def on_round(api_, metrics):
         last = api_.round_idx == args.comm_round
-        if (ckpt is not None and is_primary()
+        if (ckpt is not None
                 and (api_.round_idx % args.save_frequency == 0 or last)):
-            # the round's outputs are replicated pytrees, so EVERYTHING in
-            # the payload converts to host numpy locally -- a primary-only
-            # save never needs a cross-process orbax collective
+            # EVERY process calls save (orbax CheckpointManager.save is a
+            # collective under jax.process_count()>1 -- its internal
+            # barriers would deadlock a primary-only call); payloads are
+            # identical host numpy on all ranks (replicated pytrees
+            # convert locally), and orbax writes from process 0
             to_np = lambda t: jax.tree.map(np.asarray, t)
             ckpt.save(api_.round_idx, to_np(api_.global_state),
                       server_state=to_np(api_.server_state),

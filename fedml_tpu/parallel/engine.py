@@ -380,7 +380,6 @@ class WaveRunner:
             w_n, w_ids, w_rngs = sched_n[pos], ids[pos], all_rngs[pos]
             if k < chunk:  # pad the ragged last wave -> one stable jit shape
                 pad = chunk - k
-                from fedml_tpu.parallel.mesh import zero_pad_leading
                 w_idx, w_mask, w_n, w_ids = zero_pad_leading(
                     (w_idx, w_mask, w_n, w_ids), pad)
                 w_rngs = np.concatenate([w_rngs, w_rngs[:1].repeat(pad, 0)])
@@ -408,6 +407,197 @@ class WaveRunner:
             aux_out["steps"][pos] = np.asarray(aux["steps"])[:k]
         return new_global, new_server_state, {"aux": aux_out,
                                               "metrics": metrics_sum}
+
+
+class LaneRunner:
+    """Packed-lane execution: the WHOLE round as ONE jitted dispatch.
+
+    ``pack_lanes`` lays the cohort's per-client step schedules end-to-end
+    into K balanced lanes (LPT). Each lane's ``fori_loop`` trains clients
+    back-to-back: at a client's final step the lane flushes the weighted
+    payload into an on-device accumulator and resets its carried state to
+    the global model, so no lane ever executes a padded fwd+bwd. Wall
+    steps per round = max lane load ~= ceil(total_steps / K): strictly
+    less straggle than size-sorted waves (``WaveRunner``), with a single
+    program launch per round. RNG per client step is
+    ``fold_in(client_key, local_step)`` with the same client keys as the
+    flat paths, so lane, wave, and flat trajectories agree to float
+    reassociation (tested in ``tests/test_engine.py``).
+
+    Reference contrast: one torch process per client, rounds gated on the
+    slowest process (``FedAVGAggregator.py:58-87``); here the scheduler
+    is ~50 lines of host numpy and the chip never idles.
+    """
+
+    def __init__(self, spec: TrainSpec, cfg: ClientUpdateConfig,
+                 payload_fn=None, server_fn=None, n_lanes=8):
+        self.payload_fn = payload_fn or _default_payload
+        self.server_fn = server_fn or _default_server
+        self.n_lanes = int(n_lanes or 8)
+        optimizer = make_optimizer(cfg)
+        payload_fn_ = self.payload_fn
+        server_fn_ = self.server_fn
+
+        def lane_update(global_state, data_x, data_y, n_max, rows, lane,
+                        step_keys, trip):
+            """One lane: sequential clients with flush/reset boundaries.
+
+            ``data_x/data_y``: FULL device-resident stacks flattened on
+            their first two axes (``[R * n_max, ...]``); ``rows`` maps
+            cohort slot -> device row; ``lane`` is this lane's slice of
+            the ``pack_lanes`` arrays; ``step_keys [L, 2]`` the
+            pre-folded per-step PRNG keys.
+            """
+            g_params, g_rest = _split_state(global_state)
+            g_opt = optimizer.init(g_params)
+
+            def batch_at(i):
+                idx_b = jax.lax.dynamic_index_in_dim(
+                    lane["idx"], i, axis=0, keepdims=False)
+                mask_b = jax.lax.dynamic_index_in_dim(
+                    lane["mask"], i, axis=0, keepdims=False)
+                slot = jax.lax.dynamic_index_in_dim(
+                    lane["slot"], i, axis=0, keepdims=False)
+                row = jnp.take(rows, slot)
+                flat = row * n_max + idx_b
+                return {"x": jnp.take(data_x, flat, axis=0),
+                        "y": jnp.take(data_y, flat, axis=0),
+                        "mask": mask_b}
+
+            def grad_at(params, rest, batch, step_rng):
+                if spec.augment_fn is not None:
+                    batch = dict(batch)
+                    batch["x"] = spec.augment_fn(
+                        batch["x"], jax.random.fold_in(step_rng, 13))
+
+                def loss_wrapper(p):
+                    state = dict(rest)
+                    state["params"] = p
+                    return spec.loss_fn(state, batch, step_rng, True)
+
+                return jax.value_and_grad(loss_wrapper, has_aux=True)(params)
+
+            metrics0 = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype),
+                jax.eval_shape(lambda: grad_at(
+                    g_params, g_rest, batch_at(0), step_keys[0]))[0][1][1])
+            aux0 = {"n": jnp.float32(0), "steps": jnp.int32(0)}
+            pay0 = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, jnp.float32),
+                jax.eval_shape(payload_fn_, global_state, global_state,
+                               aux0))
+
+            def body(i, carry):
+                params, rest, opt_state, pay, w, msum = carry
+                batch = batch_at(i)
+                step_rng = jax.lax.dynamic_index_in_dim(
+                    step_keys, i, axis=0, keepdims=False)
+                (_, (new_state, metrics)), grads = grad_at(
+                    params, rest, batch, step_rng)
+                updates, new_opt = optimizer.update(grads, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
+                new_rest = {k: new_state[k] for k in rest}
+                valid = jnp.sum(batch["mask"]) > 0
+                params, rest, opt_state = _tree_select(
+                    valid, (new_params, new_rest, new_opt),
+                    (params, rest, opt_state))
+                msum = jax.tree.map(jnp.add, msum, metrics)
+
+                # client boundary: flush weighted payload, reset to global
+                f = jax.lax.dynamic_index_in_dim(
+                    lane["flush"], i, axis=0, keepdims=False)
+                f_n = jax.lax.dynamic_index_in_dim(
+                    lane["flush_n"], i, axis=0, keepdims=False)
+                f_steps = jax.lax.dynamic_index_in_dim(
+                    lane["flush_steps"], i, axis=0, keepdims=False)
+                local_state = dict(rest)
+                local_state["params"] = params
+                payload = payload_fn_(local_state, global_state,
+                                      {"n": f_n,
+                                       "steps": f_steps.astype(jnp.int32)})
+                scale = f * f_n
+                pay = jax.tree.map(
+                    lambda a, p: a + scale * p.astype(jnp.float32),
+                    pay, payload)
+                w = w + scale
+                params, rest, opt_state = _tree_select(
+                    f > 0, (g_params, g_rest, g_opt),
+                    (params, rest, opt_state))
+                return (params, rest, opt_state, pay, w, msum)
+
+            carry = (g_params, g_rest, g_opt, pay0, jnp.float32(0), metrics0)
+            _, _, _, pay, w, msum = jax.lax.fori_loop(0, trip, body, carry)
+            return pay, w, msum
+
+        @jax.jit
+        def round_fn(global_state, server_state, device_x, device_y, rows,
+                     lanes, step_keys, trip, dtypes, rng):
+            R, n_max = device_x.shape[0], device_x.shape[1]
+            dx = device_x.reshape((R * n_max,) + device_x.shape[2:])
+            dy = device_y.reshape((R * n_max,) + device_y.shape[2:])
+            pay, w, msum = jax.vmap(
+                lane_update, in_axes=(None, None, None, None, None, 0, 0,
+                                      None))(
+                global_state, dx, dy, n_max, rows, lanes, step_keys, trip)
+            pay_sum = jax.tree.map(lambda x: jnp.sum(x, axis=0), pay)
+            w_sum = jnp.sum(w)
+            metrics_sum = jax.tree.map(lambda m: jnp.sum(m, axis=0), msum)
+            avg = jax.tree.map(
+                lambda s, d: (s / jnp.maximum(w_sum, 1e-12)).astype(d.dtype),
+                pay_sum, dtypes)
+            new_global, new_server = server_fn_(global_state, avg,
+                                                server_state, rng)
+            return new_global, new_server, metrics_sum
+
+        @jax.jit
+        def fold_keys(client_keys, slot, local_step):
+            # step_keys[k, i] = fold_in(key of the step's client, local step)
+            def one(s, t):
+                return jax.random.fold_in(jnp.take(client_keys, s, axis=0), t)
+            return jax.vmap(jax.vmap(one))(slot, local_step)
+
+        self._round_fn = round_fn
+        self._fold_keys = fold_keys
+        self._dtypes = None
+
+    def _payload_dtypes(self, global_state):
+        if self._dtypes is None:
+            aux = {"n": jax.ShapeDtypeStruct((), jnp.float32),
+                   "steps": jax.ShapeDtypeStruct((), jnp.int32)}
+            shapes = jax.eval_shape(self.payload_fn, global_state,
+                                    global_state, aux)
+            self._dtypes = jax.tree.map(
+                lambda s: jnp.zeros((), s.dtype), shapes)
+        return self._dtypes
+
+    def run_round(self, global_state, server_state, device_data, ids, sched,
+                  rng):
+        """Same contract as :meth:`WaveRunner.run_round` (cohort ``ids``
+        into ``device_data``, full ``pack_schedule`` output, round key);
+        executes as one dispatch over ``n_lanes`` packed lanes."""
+        import numpy as np
+
+        from fedml_tpu.parallel.packing import pack_lanes
+
+        C = len(np.asarray(sched["n"]))
+        lanes = pack_lanes(sched, self.n_lanes)
+        trip = jnp.int32(max(lanes.pop("trip"), 1))
+        client_keys = jax.random.split(jax.random.fold_in(rng, 1), C)
+        lane_arrays = {k: jnp.asarray(v) for k, v in lanes.items()
+                       if k in ("idx", "mask", "slot", "flush", "flush_n",
+                                "flush_steps")}
+        step_keys = self._fold_keys(client_keys,
+                                    jnp.asarray(lanes["slot"]),
+                                    jnp.asarray(lanes["local_step"]))
+        rows = jnp.asarray(np.asarray(ids, np.int32))
+        new_global, new_server, metrics = self._round_fn(
+            global_state, server_state, device_data["x"], device_data["y"],
+            rows, lane_arrays, step_keys, trip,
+            self._payload_dtypes(global_state), jax.random.fold_in(rng, 2))
+        steps_pc = (np.asarray(sched["mask"]).sum(axis=2) > 0).sum(axis=1)
+        aux = {"n": np.asarray(sched["n"], np.float32),
+               "steps": steps_pc.astype(np.int64)}
+        return new_global, new_server, {"aux": aux, "metrics": metrics}
 
 
 def make_indexed_sim_round(spec: TrainSpec, cfg: ClientUpdateConfig,

@@ -43,23 +43,26 @@ def maybe_initialize_distributed():
     """
     import jax
 
+    def init(**kw):
+        try:
+            jax.distributed.initialize(**kw)
+        except RuntimeError as e:
+            # tolerate ONLY re-initialization; a connect failure must fail
+            # fast -- swallowing it would leave every host running the
+            # full workload independently as its own "process 0"
+            if "already initialized" not in str(e).lower():
+                raise
+            logging.debug("jax.distributed already initialized: %s", e)
+
     coord = os.environ.get("FEDML_TPU_COORDINATOR")
     nproc = os.environ.get("FEDML_TPU_NUM_PROCESSES")
     if coord and nproc and int(nproc) > 1:
-        try:
-            jax.distributed.initialize(
-                coordinator_address=coord,
-                num_processes=int(nproc),
-                process_id=int(os.environ["FEDML_TPU_PROCESS_ID"]))
-            logging.info("jax.distributed: process %d/%s via %s",
-                         jax.process_index(), nproc, coord)
-        except RuntimeError as e:  # already initialized
-            logging.debug("jax.distributed.initialize skipped: %s", e)
+        init(coordinator_address=coord, num_processes=int(nproc),
+             process_id=int(os.environ["FEDML_TPU_PROCESS_ID"]))
+        logging.info("jax.distributed: process %d/%s via %s",
+                     jax.process_index(), nproc, coord)
     elif os.environ.get("JAX_COORDINATOR_ADDRESS"):
-        try:
-            jax.distributed.initialize()
-        except RuntimeError as e:
-            logging.debug("jax.distributed.initialize skipped: %s", e)
+        init()
     return jax.process_index(), jax.process_count()
 
 

@@ -210,6 +210,77 @@ def pack_schedule(ns, batch_size, epochs, rng=None, drop_last=False,
             "n": np.asarray(ns, np.float32)}
 
 
+def pack_lanes(sched, n_lanes, step_bucket=8):
+    """Re-lay a packed cohort schedule ``[C, S, B]`` into ``n_lanes``
+    PACKED LANES for single-dispatch rounds (``engine.LaneRunner``).
+
+    Clients are assigned to lanes by LPT (longest-processing-time-first)
+    scheduling, then each lane's clients run back-to-back: the engine
+    resets carried state to the global model at client boundaries and
+    flushes the finished client's weighted payload into an accumulator.
+    Executed wall steps drop from ``sum_w max_steps(wave_w)`` (waves) to
+    ``max_lane_load ~= ceil(total_steps / n_lanes) + LPT slack`` -- the
+    endgame of the straggler problem the reference pays with idle GPU
+    workers (its slowest client process gates every round).
+
+    Args:
+      sched: ``pack_schedule`` output (``idx``/``mask`` ``[C, S, B]``,
+        ``n [C]``) in cohort order.
+    Returns dict of numpy arrays, lane-major:
+      ``idx/mask [K, L, B]``: per-step batch index/mask rows.
+      ``slot [K, L]`` int32: cohort position of the step's client (0 on
+        padding; masked steps are guarded no-ops).
+      ``local_step [K, L]`` int32: step index within the client (drives
+        the per-client RNG stream exactly as the flat paths).
+      ``flush [K, L]`` float32: 1.0 on a client's final step.
+      ``flush_n / flush_steps [K, L]`` float32: the client's sample count
+        and executed-step count, carried on its flush step (payload aux).
+      ``trip`` int: executed steps per lane (max lane load, bucketed).
+    """
+    idx, mask = np.asarray(sched["idx"]), np.asarray(sched["mask"])
+    ns = np.asarray(sched["n"], np.float32)
+    C, S, B = idx.shape
+    steps_pc = (mask.sum(axis=2) > 0).sum(axis=1).astype(np.int64)
+
+    # LPT: biggest client first onto the lightest lane
+    order = np.argsort(-steps_pc, kind="stable")
+    K = max(1, min(int(n_lanes), C))
+    loads = np.zeros(K, np.int64)
+    lanes = [[] for _ in range(K)]
+    for c in order:
+        k = int(np.argmin(loads))
+        lanes[k].append(int(c))
+        loads[k] += int(steps_pc[c])
+    L = int(loads.max())
+    L = int(math.ceil(max(L, 1) / step_bucket) * step_bucket)
+
+    out_idx = np.zeros((K, L, B), np.int32)
+    out_mask = np.zeros((K, L, B), np.float32)
+    slot = np.zeros((K, L), np.int32)
+    local_step = np.zeros((K, L), np.int32)
+    flush = np.zeros((K, L), np.float32)
+    flush_n = np.zeros((K, L), np.float32)
+    flush_steps = np.zeros((K, L), np.float32)
+    for k, members in enumerate(lanes):
+        pos = 0
+        for c in members:
+            s_c = int(steps_pc[c])
+            if s_c == 0:
+                continue
+            sl = slice(pos, pos + s_c)
+            out_idx[k, sl] = idx[c, :s_c]
+            out_mask[k, sl] = mask[c, :s_c]
+            slot[k, sl] = c
+            local_step[k, sl] = np.arange(s_c)
+            flush[k, pos + s_c - 1] = 1.0
+            flush_n[k, pos + s_c - 1] = ns[c]
+            flush_steps[k, pos + s_c - 1] = s_c
+            pos += s_c
+    return {"idx": out_idx, "mask": out_mask, "slot": slot,
+            "local_step": local_step, "flush": flush, "flush_n": flush_n,
+            "flush_steps": flush_steps, "trip": int(loads.max())}
+
+
 def pack_eval(data, batch_size, pad_multiple=1):
     """Pack a flat eval set into ``[S, B]`` masked batches."""
     x, y = np.asarray(data["x"]), np.asarray(data["y"])

@@ -7,12 +7,9 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== fast test tier (engine / core / utils / native / data-extra / online) =="
+echo "== fast test tier (engine / core / utils / native / data-extra / online;"
+echo "   includes the federated==centralized + wave/lane==flat equivalence asserts) =="
 python -m pytest tests/ -q -m "not slow" -p no:cacheprovider
-
-echo "== equivalence asserts (federated == centralized; wave == flat) =="
-python -m pytest tests/test_engine.py::TestFederatedEqualsCentralized \
-    tests/test_engine.py::TestWaveRunner -q -p no:cacheprovider
 
 echo "== CLI smoke: --ci equivalence run (reference CI-script-fedavg.sh) =="
 python - <<'EOF'

@@ -13,7 +13,7 @@ from fedml_tpu.algorithms.fedavg import FedAvgAPI, client_sampling
 from fedml_tpu.core import pytree
 from fedml_tpu.data import load_synthetic_federated
 from fedml_tpu.parallel.engine import (
-    ClientUpdateConfig, WaveRunner, make_client_update,
+    ClientUpdateConfig, LaneRunner, WaveRunner, make_client_update,
     make_indexed_sim_round, make_sim_round, make_sharded_round, make_eval_fn)
 from fedml_tpu.parallel.mesh import make_client_mesh
 from fedml_tpu.parallel.packing import (
@@ -212,6 +212,80 @@ class TestWaveRunner:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-5)
 
+    @pytest.mark.parametrize("n_lanes", [1, 3, 8])
+    def test_lanes_equal_flat(self, n_lanes):
+        """Packed lanes (one dispatch, flush/reset at client boundaries)
+        must reproduce the flat round exactly: same schedules, same
+        per-client-step RNG stream, weighted aggregation equal up to
+        reassociation."""
+        sizes = (40, 8, 24, 16, 5, 31)
+        spec, cfg, state, dd, sched = self._setup(sizes)
+        rng = jax.random.PRNGKey(3)
+
+        flat = make_indexed_sim_round(spec, cfg)
+        js = {k: jnp.asarray(v) for k, v in sched.items()}
+        s_flat, _, info_flat = flat(state, (), dd, js, rng)
+
+        lr_ = LaneRunner(spec, cfg, n_lanes=n_lanes)
+        s_lane, _, info_lane = lr_.run_round(
+            state, (), dd, list(range(len(sizes))), sched, rng)
+
+        for a, b in zip(jax.tree.leaves(s_flat), jax.tree.leaves(s_lane)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5)
+        mf = jax.tree.map(lambda x: np.asarray(x).sum(0),
+                          info_flat["metrics"])
+        ml = jax.tree.map(np.asarray, info_lane["metrics"])
+        np.testing.assert_allclose(mf["count"], ml["count"], rtol=1e-6)
+        np.testing.assert_allclose(mf["loss_sum"], ml["loss_sum"],
+                                   rtol=1e-4)
+        np.testing.assert_array_equal(info_lane["aux"]["n"], sched["n"])
+
+    def test_lanes_with_server_hook(self):
+        from fedml_tpu.core import pytree as pt
+
+        def payload_fn(local_state, global_state, aux):
+            tau = jnp.maximum(aux["steps"].astype(jnp.float32), 1.0)
+            return {"d": pt.tree_scale(
+                pt.tree_sub(global_state["params"], local_state["params"]),
+                1.0 / tau), "tau": tau}
+
+        def server_fn(global_state, avg, server_state, rng):
+            new = dict(global_state)
+            new["params"] = pt.tree_sub(
+                global_state["params"],
+                pt.tree_scale(avg["d"], avg["tau"]))
+            return new, server_state
+
+        sizes = (12, 30, 7, 21, 16)
+        spec, cfg, state, dd, sched = self._setup(sizes)
+        rng = jax.random.PRNGKey(11)
+        flat = make_indexed_sim_round(spec, cfg, payload_fn, server_fn)
+        js = {k: jnp.asarray(v) for k, v in sched.items()}
+        s_flat, _, _ = flat(state, (), dd, js, rng)
+        lr_ = LaneRunner(spec, cfg, payload_fn, server_fn, n_lanes=2)
+        s_lane, _, _ = lr_.run_round(
+            state, (), dd, list(range(len(sizes))), sched, rng)
+        for a, b in zip(jax.tree.leaves(s_flat), jax.tree.leaves(s_lane)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5)
+
+    def test_pack_lanes_covers_every_step_once(self):
+        from fedml_tpu.parallel.packing import pack_lanes, pack_schedule
+        ns = [37, 5, 18, 64, 9, 27]
+        sched = pack_schedule(ns, 8, epochs=2, rng=np.random.default_rng(2))
+        lanes = pack_lanes(sched, 4)
+        steps_pc = (np.asarray(sched["mask"]).sum(2) > 0).sum(1)
+        # every client's real steps appear exactly once across all lanes
+        total = (lanes["mask"].sum(2) > 0).sum()
+        assert total == steps_pc.sum()
+        assert lanes["flush"].sum() == len(ns)
+        np.testing.assert_allclose(sorted(lanes["flush_n"][lanes["flush"] > 0]),
+                                   sorted(np.asarray(ns, np.float32)))
+        # LPT balance: max lane load < total/K + max client load
+        K = lanes["idx"].shape[0]
+        assert lanes["trip"] <= steps_pc.sum() / K + steps_pc.max()
+
     def test_wave_subset_cohort(self):
         # cohort is a subset of device rows, in non-sorted order
         sizes = (10, 40, 6, 28, 18)
@@ -279,6 +353,20 @@ class TestFedAvgAPI:
         # per-client labeling functions (LEAF synthetic) cap global accuracy;
         # 0.25 is well above the 0.1 chance level
         assert final["Test/Acc"] > 0.25
+
+    def test_wave_mode_2_lane_rounds(self):
+        dataset = load_synthetic_federated(client_num=8, n_train=800,
+                                           n_test=200, seed=0)
+        spec = _lr_spec()
+        args = _args(client_num_per_round=8, comm_round=4, lr=0.5,
+                     frequency_of_the_test=100, wave_mode=2, client_chunk=3,
+                     device_resident="auto")
+        api = FedAvgAPI(dataset, spec, args)
+        assert api.device_data is not None
+        first = api.train_one_round()
+        for _ in range(3):
+            last = api.train_one_round()
+        assert last["Train/Acc"] > first["Train/Acc"]
 
     def test_partial_participation(self):
         dataset = load_synthetic_federated(client_num=10, n_train=500,
