@@ -93,7 +93,13 @@ class FedAvgAPI:
                                for d in self.train_data_local_dict.values()))
             x0 = np.asarray(self.train_data_local_dict[0]["x"])
             y0 = np.asarray(self.train_data_local_dict[0]["y"])
-            row = (int(np.prod(x0.shape[1:], dtype=np.int64)) * x0.dtype.itemsize
+            # optional reduced-precision residency: floating x only (token
+            # ids would be corrupted by a bf16 cast -- ids >= 257 round)
+            ddt = getattr(args, "device_dtype", None)
+            cast_bf16 = (ddt in ("bf16", "bfloat16")
+                         and np.issubdtype(x0.dtype, np.floating))
+            x_itemsize = 2 if cast_bf16 else x0.dtype.itemsize
+            row = (int(np.prod(x0.shape[1:], dtype=np.int64)) * x_itemsize
                    + int(np.prod(y0.shape[1:], dtype=np.int64) or 1)
                    * y0.dtype.itemsize)
             cap = float(getattr(args, "device_data_cap_gb", 2.0)) * 1e9
@@ -101,8 +107,13 @@ class FedAvgAPI:
                 import jax.numpy as jnp
                 stacked = stack_clients(
                     [self.train_data_local_dict[i] for i in range(C)])
-                self.device_data = {"x": jnp.asarray(stacked["x"]),
-                                    "y": jnp.asarray(stacked["y"])}
+                # halves the footprint; models cast inputs to their
+                # compute dtype anyway
+                self.device_data = {
+                    "x": jnp.asarray(stacked["x"],
+                                     dtype=jnp.bfloat16 if cast_bf16
+                                     else None),
+                    "y": jnp.asarray(stacked["y"])}
                 self._client_ns = stacked["n"]
                 # execution modes for device-resident rounds
                 # (--wave_mode): 2 = packed lanes (one dispatch, LPT-

@@ -69,6 +69,15 @@ def add_base_args(parser: argparse.ArgumentParser):
                    help="auto | 0: keep client shards resident in HBM "
                         "when they fit (single-chip path)")
     p.add_argument("--device_data_cap_gb", type=float, default=2.0)
+    p.add_argument("--device_dtype", type=str, default=None,
+                   choices=("bf16", "bfloat16"),
+                   help="keep device-resident floating image data in "
+                        "bfloat16 (half the HBM footprint; default keeps "
+                        "source dtype; integer data is never cast)")
+    p.add_argument("--platform", type=str, default=None,
+                   help="force a jax platform (e.g. cpu); needed because "
+                        "the container pins JAX_PLATFORMS and ignores env "
+                        "overrides")
     p.add_argument("--run_dir", type=str, default=None,
                    help="metrics/summary output dir (wandb-summary analog)")
     p.add_argument("--enable_wandb", type=int, default=0)
@@ -98,6 +107,9 @@ def setup(args, run_name=None):
         is_primary, maybe_initialize_distributed)
     from fedml_tpu.utils import MetricsLogger, init_logging
 
+    if getattr(args, "platform", None):
+        import jax
+        jax.config.update("jax_platforms", args.platform)
     proc, nproc = maybe_initialize_distributed()
     init_logging(proctitle=run_name)
     logging.info("args = %s (process %d/%d)", vars(args), proc, nproc)

@@ -1,0 +1,4 @@
+#!/usr/bin/env bash
+# Streaming decentralized online learning (reference analog:
+# fedml_experiments/standalone/decentralized run scripts).
+python3 -m fedml_tpu.experiments.main_decentralized --online 1 "$@"

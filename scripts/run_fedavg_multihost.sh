@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Multi-host FedAvg launcher -- the TPU-native analog of the reference's
+# mpirun entry (fedml_experiments/distributed/fedavg/
+# run_fedavg_distributed_pytorch.sh:18-38). One process per host; each
+# process runs the SAME SPMD program over a global `clients` mesh and the
+# aggregation psum rides ICI/DCN (no pickled state_dicts, no rank-0
+# unicast loop).
+#
+# Usage:
+#   NUM_PROCESSES=2 COORDINATOR=host0:12345 PROCESS_ID=0 \
+#     sh run_fedavg_multihost.sh --dataset cifar10 --model resnet56 ...
+# For a local smoke (2 processes x 4 virtual CPU devices, same machine):
+#   sh run_fedavg_multihost.sh --local_smoke
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if [[ "${1:-}" == "--local_smoke" ]]; then
+    shift
+    PORT=$(python3 - <<'EOF'
+import socket
+s = socket.socket(); s.bind(("localhost", 0)); print(s.getsockname()[1])
+EOF
+)
+    for i in 0 1; do
+        FEDML_TPU_COORDINATOR="localhost:${PORT}" \
+        FEDML_TPU_NUM_PROCESSES=2 \
+        FEDML_TPU_PROCESS_ID=$i \
+        XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+        JAX_PLATFORMS=cpu \
+        python3 -m fedml_tpu.experiments.main_fedavg \
+            --dataset synthetic --model lr --mesh 8 \
+            --client_num_in_total 8 --client_num_per_round 8 \
+            --comm_round 2 --epochs 1 --platform cpu "$@" &
+    done
+    wait
+    echo "multihost local smoke: OK"
+else
+    : "${NUM_PROCESSES:?set NUM_PROCESSES}" \
+      "${COORDINATOR:?set COORDINATOR host:port}" \
+      "${PROCESS_ID:?set PROCESS_ID for this host}"
+    FEDML_TPU_COORDINATOR="$COORDINATOR" \
+    FEDML_TPU_NUM_PROCESSES="$NUM_PROCESSES" \
+    FEDML_TPU_PROCESS_ID="$PROCESS_ID" \
+    python3 -m fedml_tpu.experiments.main_fedavg "$@"
+fi

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Launch wrapper for the fedopt experiment main (reference analog:
+# fedml_experiments/*/fedopt/run_*.sh -- mpirun replaced by one SPMD
+# process; pass --mesh N to shard clients over N devices).
+# Usage: sh run_fedopt.sh [extra --flags forwarded to the main]
+python3 -m fedml_tpu.experiments.main_fedopt "$@"

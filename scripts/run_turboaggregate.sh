@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Launch wrapper for the turboaggregate experiment main (reference analog:
+# fedml_experiments/*/turboaggregate/run_*.sh -- mpirun replaced by one SPMD
+# process; pass --mesh N to shard clients over N devices).
+# Usage: sh run_turboaggregate.sh [extra --flags forwarded to the main]
+python3 -m fedml_tpu.experiments.main_turboaggregate "$@"
