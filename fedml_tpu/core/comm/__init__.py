@@ -1,2 +1,3 @@
 from fedml_tpu.core.comm.base import BaseCommunicationManager, Observer  # noqa: F401
 from fedml_tpu.core.comm.local import LocalCommNetwork, LocalCommManager  # noqa: F401
+from fedml_tpu.core.comm.tcp import TcpCommManager  # noqa: F401

@@ -2,9 +2,10 @@
 
 Interface parity with reference ``fedml_core/distributed/communication/
 base_com_manager.py:7-27`` and ``observer.py:4-7``. Concrete backends:
-``local`` (in-process queues, replaces MPI-on-localhost for simulation and
-tests), ``mqtt`` (device bridge, optional), and the ICI data plane which needs
-no manager at all -- it is XLA collectives inside the jitted round step.
+``local`` (in-process queues, for simulation and tests), ``tcp`` (real
+cross-process byte transport, the MPI-backend analog), ``mqtt`` (device
+bridge, optional), and the ICI data plane which needs no manager at all --
+it is XLA collectives inside the jitted round step.
 """
 
 from __future__ import annotations

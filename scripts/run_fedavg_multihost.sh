@@ -32,7 +32,9 @@ EOF
             --client_num_in_total 8 --client_num_per_round 8 \
             --comm_round 2 --epochs 1 --platform cpu "$@" &
     done
-    wait
+    # bare `wait` returns 0 regardless of child status -- wait per PID so
+    # a crashed rank fails the smoke
+    for pid in $(jobs -p); do wait "$pid"; done
     echo "multihost local smoke: OK"
 else
     : "${NUM_PROCESSES:?set NUM_PROCESSES}" \

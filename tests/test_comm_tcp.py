@@ -1,0 +1,86 @@
+"""TCP comm backend: a real byte-over-socket transport for the control
+plane (reference MPI-backend parity; round-2 note: the local backend alone
+is in-process only)."""
+
+import socket
+import threading
+import time
+
+from fedml_tpu.core.comm.tcp import TcpCommManager
+from fedml_tpu.core.message import Message
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class Recorder:
+    def __init__(self):
+        self.messages = []
+        self.event = threading.Event()
+
+    def receive_message(self, msg_type, msg):
+        self.messages.append((msg_type, msg.get_sender_id(),
+                              msg.get("payload")))
+        self.event.set()
+
+
+def test_full_star_protocol():
+    port = _free_port()
+    world = 3
+    recorders = {r: Recorder() for r in range(world)}
+    managers = {}
+
+    def client(rank):
+        m = TcpCommManager("localhost", port, rank, world, timeout=30.0)
+        m.add_observer(recorders[rank])
+        managers[rank] = m
+        # announce to server
+        msg = Message("client_ready", rank, 0)
+        msg.add("payload", f"hi from {rank}")
+        m.send_message(msg)
+        m.handle_receive_message()
+
+    threads = [threading.Thread(target=client, args=(r,), daemon=True)
+               for r in (1, 2)]
+    for t in threads:
+        t.start()
+    server = TcpCommManager("localhost", port, 0, world, timeout=30.0)
+    server.add_observer(recorders[0])
+    managers[0] = server
+    server_thread = threading.Thread(target=server.handle_receive_message,
+                                     daemon=True)
+    server_thread.start()
+
+    # both clients' HELLOs arrive at the server observer
+    deadline = time.time() + 20
+    while len(recorders[0].messages) < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    assert sorted(m[1] for m in recorders[0].messages) == [1, 2]
+    assert all(m[0] == "client_ready" for m in recorders[0].messages)
+
+    # server -> client delivery
+    out = Message("sync_model", 0, 1)
+    out.add("payload", [1.5, 2.5])
+    server.send_message(out)
+    assert recorders[1].event.wait(20)
+    assert recorders[1].messages[0] == ("sync_model", 0, [1.5, 2.5])
+
+    # client -> client routes through the hub
+    p2p = Message("gossip", 1, 2)
+    p2p.add("payload", "relay")
+    managers[1].send_message(p2p)
+    assert recorders[2].event.wait(20)
+    assert recorders[2].messages[0] == ("gossip", 1, "relay")
+
+    # clean shutdown: STOP frames, no thread assassination
+    server.stop_receive_message()
+    for t in threads:
+        t.join(timeout=20)
+    server_thread.join(timeout=20)
+    assert not any(t.is_alive() for t in threads)
+    assert not server_thread.is_alive()
