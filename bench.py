@@ -36,7 +36,10 @@ Prints one JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 import argparse
 import json
+import os
+import subprocess
 import sys
+import threading
 import time
 import traceback
 
@@ -65,6 +68,50 @@ def peak_flops(device) -> float:
         if key in kind:
             return tf * 1e12
     return 197.0e12  # assume v5e-class if unknown
+
+
+def emit_failure(error, **extra):
+    """The one-JSON-line contract holds on EVERY failure path."""
+    out = {"metric": "FedAvg rounds/hour (CIFAR-10-scale ResNet-56)",
+           "value": 0.0, "unit": "rounds/hour", "vs_baseline": 0.0,
+           "error": error}
+    out.update(extra)
+    print(json.dumps(out), flush=True)
+
+
+def probe_device(timeout_s=120.0):
+    """Check the accelerator tunnel is alive WITHOUT risking a hang.
+
+    The axon platform's relay can wedge such that every jax call (even
+    ``jax.devices()``) blocks forever in epoll; probing in a killable
+    subprocess keeps the bench's one-JSON-line contract intact. Returns
+    an error string, or None when the device answers."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0])"],
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return (f"device probe timed out after {timeout_s:.0f}s "
+                "(accelerator tunnel unreachable)")
+    if r.returncode != 0:
+        return f"device probe failed: {r.stderr[-500:]}"
+    return None
+
+
+def arm_watchdog(budget_s, context):
+    """Emit the JSON line and hard-exit if the bench wedges mid-run (a
+    round blocked on a dead device cannot be unblocked from Python)."""
+
+    def fire():
+        emit_failure(f"watchdog: no result within {budget_s:.0f}s "
+                     f"({context})")
+        os._exit(0)
+
+    t = threading.Timer(budget_s, fire)
+    t.daemon = True
+    t.start()
+    return t
 
 
 def build_api(args, epochs, client_chunk, wave_mode):
@@ -122,16 +169,19 @@ def measure(args, epochs, client_chunk, wave_mode):
     rounds = 1 if args.smoke else args.rounds
     times, metrics, samples = [], None, []
     err = None
-    for _ in range(rounds):
-        try:
-            t0 = time.time()
-            metrics = api.train_one_round()
-            times.append(time.time() - t0)
-            samples.append(float(np.asarray(
-                api._last_metrics["count"]).sum()))
-        except Exception:
-            err = traceback.format_exc(limit=3)
-            break
+    from fedml_tpu.utils.profiling import profile_trace
+    with profile_trace(args.profile_dir,
+                       enabled=args.profile_dir is not None):
+        for _ in range(rounds):
+            try:
+                t0 = time.time()
+                metrics = api.train_one_round()
+                times.append(time.time() - t0)
+                samples.append(float(np.asarray(
+                    api._last_metrics["count"]).sum()))
+            except Exception:
+                err = traceback.format_exc(limit=3)
+                break
     if not times:
         raise RuntimeError(err or "no measured rounds")
     return {
@@ -168,7 +218,23 @@ def main():
     p.add_argument("--device_dtype", type=str, default=None,
                    choices=("bf16", "bfloat16"),
                    help="halve the HBM residency of the data")
+    p.add_argument("--profile_dir", type=str, default=None,
+                   help="write a jax.profiler trace of the measured rounds")
     args = p.parse_args()
+
+    # the hang-probe only matters where the wedge exists: the axon relay
+    # (probing costs a full second accelerator init, so skip it elsewhere)
+    if os.environ.get("JAX_PLATFORMS", "") == "axon":
+        err = probe_device()
+        if err is not None:
+            emit_failure(err)  # ALWAYS print the one JSON line
+            sys.exit(0)
+    # budget scales with the workload: compile (~5 min worst) + one warmup
+    # + measured rounds at a generous 5 min/round ceiling, per rung walked
+    rungs = 1 if args.no_degrade else 6
+    budget_s = max(45 * 60, rungs * (5 * 60 + (args.rounds + 1) * 5 * 60))
+    watchdog = arm_watchdog(
+        budget_s, f"{args.rounds} rounds, ladder of {rungs}")
 
     import jax
 
@@ -210,12 +276,9 @@ def main():
             print(f"# rung failed: {rung}", file=sys.stderr)
 
     if meas is None:
-        # still ALWAYS print the one JSON line (driver contract)
-        print(json.dumps({
-            "metric": "FedAvg rounds/hour (CIFAR-10-scale ResNet-56)",
-            "value": 0.0, "unit": "rounds/hour", "vs_baseline": 0.0,
-            "error": failures[-1]["error"][-800:] if failures else "unknown",
-            "failed_configs": [f["config"] for f in failures]}))
+        emit_failure(
+            failures[-1]["error"][-800:] if failures else "unknown",
+            failed_configs=[f["config"] for f in failures])
         sys.exit(0)
 
     round_s = meas["round_s"]
@@ -267,6 +330,7 @@ def main():
         result["failed_configs"] = [f["config"] for f in failures]
     if meas["partial_error"]:
         result["partial_rounds_error"] = meas["partial_error"][-400:]
+    watchdog.cancel()
     print(json.dumps(result))
     print(f"# times={[round(t, 2) for t in meas['times']]} "
           f"train_acc={meas['train_acc']:.3f} "

@@ -80,11 +80,19 @@ class TcpCommManager(BaseCommunicationManager):
                 conn, _addr = self._listener.accept()
                 conn.settimeout(timeout)
                 hello = json.loads(_recv_frame(conn).decode())
+                peer_rank = int(hello["rank"])
+                if peer_rank in self._peers or peer_rank == 0:
+                    conn.close()
+                    raise ValueError(
+                        f"duplicate HELLO for rank {peer_rank} "
+                        "(two processes launched with the same rank?)")
                 # handshake done: drop the timeout -- long idle gaps
                 # (minutes of local training between control messages)
-                # must not tear down the transport
+                # must not tear down the transport; TCP keepalive still
+                # detects a dead peer vs an idle one
                 conn.settimeout(None)
-                self._peers[int(hello["rank"])] = conn
+                conn.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+                self._peers[peer_rank] = conn
         else:
             # retry the dial until the server is up (launch order between
             # hosts is not coordinated) or the timeout elapses
@@ -101,6 +109,7 @@ class TcpCommManager(BaseCommunicationManager):
                     time.sleep(0.05)
             _send_frame(self._sock, json.dumps({"rank": self.rank}).encode())
             self._sock.settimeout(None)  # see server side: idle != dead
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
 
     # -- BaseCommunicationManager ----------------------------------------
     def add_observer(self, observer):

@@ -209,7 +209,56 @@ def test_checkpoint_resume_continues_training(tmp_path):
     resumed._data_rng = saved["data_rng"]
     run(2, resumed)
     ckpt.close()
-
     for a, b in zip(jax.tree.leaves(full.global_state),
                     jax.tree.leaves(resumed.global_state)):
+        # 2e-4: float-reassociation noise tolerance (original choice)
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_resume_across_exec_modes(tmp_path):
+    """Checkpoint under one device-resident exec mode, resume under
+    another: all three modes consume the identical pack_schedule draw from
+    the shared host RNG stream and the identical per-client-step PRNG
+    derivation, so a lanes-run checkpoint continued in wave mode matches
+    an uninterrupted lanes run (up to float reassociation)."""
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    from fedml_tpu.algorithms.specs import make_classification_spec
+    from fedml_tpu.data.synthetic import load_synthetic_federated
+    from fedml_tpu import models
+
+    dataset = load_synthetic_federated(client_num=4, seed=0)
+    model = models.LogisticRegression(num_classes=dataset[7])
+    spec = make_classification_spec(
+        model, jnp.zeros((1, dataset[2]["x"].shape[1])))
+
+    def make_args(mode):
+        return _Args(client_num_in_total=4, client_num_per_round=4,
+                     comm_round=4, epochs=1, batch_size=8, lr=0.1,
+                     client_optimizer="sgd", frequency_of_the_test=100,
+                     seed=0, device_resident="auto", wave_mode=mode,
+                     client_chunk=2)
+
+    full = FedAvgAPI(dataset, spec, make_args(2))  # lanes, uninterrupted
+    assert full.device_data is not None
+    for _ in range(4):
+        full.train_one_round()
+
+    part = FedAvgAPI(dataset, spec, make_args(2))  # lanes, 2 rounds
+    for _ in range(2):
+        part.train_one_round()
+    ckpt = Checkpointer(str(tmp_path / "x"))
+    ckpt.save(part.round_idx, part.global_state, rng=part.rng,
+              data_rng=part._data_rng)
+
+    resumed = FedAvgAPI(dataset, spec, make_args(1))  # waves from here on
+    saved = ckpt.restore()
+    resumed.global_state = jax.tree.map(jnp.asarray, saved["global_state"])
+    resumed.rng = jnp.asarray(saved["rng"], dtype=jnp.uint32)
+    resumed.round_idx = saved["round_idx"]
+    resumed._data_rng = saved["data_rng"]
+    for _ in range(2):
+        resumed.train_one_round()
+    ckpt.close()
+    for a, b in zip(jax.tree.leaves(full.global_state),
+                    jax.tree.leaves(resumed.global_state)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
