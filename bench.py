@@ -224,7 +224,7 @@ def main():
 
     # the hang-probe only matters where the wedge exists: the axon relay
     # (probing costs a full second accelerator init, so skip it elsewhere)
-    if os.environ.get("JAX_PLATFORMS", "") == "axon":
+    if "axon" in os.environ.get("JAX_PLATFORMS", "").split(","):
         err = probe_device()
         if err is not None:
             emit_failure(err)  # ALWAYS print the one JSON line

@@ -1,0 +1,155 @@
+"""Reference-signature compatibility layer.
+
+The reference's public entry API is ``FedML_init()`` +
+``FedML_<Algo>_distributed(process_id, worker_number, device, comm, model,
+<8-tuple fields>, args, model_trainer=None)`` per algorithm
+(``fedml_api/distributed/fedavg/FedAvgAPI.py:10-25`` and siblings). This
+module keeps those call shapes so reference launch code ports with minimal
+edits, while the semantics map to the TPU design:
+
+- ``FedML_init``: no ``MPI.COMM_WORLD`` -- returns ``(None, process_index,
+  process_count)`` after the env-driven ``jax.distributed`` bring-up
+  (``parallel.multihost``). Single-process runs get ``(None, 0, 1)``.
+- ``model`` is a Flax module (the reference takes a torch ``nn.Module``);
+  ``device``/``comm`` are accepted and ignored -- placement is jax's.
+- every process runs the SAME SPMD round loop (there is no server/client
+  process split to branch on; the reference's ``if process_id == 0`` dance
+  collapses into one call).
+
+Returns the trained global state, so callers keep their evaluation code.
+"""
+
+from __future__ import annotations
+
+
+def FedML_init():
+    """Reference ``FedML_init`` (``FedAvgAPI.py:10-14``): grab the world.
+
+    Here: optional ``jax.distributed`` bring-up from env (see
+    ``multihost.maybe_initialize_distributed``); the first return slot
+    (MPI comm in the reference) is None.
+    """
+    from fedml_tpu.parallel.multihost import maybe_initialize_distributed
+
+    process_id, worker_number = maybe_initialize_distributed()
+    return None, process_id, worker_number
+
+
+def _dataset_tuple(train_data_num, train_data_global, test_data_global,
+                   train_data_local_num_dict, train_data_local_dict,
+                   test_data_local_dict, class_num):
+    test_num = (len(test_data_global["y"])
+                if test_data_global is not None else 0)
+    return [train_data_num, test_num, train_data_global, test_data_global,
+            train_data_local_num_dict, train_data_local_dict,
+            test_data_local_dict, class_num]
+
+
+def _spec_for(model, train_data_global, train_data_local_dict, class_num):
+    import jax.numpy as jnp
+
+    from fedml_tpu.algorithms.specs import make_classification_spec
+
+    src = train_data_global
+    if src is None or "x" not in src:
+        src = next(d for d in train_data_local_dict.values()
+                   if d is not None and len(d["y"]))
+    return make_classification_spec(model, jnp.asarray(src["x"][:1]),
+                                    num_classes=class_num)
+
+
+def _mesh_for(args):
+    n = int(getattr(args, "mesh", 0) or 0)
+    if not n:
+        return None
+    import jax
+
+    from fedml_tpu.parallel.mesh import make_client_mesh
+    return make_client_mesh(n, devices=jax.devices()[:n])
+
+
+def _run(api_cls, model, dataset_fields, args, **api_kw):
+    (train_data_num, train_data_global, test_data_global,
+     train_data_local_num_dict, train_data_local_dict,
+     test_data_local_dict) = dataset_fields
+    class_num = int(getattr(args, "class_num", 0) or 0)
+    if not class_num:
+        import numpy as np
+        ys = [np.asarray(d["y"]) for d in train_data_local_dict.values()
+              if d is not None and len(d["y"])]
+        class_num = int(max(int(y.max()) for y in ys) + 1)
+    dataset = _dataset_tuple(train_data_num, train_data_global,
+                             test_data_global, train_data_local_num_dict,
+                             train_data_local_dict, test_data_local_dict,
+                             class_num)
+    spec = _spec_for(model, train_data_global, train_data_local_dict,
+                     class_num)
+    api = api_cls(dataset, spec, args, mesh=_mesh_for(args), **api_kw)
+    api.train()
+    return api
+
+
+def FedML_FedAvg_distributed(process_id, worker_number, device, comm, model,
+                             train_data_num, train_data_global,
+                             test_data_global, train_data_local_num_dict,
+                             train_data_local_dict, test_data_local_dict,
+                             args, model_trainer=None):
+    """Signature parity: ``FedAvgAPI.py:17-25``. ``process_id``/``comm``/
+    ``device``/``model_trainer`` accepted for call-shape compatibility
+    (every process runs the same SPMD loop; pass a TrainSpec-style seam
+    via ``fedml_tpu.algorithms`` directly for custom trainers)."""
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+
+    return _run(FedAvgAPI, model,
+                (train_data_num, train_data_global, test_data_global,
+                 train_data_local_num_dict, train_data_local_dict,
+                 test_data_local_dict), args)
+
+
+def FedML_FedOpt_distributed(process_id, worker_number, device, comm, model,
+                             train_data_num, train_data_global,
+                             test_data_global, train_data_local_num_dict,
+                             train_data_local_dict, test_data_local_dict,
+                             args, model_trainer=None):
+    """Reference ``fedml_api/distributed/fedopt/FedOptAPI.py``."""
+    from fedml_tpu.algorithms.fedopt import FedOptAPI
+
+    return _run(FedOptAPI, model,
+                (train_data_num, train_data_global, test_data_global,
+                 train_data_local_num_dict, train_data_local_dict,
+                 test_data_local_dict), args)
+
+
+def FedML_FedNova_distributed(process_id, worker_number, device, comm, model,
+                              train_data_num, train_data_global,
+                              test_data_global, train_data_local_num_dict,
+                              train_data_local_dict, test_data_local_dict,
+                              args, model_trainer=None):
+    """Reference ``fedml_api/standalone/fednova`` (distributed call shape)."""
+    from fedml_tpu.algorithms.fednova import FedNovaAPI
+
+    return _run(FedNovaAPI, model,
+                (train_data_num, train_data_global, test_data_global,
+                 train_data_local_num_dict, train_data_local_dict,
+                 test_data_local_dict), args)
+
+
+def FedML_FedAvgRobust_distributed(process_id, worker_number, device, comm,
+                                   model, train_data_num, train_data_global,
+                                   test_data_global,
+                                   train_data_local_num_dict,
+                                   train_data_local_dict,
+                                   test_data_local_dict, args,
+                                   model_trainer=None):
+    """Reference ``fedml_api/distributed/fedavg_robust/FedAvgRobustAPI.py``."""
+    from fedml_tpu.algorithms.fedavg_robust import FedAvgRobustAPI
+
+    return _run(FedAvgRobustAPI, model,
+                (train_data_num, train_data_global, test_data_global,
+                 train_data_local_num_dict, train_data_local_dict,
+                 test_data_local_dict), args)
+
+
+__all__ = ["FedML_init", "FedML_FedAvg_distributed",
+           "FedML_FedOpt_distributed", "FedML_FedNova_distributed",
+           "FedML_FedAvgRobust_distributed"]

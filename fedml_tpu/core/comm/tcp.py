@@ -50,6 +50,19 @@ def _recv_exact(sock, n):
     return buf
 
 
+def _enable_keepalive(sock, idle=60, interval=10, count=5):
+    """Dead-peer detection at the federated-round timescale: without
+    tuning, Linux's first keepalive probe fires after tcp_keepalive_time
+    (default 7200 s) -- useless against a powered-off peer mid-run. With
+    these values a dead transport surfaces in ~idle + interval*count
+    (~2 min) while idle-but-alive peers stay connected indefinitely."""
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+    for opt, val in (("TCP_KEEPIDLE", idle), ("TCP_KEEPINTVL", interval),
+                     ("TCP_KEEPCNT", count)):
+        if hasattr(socket, opt):  # Linux; other OSes keep their defaults
+            sock.setsockopt(socket.IPPROTO_TCP, getattr(socket, opt), val)
+
+
 def _recv_frame(sock) -> bytes:
     (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
     if n > _MAX_FRAME:
@@ -81,17 +94,19 @@ class TcpCommManager(BaseCommunicationManager):
                 conn.settimeout(timeout)
                 hello = json.loads(_recv_frame(conn).decode())
                 peer_rank = int(hello["rank"])
-                if peer_rank in self._peers or peer_rank == 0:
+                if (peer_rank in self._peers or peer_rank <= 0
+                        or peer_rank >= self.world_size):
                     conn.close()
                     raise ValueError(
-                        f"duplicate HELLO for rank {peer_rank} "
-                        "(two processes launched with the same rank?)")
+                        f"invalid HELLO rank {peer_rank} for world size "
+                        f"{self.world_size} (duplicate or out-of-range "
+                        "rank -- misconfigured launch?)")
                 # handshake done: drop the timeout -- long idle gaps
                 # (minutes of local training between control messages)
                 # must not tear down the transport; TCP keepalive still
                 # detects a dead peer vs an idle one
                 conn.settimeout(None)
-                conn.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+                _enable_keepalive(conn)
                 self._peers[peer_rank] = conn
         else:
             # retry the dial until the server is up (launch order between
@@ -109,7 +124,7 @@ class TcpCommManager(BaseCommunicationManager):
                     time.sleep(0.05)
             _send_frame(self._sock, json.dumps({"rank": self.rank}).encode())
             self._sock.settimeout(None)  # see server side: idle != dead
-            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+            _enable_keepalive(self._sock)
 
     # -- BaseCommunicationManager ----------------------------------------
     def add_observer(self, observer):
