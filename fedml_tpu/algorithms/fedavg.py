@@ -17,8 +17,8 @@ import numpy as np
 
 from fedml_tpu.core.trainer import TrainSpec
 from fedml_tpu.parallel.engine import (
-    ClientUpdateConfig, LaneRunner, WaveRunner, make_indexed_sim_round,
-    make_sim_round, make_sharded_round, make_eval_fn)
+    ClientUpdateConfig, LaneRunner, ShardedLaneRunner, WaveRunner,
+    make_indexed_sim_round, make_sim_round, make_sharded_round, make_eval_fn)
 from fedml_tpu.parallel.mesh import shard_cohort  # noqa: F401 (re-export)
 from fedml_tpu.parallel.packing import (
     pack_cohort, pack_eval, pack_schedule, stack_clients)
@@ -84,49 +84,45 @@ class FedAvgAPI:
         # padded shard to HBM once; per-round host work shrinks to an index
         # schedule. Auto-enabled when the stacked arrays fit the cap.
         self.device_data = None
+        self.sharded_lane_runner = None
         device_resident = getattr(args, "device_resident", "auto")
         if str(device_resident).lower() in ("0", "false", "none", ""):
             device_resident = False
-        if mesh is None and device_resident:
-            C = len(self.train_data_local_dict)
-            n_max = max(1, max(len(d["y"])
-                               for d in self.train_data_local_dict.values()))
-            x0 = np.asarray(self.train_data_local_dict[0]["x"])
-            y0 = np.asarray(self.train_data_local_dict[0]["y"])
-            # optional reduced-precision residency: floating x only (token
-            # ids would be corrupted by a bf16 cast -- ids >= 257 round)
-            ddt = getattr(args, "device_dtype", None)
-            cast_bf16 = (ddt in ("bf16", "bfloat16")
-                         and np.issubdtype(x0.dtype, np.floating))
-            x_itemsize = 2 if cast_bf16 else x0.dtype.itemsize
-            row = (int(np.prod(x0.shape[1:], dtype=np.int64)) * x_itemsize
-                   + int(np.prod(y0.shape[1:], dtype=np.int64) or 1)
-                   * y0.dtype.itemsize)
-            cap = float(getattr(args, "device_data_cap_gb", 2.0)) * 1e9
-            if C * n_max * row <= cap:
-                import jax.numpy as jnp
-                stacked = stack_clients(
-                    [self.train_data_local_dict[i] for i in range(C)])
-                # halves the footprint; models cast inputs to their
-                # compute dtype anyway
-                self.device_data = {
-                    "x": jnp.asarray(stacked["x"],
-                                     dtype=jnp.bfloat16 if cast_bf16
-                                     else None),
-                    "y": jnp.asarray(stacked["y"])}
-                self._client_ns = stacked["n"]
-                # execution modes for device-resident rounds
-                # (--wave_mode): 2 = packed lanes (one dispatch, LPT-
-                # balanced, zero padded compute), 1 = size-sorted waves
-                # (default), 0 = flat single program (A/B / debugging)
-                chunk = getattr(args, "client_chunk", 8) or 8
-                self.wave_runner = WaveRunner(
-                    spec, cfg, payload_fn, server_fn, client_chunk=chunk)
-                self.lane_runner = LaneRunner(
-                    spec, cfg, payload_fn, server_fn, n_lanes=chunk)
-                self.indexed_round_fn = make_indexed_sim_round(
-                    spec, cfg, payload_fn, server_fn,
-                    client_chunk=getattr(args, "client_chunk", None))
+        chunk = getattr(args, "client_chunk", 8) or 8
+        # stacking copies the whole dataset host-side: only do it for the
+        # paths that will consume it (single-chip residency, or mesh lanes)
+        wants_residency = (mesh is None
+                           or int(getattr(args, "wave_mode", 1)) == 2)
+        stacked = (self._stack_if_fits(args)
+                   if device_resident and wants_residency else None)
+        if stacked is not None and mesh is None:
+            import jax.numpy as jnp
+            self.device_data = {"x": jnp.asarray(stacked["host"]["x"]),
+                                "y": jnp.asarray(stacked["host"]["y"])}
+            self._client_ns = stacked["n"]
+            # execution modes for device-resident rounds (--wave_mode):
+            # 2 = packed lanes (one dispatch, LPT-balanced, zero padded
+            # compute), 1 = size-sorted waves (default), 0 = flat single
+            # program (A/B / debugging)
+            self.wave_runner = WaveRunner(
+                spec, cfg, payload_fn, server_fn, client_chunk=chunk)
+            self.lane_runner = LaneRunner(
+                spec, cfg, payload_fn, server_fn, n_lanes=chunk)
+            self.indexed_round_fn = make_indexed_sim_round(
+                spec, cfg, payload_fn, server_fn,
+                client_chunk=getattr(args, "client_chunk", None))
+        elif (stacked is not None and mesh is not None
+                and int(getattr(args, "wave_mode", 1)) == 2):
+            # mesh + lanes: client rows live SHARDED over the mesh's
+            # clients axis; each shard runs its residents as packed lanes
+            # and aggregation is one psum (ShardedLaneRunner)
+            from fedml_tpu.parallel.multihost import global_cohort
+            host = stacked["host"]
+            placed = global_cohort(mesh, {"x": host["x"], "y": host["y"]})
+            self.device_data = {"x": placed["x"], "y": placed["y"]}
+            self._client_ns = stacked["n"]
+            self.sharded_lane_runner = ShardedLaneRunner(
+                spec, cfg, mesh, payload_fn, server_fn, n_lanes=chunk)
         self.server_state = server_state if server_state is not None else ()
 
         seed = getattr(args, "seed", 0)
@@ -135,6 +131,34 @@ class FedAvgAPI:
         self._data_rng = np.random.default_rng(seed)
         self.round_idx = 0
         self.history = []
+
+    def _stack_if_fits(self, args):
+        """Stack every client's padded shard for HBM residency when the
+        result fits ``device_data_cap_gb``. Applies the optional bf16 cast
+        (floating x only -- token ids would be corrupted). Returns
+        ``{"host": {"x","y"} numpy (cast applied), "n": [C]}`` or None."""
+        import jax.numpy as jnp
+
+        C = len(self.train_data_local_dict)
+        n_max = max(1, max(len(d["y"])
+                           for d in self.train_data_local_dict.values()))
+        x0 = np.asarray(self.train_data_local_dict[0]["x"])
+        y0 = np.asarray(self.train_data_local_dict[0]["y"])
+        ddt = getattr(args, "device_dtype", None)
+        cast_bf16 = (ddt in ("bf16", "bfloat16")
+                     and np.issubdtype(x0.dtype, np.floating))
+        x_itemsize = 2 if cast_bf16 else x0.dtype.itemsize
+        row = (int(np.prod(x0.shape[1:], dtype=np.int64)) * x_itemsize
+               + int(np.prod(y0.shape[1:], dtype=np.int64) or 1)
+               * y0.dtype.itemsize)
+        cap = float(getattr(args, "device_data_cap_gb", 2.0)) * 1e9
+        if C * n_max * row > cap:
+            return None
+        stacked = stack_clients(
+            [self.train_data_local_dict[i] for i in range(C)])
+        xh = (np.asarray(stacked["x"], dtype=jnp.bfloat16) if cast_bf16
+              else stacked["x"])
+        return {"host": {"x": xh, "y": stacked["y"]}, "n": stacked["n"]}
 
     def _cohort(self, round_idx):
         client_indexes = client_sampling(
@@ -170,7 +194,12 @@ class FedAvgAPI:
             sched = pack_schedule(ns, self.args.batch_size, self.args.epochs,
                                   rng=self._data_rng)
             mode = int(getattr(self.args, "wave_mode", 1))
-            if mode == 2:
+            if self.sharded_lane_runner is not None:
+                (self.global_state, self.server_state,
+                 info) = self.sharded_lane_runner.run_round(
+                    self.global_state, self.server_state, self.device_data,
+                    client_indexes, sched, round_rng)
+            elif mode == 2:
                 (self.global_state, self.server_state,
                  info) = self.lane_runner.run_round(
                     self.global_state, self.server_state, self.device_data,

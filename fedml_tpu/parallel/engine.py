@@ -336,12 +336,8 @@ class WaveRunner:
 
     def _payload_dtypes(self, global_state):
         if self._dtypes is None:
-            aux = {"n": jax.ShapeDtypeStruct((), jnp.float32),
-                   "steps": jax.ShapeDtypeStruct((), jnp.int32)}
-            shapes = jax.eval_shape(self.payload_fn, global_state,
-                                    global_state, aux)
-            self._dtypes = jax.tree.map(
-                lambda s: jnp.zeros((), s.dtype), shapes)
+            self._dtypes = payload_dtype_template(self.payload_fn,
+                                                  global_state)
         return self._dtypes
 
     def run_round(self, global_state, server_state, device_data, ids, sched,
@@ -409,6 +405,107 @@ class WaveRunner:
                                               "metrics": metrics_sum}
 
 
+def make_lane_update(spec: TrainSpec, cfg: ClientUpdateConfig, payload_fn):
+    """Build the per-lane sequential-clients update (shared by
+    :class:`LaneRunner` and :class:`ShardedLaneRunner`).
+
+    ``fn(global_state, data_x, data_y, n_max, rows, lane, step_keys, trip)
+    -> (payload_weighted_sum_f32, weight_sum, metrics_sum)`` where
+    ``data_x/data_y`` are device-resident stacks flattened on their first
+    two axes (``[R * n_max, ...]``), ``rows`` maps schedule slot -> device
+    row, ``lane`` is one lane's slice of the ``pack_lanes`` arrays and
+    ``step_keys [L, 2]`` the pre-folded per-step PRNG keys. The lane
+    trains its clients back-to-back: each client's final step flushes the
+    weighted payload into the accumulator and resets carried state to the
+    global model, so padded compute never executes.
+    """
+    optimizer = make_optimizer(cfg)
+
+    def lane_update(global_state, data_x, data_y, n_max, rows, lane,
+                    step_keys, trip):
+        g_params, g_rest = _split_state(global_state)
+        g_opt = optimizer.init(g_params)
+
+        def batch_at(i):
+            idx_b = jax.lax.dynamic_index_in_dim(
+                lane["idx"], i, axis=0, keepdims=False)
+            mask_b = jax.lax.dynamic_index_in_dim(
+                lane["mask"], i, axis=0, keepdims=False)
+            slot = jax.lax.dynamic_index_in_dim(
+                lane["slot"], i, axis=0, keepdims=False)
+            row = jnp.take(rows, slot)
+            flat = row * n_max + idx_b
+            return {"x": jnp.take(data_x, flat, axis=0),
+                    "y": jnp.take(data_y, flat, axis=0),
+                    "mask": mask_b}
+
+        def grad_at(params, rest, batch, step_rng):
+            if spec.augment_fn is not None:
+                batch = dict(batch)
+                batch["x"] = spec.augment_fn(
+                    batch["x"], jax.random.fold_in(step_rng, 13))
+
+            def loss_wrapper(p):
+                state = dict(rest)
+                state["params"] = p
+                return spec.loss_fn(state, batch, step_rng, True)
+
+            return jax.value_and_grad(loss_wrapper, has_aux=True)(params)
+
+        metrics0 = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype),
+            jax.eval_shape(lambda: grad_at(
+                g_params, g_rest, batch_at(0), step_keys[0]))[0][1][1])
+        aux0 = {"n": jnp.float32(0), "steps": jnp.int32(0)}
+        pay0 = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, jnp.float32),
+            jax.eval_shape(payload_fn, global_state, global_state, aux0))
+
+        def body(i, carry):
+            params, rest, opt_state, pay, w, msum = carry
+            batch = batch_at(i)
+            step_rng = jax.lax.dynamic_index_in_dim(
+                step_keys, i, axis=0, keepdims=False)
+            (_, (new_state, metrics)), grads = grad_at(
+                params, rest, batch, step_rng)
+            updates, new_opt = optimizer.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            new_rest = {k: new_state[k] for k in rest}
+            valid = jnp.sum(batch["mask"]) > 0
+            params, rest, opt_state = _tree_select(
+                valid, (new_params, new_rest, new_opt),
+                (params, rest, opt_state))
+            msum = jax.tree.map(jnp.add, msum, metrics)
+
+            # client boundary: flush weighted payload, reset to global
+            f = jax.lax.dynamic_index_in_dim(
+                lane["flush"], i, axis=0, keepdims=False)
+            f_n = jax.lax.dynamic_index_in_dim(
+                lane["flush_n"], i, axis=0, keepdims=False)
+            f_steps = jax.lax.dynamic_index_in_dim(
+                lane["flush_steps"], i, axis=0, keepdims=False)
+            local_state = dict(rest)
+            local_state["params"] = params
+            payload = payload_fn(local_state, global_state,
+                                 {"n": f_n,
+                                  "steps": f_steps.astype(jnp.int32)})
+            scale = f * f_n
+            pay = jax.tree.map(
+                lambda a, p: a + scale * p.astype(jnp.float32),
+                pay, payload)
+            w = w + scale
+            params, rest, opt_state = _tree_select(
+                f > 0, (g_params, g_rest, g_opt),
+                (params, rest, opt_state))
+            return (params, rest, opt_state, pay, w, msum)
+
+        carry = (g_params, g_rest, g_opt, pay0, jnp.float32(0), metrics0)
+        _, _, _, pay, w, msum = jax.lax.fori_loop(0, trip, body, carry)
+        return pay, w, msum
+
+    return lane_update
+
+
 class LaneRunner:
     """Packed-lane execution: the WHOLE round as ONE jitted dispatch.
 
@@ -434,100 +531,8 @@ class LaneRunner:
         self.payload_fn = payload_fn or _default_payload
         self.server_fn = server_fn or _default_server
         self.n_lanes = int(n_lanes or 8)
-        optimizer = make_optimizer(cfg)
-        payload_fn_ = self.payload_fn
+        lane_update = make_lane_update(spec, cfg, self.payload_fn)
         server_fn_ = self.server_fn
-
-        def lane_update(global_state, data_x, data_y, n_max, rows, lane,
-                        step_keys, trip):
-            """One lane: sequential clients with flush/reset boundaries.
-
-            ``data_x/data_y``: FULL device-resident stacks flattened on
-            their first two axes (``[R * n_max, ...]``); ``rows`` maps
-            cohort slot -> device row; ``lane`` is this lane's slice of
-            the ``pack_lanes`` arrays; ``step_keys [L, 2]`` the
-            pre-folded per-step PRNG keys.
-            """
-            g_params, g_rest = _split_state(global_state)
-            g_opt = optimizer.init(g_params)
-
-            def batch_at(i):
-                idx_b = jax.lax.dynamic_index_in_dim(
-                    lane["idx"], i, axis=0, keepdims=False)
-                mask_b = jax.lax.dynamic_index_in_dim(
-                    lane["mask"], i, axis=0, keepdims=False)
-                slot = jax.lax.dynamic_index_in_dim(
-                    lane["slot"], i, axis=0, keepdims=False)
-                row = jnp.take(rows, slot)
-                flat = row * n_max + idx_b
-                return {"x": jnp.take(data_x, flat, axis=0),
-                        "y": jnp.take(data_y, flat, axis=0),
-                        "mask": mask_b}
-
-            def grad_at(params, rest, batch, step_rng):
-                if spec.augment_fn is not None:
-                    batch = dict(batch)
-                    batch["x"] = spec.augment_fn(
-                        batch["x"], jax.random.fold_in(step_rng, 13))
-
-                def loss_wrapper(p):
-                    state = dict(rest)
-                    state["params"] = p
-                    return spec.loss_fn(state, batch, step_rng, True)
-
-                return jax.value_and_grad(loss_wrapper, has_aux=True)(params)
-
-            metrics0 = jax.tree.map(
-                lambda s: jnp.zeros(s.shape, s.dtype),
-                jax.eval_shape(lambda: grad_at(
-                    g_params, g_rest, batch_at(0), step_keys[0]))[0][1][1])
-            aux0 = {"n": jnp.float32(0), "steps": jnp.int32(0)}
-            pay0 = jax.tree.map(
-                lambda s: jnp.zeros(s.shape, jnp.float32),
-                jax.eval_shape(payload_fn_, global_state, global_state,
-                               aux0))
-
-            def body(i, carry):
-                params, rest, opt_state, pay, w, msum = carry
-                batch = batch_at(i)
-                step_rng = jax.lax.dynamic_index_in_dim(
-                    step_keys, i, axis=0, keepdims=False)
-                (_, (new_state, metrics)), grads = grad_at(
-                    params, rest, batch, step_rng)
-                updates, new_opt = optimizer.update(grads, opt_state, params)
-                new_params = optax.apply_updates(params, updates)
-                new_rest = {k: new_state[k] for k in rest}
-                valid = jnp.sum(batch["mask"]) > 0
-                params, rest, opt_state = _tree_select(
-                    valid, (new_params, new_rest, new_opt),
-                    (params, rest, opt_state))
-                msum = jax.tree.map(jnp.add, msum, metrics)
-
-                # client boundary: flush weighted payload, reset to global
-                f = jax.lax.dynamic_index_in_dim(
-                    lane["flush"], i, axis=0, keepdims=False)
-                f_n = jax.lax.dynamic_index_in_dim(
-                    lane["flush_n"], i, axis=0, keepdims=False)
-                f_steps = jax.lax.dynamic_index_in_dim(
-                    lane["flush_steps"], i, axis=0, keepdims=False)
-                local_state = dict(rest)
-                local_state["params"] = params
-                payload = payload_fn_(local_state, global_state,
-                                      {"n": f_n,
-                                       "steps": f_steps.astype(jnp.int32)})
-                scale = f * f_n
-                pay = jax.tree.map(
-                    lambda a, p: a + scale * p.astype(jnp.float32),
-                    pay, payload)
-                w = w + scale
-                params, rest, opt_state = _tree_select(
-                    f > 0, (g_params, g_rest, g_opt),
-                    (params, rest, opt_state))
-                return (params, rest, opt_state, pay, w, msum)
-
-            carry = (g_params, g_rest, g_opt, pay0, jnp.float32(0), metrics0)
-            _, _, _, pay, w, msum = jax.lax.fori_loop(0, trip, body, carry)
-            return pay, w, msum
 
         @jax.jit
         def round_fn(global_state, server_state, device_x, device_y, rows,
@@ -549,25 +554,14 @@ class LaneRunner:
                                                 server_state, rng)
             return new_global, new_server, metrics_sum
 
-        @jax.jit
-        def fold_keys(client_keys, slot, local_step):
-            # step_keys[k, i] = fold_in(key of the step's client, local step)
-            def one(s, t):
-                return jax.random.fold_in(jnp.take(client_keys, s, axis=0), t)
-            return jax.vmap(jax.vmap(one))(slot, local_step)
-
         self._round_fn = round_fn
-        self._fold_keys = fold_keys
+        self._fold_keys = fold_step_keys
         self._dtypes = None
 
     def _payload_dtypes(self, global_state):
         if self._dtypes is None:
-            aux = {"n": jax.ShapeDtypeStruct((), jnp.float32),
-                   "steps": jax.ShapeDtypeStruct((), jnp.int32)}
-            shapes = jax.eval_shape(self.payload_fn, global_state,
-                                    global_state, aux)
-            self._dtypes = jax.tree.map(
-                lambda s: jnp.zeros((), s.dtype), shapes)
+            self._dtypes = payload_dtype_template(self.payload_fn,
+                                                  global_state)
         return self._dtypes
 
     def run_round(self, global_state, server_state, device_data, ids, sched,
@@ -595,6 +589,172 @@ class LaneRunner:
             rows, lane_arrays, step_keys, trip,
             self._payload_dtypes(global_state), jax.random.fold_in(rng, 2))
         steps_pc = (np.asarray(sched["mask"]).sum(axis=2) > 0).sum(axis=1)
+        aux = {"n": np.asarray(sched["n"], np.float32),
+               "steps": steps_pc.astype(np.int64)}
+        return new_global, new_server, {"aux": aux, "metrics": metrics}
+
+
+class ShardedLaneRunner:
+    """Packed lanes over a ``clients`` mesh: the multi-chip round as one
+    SPMD dispatch with zero padded compute per shard.
+
+    Client shards live in HBM sharded over the mesh's ``clients`` axis
+    (each device owns a contiguous block of client rows); every mesh shard
+    runs ITS resident cohort members as LPT-packed lanes (the
+    :func:`make_lane_update` program), then the weighted payload sums meet
+    in a ``psum`` over ICI and the server step runs replicated. This
+    composes the single-chip lane design with the reference's multi-worker
+    scaling story (SURVEY.md section 2.7/2.8): where the reference gates
+    every round on its slowest client process and moves pickled
+    state_dicts through MPI, here the only cross-chip traffic is one
+    weighted-payload reduction.
+
+    The fori_loop trip count is the max lane load across ALL shards
+    (uniform SPMD control flow); shards with lighter loads run guarded
+    no-op steps for the difference, so balance comes from placing clients
+    on shards evenly (``FedAvgAPI`` places contiguous blocks; LDA skew
+    within a block is absorbed by the in-shard LPT packing).
+    """
+
+    def __init__(self, spec: TrainSpec, cfg: ClientUpdateConfig, mesh,
+                 payload_fn=None, server_fn=None, n_lanes=8):
+        self.payload_fn = payload_fn or _default_payload
+        self.server_fn = server_fn or _default_server
+        self.n_lanes = int(n_lanes or 8)
+        self.mesh = mesh
+        lane_update = make_lane_update(spec, cfg, self.payload_fn)
+        server_fn_ = self.server_fn
+
+        def shard_fn(global_state, server_state, dx, dy, rows, lanes,
+                     step_keys, trip, dtypes, rng):
+            # leading mesh axis arrives size-1 under shard_map: squeeze
+            rows_l = rows[0]
+            lanes_l = jax.tree.map(lambda a: a[0], lanes)
+            keys_l = step_keys[0]
+            R_local, n_max = dx.shape[0], dx.shape[1]
+            dxf = dx.reshape((R_local * n_max,) + dx.shape[2:])
+            dyf = dy.reshape((R_local * n_max,) + dy.shape[2:])
+            pay, w, msum = jax.vmap(
+                lane_update,
+                in_axes=(None, None, None, None, None, 0, 0, None))(
+                global_state, dxf, dyf, n_max, rows_l, lanes_l, keys_l,
+                trip)
+            pay_sum = jax.tree.map(
+                lambda x: jax.lax.psum(jnp.sum(x, axis=0), CLIENT_AXIS),
+                pay)
+            w_sum = jax.lax.psum(jnp.sum(w), CLIENT_AXIS)
+            metrics = jax.tree.map(
+                lambda m: jax.lax.psum(jnp.sum(m, axis=0), CLIENT_AXIS),
+                msum)
+            avg = jax.tree.map(
+                lambda s, d: (s / jnp.maximum(w_sum, 1e-12)).astype(d.dtype),
+                pay_sum, dtypes)
+            new_global, new_server = server_fn_(global_state, avg,
+                                                server_state, rng)
+            return new_global, new_server, metrics
+
+        sharded = jax.shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(P(), P(), P(CLIENT_AXIS), P(CLIENT_AXIS),
+                      P(CLIENT_AXIS), P(CLIENT_AXIS), P(CLIENT_AXIS),
+                      P(), P(), P()),
+            out_specs=(P(), P(), P()),
+            check_vma=False)
+        self._round_fn = jax.jit(sharded)
+        self._fold_keys = fold_step_keys
+        self._dtypes = None
+
+    def _payload_dtypes(self, global_state):
+        if self._dtypes is None:
+            self._dtypes = payload_dtype_template(self.payload_fn,
+                                                  global_state)
+        return self._dtypes
+
+    def run_round(self, global_state, server_state, device_data, ids, sched,
+                  rng):
+        """Same contract as :meth:`LaneRunner.run_round`; ``device_data``
+        is SHARDED over the mesh's client axis (row blocks of size
+        ``R / D``), and ``ids`` are global device rows."""
+        import numpy as np
+
+        from fedml_tpu.parallel.packing import pack_lanes
+
+        mask = np.asarray(sched["mask"])
+        C = mask.shape[0]
+        D = self.mesh.shape[CLIENT_AXIS]
+        R = int(device_data["x"].shape[0])
+        assert R % D == 0, (R, D)
+        block = R // D
+        ids = np.asarray(ids, np.int64)
+        K = self.n_lanes
+
+        # split the cohort by owning shard; size lanes with the cheap
+        # max-load query, pack arrays once per shard below
+        from fedml_tpu.parallel.packing import lane_max_load
+
+        steps_pc_all = (mask.sum(axis=2) > 0).sum(axis=1)
+        per_shard = []
+        l_needed = 1
+        for d in range(D):
+            members = np.nonzero((ids >= d * block)
+                                 & (ids < (d + 1) * block))[0]
+            sub = {k: np.asarray(sched[k])[members]
+                   for k in ("idx", "mask", "n")}
+            if len(members) == 0:
+                sub = {"idx": np.zeros((1,) + mask.shape[1:], np.int32),
+                       "mask": np.zeros((1,) + mask.shape[1:], np.float32),
+                       "n": np.zeros((1,), np.float32)}
+            else:
+                l_needed = max(l_needed,
+                               lane_max_load(steps_pc_all[members], K))
+            per_shard.append((members, sub))
+
+        # uniform allocation across shards (SPMD arrays must stack);
+        # power-of-two bucket bounds recompiles across rounds
+        L = 8
+        while L < l_needed:
+            L *= 2
+
+        client_keys = jax.random.split(jax.random.fold_in(rng, 1), C)
+        keys_np = np.asarray(client_keys)
+        lane_stack, key_stack, row_stack, trips = [], [], [], []
+        for d, (members, sub) in enumerate(per_shard):
+            lanes = pack_lanes(sub, K, l_max=L)
+            trips.append(lanes.pop("trip"))
+            local_step = lanes.pop("local_step")
+            k_sub = lanes["idx"].shape[0]
+            if k_sub < K:  # pack_lanes clamps K to the member count;
+                # pad with inert zero lanes so shards stack uniformly
+                lanes = {k: np.concatenate(
+                    [v, np.zeros((K - k_sub,) + v.shape[1:], v.dtype)])
+                    for k, v in lanes.items()}
+                local_step = np.concatenate(
+                    [local_step,
+                     np.zeros((K - k_sub,) + local_step.shape[1:],
+                              local_step.dtype)])
+            # slot -> LOCAL device row for this shard's member list
+            rows_local = np.zeros((max(block, 1),), np.int32)
+            if len(members):
+                rows_local[:len(members)] = ids[members] - d * block
+                member_keys = keys_np[members]
+            else:
+                member_keys = keys_np[:1]
+            lane_stack.append(lanes)
+            key_stack.append(self._fold_keys(
+                jnp.asarray(member_keys), jnp.asarray(lanes["slot"]),
+                jnp.asarray(local_step)))
+            row_stack.append(rows_local)
+        lanes_all = jax.tree.map(
+            lambda *xs: jnp.asarray(np.stack(xs)), *lane_stack)
+        keys_all = jnp.stack(key_stack)
+        rows_all = jnp.asarray(np.stack(row_stack))
+        trip = jnp.int32(max(max(trips), 1))
+
+        new_global, new_server, metrics = self._round_fn(
+            global_state, server_state, device_data["x"], device_data["y"],
+            rows_all, lanes_all, keys_all, trip,
+            self._payload_dtypes(global_state), jax.random.fold_in(rng, 2))
+        steps_pc = (mask.sum(axis=2) > 0).sum(axis=1)
         aux = {"n": np.asarray(sched["n"], np.float32),
                "steps": steps_pc.astype(np.int64)}
         return new_global, new_server, {"aux": aux, "metrics": metrics}
@@ -667,6 +827,28 @@ def _default_payload(local_state, global_state, aux):
 
 def _default_server(global_state, avg_payload, server_state, rng):
     return avg_payload, server_state
+
+
+def payload_dtype_template(payload_fn, global_state):
+    """Zero-scalar pytree carrying the payload's dtypes (the accumulators
+    run in f32; the final average casts back through this template).
+    Shared by every accumulate-then-normalize runner."""
+    aux = {"n": jax.ShapeDtypeStruct((), jnp.float32),
+           "steps": jax.ShapeDtypeStruct((), jnp.int32)}
+    shapes = jax.eval_shape(payload_fn, global_state, global_state, aux)
+    return jax.tree.map(lambda s: jnp.zeros((), s.dtype), shapes)
+
+
+@jax.jit
+def fold_step_keys(client_keys, slot, local_step):
+    """Per-step PRNG keys for packed lanes:
+    ``keys[k, i] = fold_in(client_keys[slot[k, i]], local_step[k, i])`` --
+    the exact per-client-step derivation of the flat paths."""
+
+    def one(s, t):
+        return jax.random.fold_in(jnp.take(client_keys, s, axis=0), t)
+
+    return jax.vmap(jax.vmap(one))(slot, local_step)
 
 
 def make_sim_round(spec: TrainSpec, cfg: ClientUpdateConfig,

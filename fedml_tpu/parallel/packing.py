@@ -210,7 +210,19 @@ def pack_schedule(ns, batch_size, epochs, rng=None, drop_last=False,
             "n": np.asarray(ns, np.float32)}
 
 
-def pack_lanes(sched, n_lanes, step_bucket=8):
+def lane_max_load(steps_per_client, n_lanes) -> int:
+    """Max lane load under the same LPT assignment ``pack_lanes`` uses --
+    the cheap first-pass sizing query (no schedule arrays are built)."""
+    steps = np.asarray(steps_per_client, np.int64)
+    order = np.argsort(-steps, kind="stable")
+    K = max(1, min(int(n_lanes), len(steps)))
+    loads = np.zeros(K, np.int64)
+    for c in order:
+        loads[int(np.argmin(loads))] += int(steps[c])
+    return int(loads.max())
+
+
+def pack_lanes(sched, n_lanes, step_bucket=8, l_max=None):
     """Re-lay a packed cohort schedule ``[C, S, B]`` into ``n_lanes``
     PACKED LANES for single-dispatch rounds (``engine.LaneRunner``).
 
@@ -253,6 +265,12 @@ def pack_lanes(sched, n_lanes, step_bucket=8):
         loads[k] += int(steps_pc[c])
     L = int(loads.max())
     L = int(math.ceil(max(L, 1) / step_bucket) * step_bucket)
+    if l_max is not None:
+        # caller-forced allocation length (sharded lanes pad every shard
+        # to one uniform L so the SPMD arrays stack)
+        if l_max < loads.max():
+            raise ValueError(f"l_max={l_max} < max lane load {loads.max()}")
+        L = int(l_max)
 
     out_idx = np.zeros((K, L, B), np.int32)
     out_mask = np.zeros((K, L, B), np.float32)

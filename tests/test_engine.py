@@ -13,8 +13,9 @@ from fedml_tpu.algorithms.fedavg import FedAvgAPI, client_sampling
 from fedml_tpu.core import pytree
 from fedml_tpu.data import load_synthetic_federated
 from fedml_tpu.parallel.engine import (
-    ClientUpdateConfig, LaneRunner, WaveRunner, make_client_update,
-    make_indexed_sim_round, make_sim_round, make_sharded_round, make_eval_fn)
+    ClientUpdateConfig, LaneRunner, ShardedLaneRunner, WaveRunner,
+    make_client_update, make_indexed_sim_round, make_sim_round,
+    make_sharded_round, make_eval_fn)
 from fedml_tpu.parallel.mesh import make_client_mesh
 from fedml_tpu.parallel.packing import (
     pack_cohort, pack_eval, pack_schedule, stack_clients)
@@ -286,6 +287,75 @@ class TestWaveRunner:
         K = lanes["idx"].shape[0]
         assert lanes["trip"] <= steps_pc.sum() / K + steps_pc.max()
 
+    def test_sharded_lanes_equal_flat(self):
+        """Multi-chip lanes: rows sharded over an 8-device mesh, every
+        shard runs its residents as packed lanes, psum aggregation --
+        result equals the flat single-device round."""
+        from fedml_tpu.parallel.multihost import global_cohort
+
+        sizes = (40, 8, 24, 16, 5, 31, 12, 9, 27, 14, 6)  # 11 clients
+        spec, cfg, state, dd, sched = self._setup(sizes)
+        rng = jax.random.PRNGKey(3)
+
+        flat = make_indexed_sim_round(spec, cfg)
+        js = {k: jnp.asarray(v) for k, v in sched.items()}
+        s_flat, _, info_flat = flat(state, (), dd, js, rng)
+
+        mesh = make_client_mesh(8)
+        placed = global_cohort(mesh, {"x": np.asarray(dd["x"]),
+                                      "y": np.asarray(dd["y"])})
+        slr = ShardedLaneRunner(spec, cfg, mesh, n_lanes=2)
+        s_sh, _, info_sh = slr.run_round(
+            state, (), placed, list(range(len(sizes))), sched, rng)
+
+        for a, b in zip(jax.tree.leaves(s_flat), jax.tree.leaves(s_sh)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5)
+        mf = jax.tree.map(lambda x: np.asarray(x).sum(0),
+                          info_flat["metrics"])
+        ms = jax.tree.map(np.asarray, info_sh["metrics"])
+        np.testing.assert_allclose(mf["count"], ms["count"], rtol=1e-6)
+
+    def test_sharded_lanes_subset_cohort_with_hook(self):
+        """Cohort subset (some shards own zero members) + FedOpt-style
+        server hook through the sharded lanes."""
+        from fedml_tpu.core import pytree as pt
+        from fedml_tpu.parallel.multihost import global_cohort
+
+        def payload_fn(local_state, global_state, aux):
+            return pt.tree_sub(global_state["params"], local_state["params"])
+
+        def server_fn(global_state, avg_delta, server_state, rng):
+            new = dict(global_state)
+            new["params"] = pt.tree_sub(
+                global_state["params"], pt.tree_scale(avg_delta, 0.5))
+            return new, server_state
+
+        sizes = (10, 40, 6, 28, 18, 22, 9, 33)
+        spec, cfg, state, dd, _ = self._setup(sizes)
+        cohort = [1, 6, 2]  # rows land on a strict subset of shards
+        ns = [40, 9, 6]
+        sched = pack_schedule(ns, 8, epochs=1,
+                              rng=np.random.default_rng(5))
+        rng = jax.random.PRNGKey(9)
+
+        flat = make_indexed_sim_round(spec, cfg, payload_fn, server_fn)
+        sel = np.asarray(cohort)
+        dd_sub = {k: jnp.asarray(np.asarray(v)[sel]) for k, v in dd.items()}
+        js = {k: jnp.asarray(v) for k, v in sched.items()}
+        s_flat, _, _ = flat(state, (), dd_sub, js, rng)
+
+        mesh = make_client_mesh(8)
+        placed = global_cohort(mesh, {"x": np.asarray(dd["x"]),
+                                      "y": np.asarray(dd["y"])})
+        slr = ShardedLaneRunner(spec, cfg, mesh, payload_fn, server_fn,
+                                n_lanes=2)
+        s_sh, _, info = slr.run_round(state, (), placed, cohort, sched, rng)
+        assert float(np.asarray(info["metrics"]["count"])) == sum(ns)
+        for a, b in zip(jax.tree.leaves(s_flat), jax.tree.leaves(s_sh)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5)
+
     def test_wave_subset_cohort(self):
         # cohort is a subset of device rows, in non-sorted order
         sizes = (10, 40, 6, 28, 18)
@@ -353,6 +423,32 @@ class TestFedAvgAPI:
         # per-client labeling functions (LEAF synthetic) cap global accuracy;
         # 0.25 is well above the 0.1 chance level
         assert final["Test/Acc"] > 0.25
+
+    def test_mesh_lanes_match_classic_mesh_path(self):
+        """FedAvgAPI with mesh + wave_mode=2 (sharded lanes) must match
+        the classic sharded round (pack_cohort path): both consume the
+        same one-draw schedule contract, so trajectories agree."""
+        dataset = load_synthetic_federated(client_num=8, n_train=640,
+                                           n_test=160, seed=0)
+        spec = _lr_spec()
+        mesh = make_client_mesh(8)
+
+        def run(mode):
+            args = _args(client_num_per_round=8, comm_round=2, lr=0.3,
+                         frequency_of_the_test=100, wave_mode=mode,
+                         client_chunk=2, device_resident="auto")
+            api = FedAvgAPI(dataset, spec, args, mesh=mesh)
+            if mode == 2:
+                assert api.sharded_lane_runner is not None
+            api.train_one_round()
+            api.train_one_round()
+            return api.global_state
+
+        classic = run(1)   # pack_cohort + make_sharded_round
+        lanes = run(2)     # sharded device residency + packed lanes
+        for a, b in zip(jax.tree.leaves(classic), jax.tree.leaves(lanes)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5)
 
     def test_wave_mode_2_lane_rounds(self):
         dataset = load_synthetic_federated(client_num=8, n_train=800,
