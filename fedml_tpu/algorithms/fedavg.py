@@ -235,9 +235,27 @@ class FedAvgAPI:
         self.round_idx += 1
         return train_metrics
 
+    def _packed_global_eval(self):
+        """Global test set packed ONCE (shared by every evaluate_global,
+        incl. subclasses). Small packs additionally stay device-resident
+        PERMANENTLY -- gated to 25% of ``device_data_cap_gb`` so the
+        steady-state HBM reservation is bounded; configs tuned to the full
+        cap should lower it or raise the cap. Large packs cache host-side
+        (skipping the re-pack, still re-uploading per eval)."""
+        if not hasattr(self, "_eval_packed"):
+            packed = pack_eval(self.test_data_global, self.args.batch_size)
+            nbytes = sum(v.nbytes for v in packed.values())
+            cap = 0.25 * float(
+                getattr(self.args, "device_data_cap_gb", 2.0)) * 1e9
+            if nbytes <= cap:
+                import jax.numpy as jnp
+                packed = {k: jnp.asarray(v) for k, v in packed.items()}
+            self._eval_packed = packed
+        return self._eval_packed
+
     def evaluate_global(self):
-        packed = pack_eval(self.test_data_global, self.args.batch_size)
-        m = jax.tree.map(np.asarray, self.eval_fn(self.global_state, packed))
+        m = jax.tree.map(np.asarray, self.eval_fn(
+            self.global_state, self._packed_global_eval()))
         return {"Test/Loss": float(m["loss_sum"] / max(m["count"], 1)),
                 "Test/Acc": float(m["correct"] / max(m["count"], 1))}
 

@@ -23,7 +23,6 @@ import numpy as np
 
 from fedml_tpu.algorithms.fedavg import FedAvgAPI
 from fedml_tpu.core.seg_eval import Evaluator
-from fedml_tpu.parallel.packing import pack_eval
 from fedml_tpu.utils.schedules import make_lr_schedule
 
 
@@ -53,8 +52,8 @@ class FedSegAPI(FedAvgAPI):
         self.checkpoint_metric = "Seg/mIoU"
 
     def evaluate_global(self):
-        packed = pack_eval(self.test_data_global, self.args.batch_size)
-        m = jax.tree.map(np.asarray, self.eval_fn(self.global_state, packed))
+        m = jax.tree.map(np.asarray, self.eval_fn(
+            self.global_state, self._packed_global_eval()))
         ev = Evaluator(self.num_classes)
         ev.add_matrix(m["confusion"])
         out = {"Test/Loss": float(m["loss_sum"] / max(m["count"], 1)),

@@ -1,0 +1,120 @@
+"""Import torch ResNet checkpoints into the Flax CifarResNet.
+
+Migration aid: reference users hold torch ``state_dict`` checkpoints of
+``fedml_api/model/cv/resnet.py`` resnet56/110 (torchvision-style naming:
+``conv1.weight``, ``bn1.{weight,bias,running_mean,running_var}``,
+``layer{s}.{b}.conv{i}.weight``, ``layer{s}.{b}.downsample.{0,1}.*``,
+``fc.{weight,bias}``). This converts such a dict -- as plain numpy, no
+torch import required -- into the parameter/batch-stats pytree of
+``fedml_tpu.models.resnet.CifarResNet`` (module names
+``layer{s}_block{b}/{conv1,bn1,conv2,bn2,downsample_conv,downsample_bn}``).
+
+Layout transforms:
+- conv kernels: torch OIHW -> flax HWIO.
+- linear: torch [out, in] -> flax [in, out].
+- BN: weight/bias -> scale/bias params; running_mean/var -> batch_stats.
+
+``export_torch_state_dict`` is the exact inverse, so round-trips are
+bit-exact (tested) and TPU-trained models can go back to torch tooling.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _np(t):
+    """torch tensors (if any) or arrays -> numpy, without importing torch."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def _conv_in(w):
+    return np.transpose(_np(w), (2, 3, 1, 0))  # OIHW -> HWIO
+
+
+def _conv_out(w):
+    return np.transpose(np.asarray(w), (3, 2, 0, 1))  # HWIO -> OIHW
+
+
+def _bn_in(sd, prefix):
+    return ({"scale": _np(sd[f"{prefix}.weight"]),
+             "bias": _np(sd[f"{prefix}.bias"])},
+            {"mean": _np(sd[f"{prefix}.running_mean"]),
+             "var": _np(sd[f"{prefix}.running_var"])})
+
+
+def _bn_out(params, stats, sd, prefix):
+    sd[f"{prefix}.weight"] = np.asarray(params["scale"])
+    sd[f"{prefix}.bias"] = np.asarray(params["bias"])
+    sd[f"{prefix}.running_mean"] = np.asarray(stats["mean"])
+    sd[f"{prefix}.running_var"] = np.asarray(stats["var"])
+    # torch BatchNorm state_dicts carry this buffer; strict load_state_dict
+    # fails without it. Flax has no equivalent, so export a zero count.
+    sd[f"{prefix}.num_batches_tracked"] = np.asarray(0, dtype=np.int64)
+
+
+def load_torch_resnet(state_dict, depth):
+    """torch state_dict (tensors or arrays) -> ``{"params", "batch_stats"}``
+    for ``CifarResNet(depth=depth)``. Raises KeyError on missing entries
+    (a wrong-depth or non-CIFAR-ResNet dict fails fast)."""
+    n = (depth - 2) // 6
+    params = {"conv1": {"kernel": _conv_in(state_dict["conv1.weight"])}}
+    stats = {}
+    params["bn1"], stats["bn1"] = _bn_in(state_dict, "bn1")
+    for s in (1, 2, 3):
+        for b in range(n):
+            name = f"layer{s}_block{b}"
+            tp = f"layer{s}.{b}"
+            blk_p = {"conv1": {"kernel": _conv_in(
+                state_dict[f"{tp}.conv1.weight"])},
+                "conv2": {"kernel": _conv_in(
+                    state_dict[f"{tp}.conv2.weight"])}}
+            blk_s = {}
+            blk_p["bn1"], blk_s["bn1"] = _bn_in(state_dict, f"{tp}.bn1")
+            blk_p["bn2"], blk_s["bn2"] = _bn_in(state_dict, f"{tp}.bn2")
+            if f"{tp}.downsample.0.weight" in state_dict:
+                blk_p["downsample_conv"] = {"kernel": _conv_in(
+                    state_dict[f"{tp}.downsample.0.weight"])}
+                (blk_p["downsample_bn"],
+                 blk_s["downsample_bn"]) = _bn_in(state_dict,
+                                                  f"{tp}.downsample.1")
+            params[name] = blk_p
+            stats[name] = blk_s
+    params["fc"] = {"kernel": _np(state_dict["fc.weight"]).T,
+                    "bias": _np(state_dict["fc.bias"])}
+    return {"params": params, "batch_stats": stats}
+
+
+def export_torch_resnet(state, depth):
+    """Inverse of :func:`load_torch_resnet`: Flax CifarResNet state ->
+    torch-style state_dict of numpy arrays."""
+    n = (depth - 2) // 6
+    params, stats = state["params"], state["batch_stats"]
+    sd = {"conv1.weight": _conv_out(params["conv1"]["kernel"])}
+    _bn_out(params["bn1"], stats["bn1"], sd, "bn1")
+    for s in (1, 2, 3):
+        for b in range(n):
+            name = f"layer{s}_block{b}"
+            tp = f"layer{s}.{b}"
+            sd[f"{tp}.conv1.weight"] = _conv_out(
+                params[name]["conv1"]["kernel"])
+            sd[f"{tp}.conv2.weight"] = _conv_out(
+                params[name]["conv2"]["kernel"])
+            _bn_out(params[name]["bn1"], stats[name]["bn1"], sd,
+                    f"{tp}.bn1")
+            _bn_out(params[name]["bn2"], stats[name]["bn2"], sd,
+                    f"{tp}.bn2")
+            if "downsample_conv" in params[name]:
+                sd[f"{tp}.downsample.0.weight"] = _conv_out(
+                    params[name]["downsample_conv"]["kernel"])
+                _bn_out(params[name]["downsample_bn"],
+                        stats[name]["downsample_bn"], sd,
+                        f"{tp}.downsample.1")
+    sd["fc.weight"] = np.asarray(params["fc"]["kernel"]).T
+    sd["fc.bias"] = np.asarray(params["fc"]["bias"])
+    return sd
+
+
+__all__ = ["load_torch_resnet", "export_torch_resnet"]
