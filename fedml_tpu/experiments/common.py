@@ -74,6 +74,12 @@ def add_base_args(parser: argparse.ArgumentParser):
                    help="keep device-resident floating image data in "
                         "bfloat16 (half the HBM footprint; default keeps "
                         "source dtype; integer data is never cast)")
+    p.add_argument("--model_dtype", type=str, default=None,
+                   choices=("bf16", "bfloat16"),
+                   help="compute-dtype for the model zoo: bf16 runs convs/"
+                        "matmuls as 1-pass MXU ops (~2x step throughput on "
+                        "CIFAR ResNets) while master params and the "
+                        "optimizer stay fp32; default fp32")
     p.add_argument("--platform", type=str, default=None,
                    help="force a jax platform (e.g. cpu); needed because "
                         "the container pins JAX_PLATFORMS and ignores env "

@@ -7,6 +7,8 @@ assert this. Inputs are NHWC ``[B, 28, 28]`` or ``[B, 28, 28, 1]``.
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -20,17 +22,19 @@ def _ensure_nhwc(x):
 class CNNOriginalFedAvg(nn.Module):
     """2x(conv5x5 + maxpool) + 512-dense (reference ``cnn.py:5-69``)."""
     only_digits: bool = True
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = _ensure_nhwc(x)
-        x = nn.Conv(32, (5, 5), padding=2, name="conv1")(x)
+        x = _ensure_nhwc(x).astype(self.dtype)
+        x = nn.Conv(32, (5, 5), padding=2, dtype=self.dtype, name="conv1")(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = nn.Conv(64, (5, 5), padding=2, name="conv2")(x)
+        x = nn.Conv(64, (5, 5), padding=2, dtype=self.dtype, name="conv2")(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(512, name="fc1")(x))
-        return nn.Dense(10 if self.only_digits else 62, name="fc2")(x)
+        x = nn.relu(nn.Dense(512, dtype=self.dtype, name="fc1")(x))
+        return nn.Dense(10 if self.only_digits else 62, dtype=jnp.float32,
+                        name="fc2")(x.astype(jnp.float32))
 
 
 class CNNDropOut(nn.Module):
@@ -38,15 +42,19 @@ class CNNDropOut(nn.Module):
     conv3x3(32) -> conv3x3(64) -> maxpool -> dropout .25 -> dense 128 ->
     dropout .5 -> head. 1,199,882 params with ``only_digits=True``."""
     only_digits: bool = True
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        x = _ensure_nhwc(x)
-        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID", name="conv1")(x))
-        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", name="conv2")(x))
+        x = _ensure_nhwc(x).astype(self.dtype)
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID", dtype=self.dtype,
+                            name="conv1")(x))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", dtype=self.dtype,
+                            name="conv2")(x))
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = nn.Dropout(0.25, deterministic=not train)(x)
         x = x.reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(128, name="fc1")(x))
+        x = nn.relu(nn.Dense(128, dtype=self.dtype, name="fc1")(x))
         x = nn.Dropout(0.5, deterministic=not train)(x)
-        return nn.Dense(10 if self.only_digits else 62, name="fc2")(x)
+        return nn.Dense(10 if self.only_digits else 62, dtype=jnp.float32,
+                        name="fc2")(x.astype(jnp.float32))

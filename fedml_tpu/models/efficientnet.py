@@ -85,6 +85,7 @@ class MBConvBlock(nn.Module):
     se_ratio: float
     norm: Any
     drop_rate: float = 0.0
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -92,19 +93,23 @@ class MBConvBlock(nn.Module):
         mid = in_ch * self.expand_ratio
         y = x
         if self.expand_ratio != 1:
-            y = nn.Conv(mid, (1, 1), use_bias=False, name="expand")(y)
+            y = nn.Conv(mid, (1, 1), use_bias=False, dtype=self.dtype,
+                        name="expand")(y)
             y = nn.swish(self.norm(name="bn0")(y))
         y = nn.Conv(mid, (self.kernel, self.kernel), strides=self.strides,
                     padding=self.kernel // 2, feature_group_count=mid,
-                    use_bias=False, name="dw")(y)
+                    use_bias=False, dtype=self.dtype, name="dw")(y)
         y = nn.swish(self.norm(name="bn1")(y))
         if self.se_ratio > 0:
             se_ch = max(1, int(in_ch * self.se_ratio))
             s = jnp.mean(y, axis=(1, 2))
-            s = nn.swish(nn.Dense(se_ch, name="se_reduce")(s))
-            s = nn.sigmoid(nn.Dense(mid, name="se_expand")(s))
+            s = nn.swish(nn.Dense(se_ch, dtype=self.dtype,
+                                  name="se_reduce")(s))
+            s = nn.sigmoid(nn.Dense(mid, dtype=self.dtype,
+                                    name="se_expand")(s))
             y = y * s[:, None, None, :]
-        y = nn.Conv(self.out_filters, (1, 1), use_bias=False, name="project")(y)
+        y = nn.Conv(self.out_filters, (1, 1), use_bias=False,
+                    dtype=self.dtype, name="project")(y)
         y = self.norm(name="bn2")(y)
         if self.strides == 1 and in_ch == self.out_filters:
             if train and self.drop_rate > 0:
@@ -129,7 +134,8 @@ class EfficientNet(nn.Module):
                        momentum=0.99, epsilon=1e-3, dtype=self.dtype)
         x = x.astype(self.dtype)
         x = nn.Conv(round_filters(32, self.width_coef), (3, 3), strides=2,
-                    padding=1, use_bias=False, name="stem")(x)
+                    padding=1, use_bias=False, dtype=self.dtype,
+                    name="stem")(x)
         x = nn.swish(norm(name="bn_stem")(x))
 
         total = sum(round_repeats(b.num_repeat, self.depth_coef)
@@ -143,12 +149,12 @@ class EfficientNet(nn.Module):
                 rate = self.drop_connect_rate * idx / total
                 x = MBConvBlock(b.kernel, b.strides if r == 0 else 1,
                                 b.expand_ratio, out_f, b.se_ratio, norm,
-                                drop_rate=rate,
+                                drop_rate=rate, dtype=self.dtype,
                                 name=f"block{si}_{r}")(x, train=train)
                 idx += 1
 
         x = nn.Conv(round_filters(1280, self.width_coef), (1, 1),
-                    use_bias=False, name="head")(x)
+                    use_bias=False, dtype=self.dtype, name="head")(x)
         x = nn.swish(norm(name="bn_head")(x))
         x = jnp.mean(x, axis=(1, 2))
         if self.dropout_rate > 0:

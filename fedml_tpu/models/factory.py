@@ -22,35 +22,48 @@ def create_model(args, model_name, output_dim):
                  model_name, output_dim)
     group_norm = getattr(args, "group_norm_channels", 32) if args else 32
     only_digits = output_dim == 10
+    # --model_dtype bf16: compute-dtype for the zoo (master params stay
+    # fp32; convs/matmuls run 1-pass bf16 on the MXU -- the single biggest
+    # single-chip throughput knob, see docs/PERFORMANCE.md)
+    dt = {}
+    dt_name = getattr(args, "model_dtype", None) if args else None
+    if dt_name in ("bf16", "bfloat16"):
+        import jax.numpy as jnp
+        dt = {"dtype": jnp.bfloat16}
 
     if model_name == "lr":
         return models.LogisticRegression(num_classes=output_dim)
     if model_name == "cnn":
-        return models.CNNOriginalFedAvg(only_digits=only_digits)
+        return models.CNNOriginalFedAvg(only_digits=only_digits, **dt)
     if model_name == "cnn_dropout":
-        return models.CNNDropOut(only_digits=only_digits)
+        return models.CNNDropOut(only_digits=only_digits, **dt)
     if model_name == "resnet56":
-        return models.resnet56(class_num=output_dim)
+        return models.resnet56(class_num=output_dim, **dt)
     if model_name == "resnet110":
-        return models.resnet110(class_num=output_dim)
+        return models.resnet110(class_num=output_dim, **dt)
     if model_name == "resnet18_gn":
-        return models.resnet18_gn(class_num=output_dim, group_norm=group_norm)
+        return models.resnet18_gn(class_num=output_dim, group_norm=group_norm,
+                                  **dt)
     if model_name == "resnet34_gn":
-        return models.resnet34_gn(class_num=output_dim, group_norm=group_norm)
+        return models.resnet34_gn(class_num=output_dim, group_norm=group_norm,
+                                  **dt)
     if model_name == "resnet50_gn":
-        return models.resnet50_gn(class_num=output_dim, group_norm=group_norm)
+        return models.resnet50_gn(class_num=output_dim, group_norm=group_norm,
+                                  **dt)
     if model_name == "mobilenet":
-        return models.MobileNet(num_classes=output_dim)
+        return models.MobileNet(num_classes=output_dim, **dt)
     if model_name == "mobilenet_v3":
         mode = getattr(args, "model_mode", "LARGE") if args else "LARGE"
-        return models.MobileNetV3(model_mode=mode, num_classes=output_dim)
+        return models.MobileNetV3(model_mode=mode, num_classes=output_dim,
+                                  **dt)
     if model_name.startswith("efficientnet"):
         name = "efficientnet-b0" if model_name == "efficientnet" else model_name
-        return models.efficientnet(name, num_classes=output_dim)
+        return models.efficientnet(name, num_classes=output_dim, **dt)
     if model_name in ("vgg11", "vgg13", "vgg16", "vgg19"):
         fn = getattr(models, model_name)
         return fn(class_num=output_dim,
-                  batch_norm=getattr(args, "vgg_bn", False) if args else False)
+                  batch_norm=getattr(args, "vgg_bn", False) if args else False,
+                  **dt)
     if model_name == "rnn":
         return models.RNNOriginalFedAvg(vocab_size=output_dim)
     if model_name == "rnn_fed_shakespeare":
@@ -58,4 +71,6 @@ def create_model(args, model_name, output_dim):
                                         output_all_timesteps=True)
     if model_name == "rnn_stackoverflow":
         return models.RNNStackOverflow(vocab_size=output_dim - 4)
+    if model_name in ("transformer", "transformer_nwp"):
+        return models.transformer_nwp(vocab_size=output_dim, **dt)
     raise ValueError(f"unknown model: {model_name}")

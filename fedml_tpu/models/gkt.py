@@ -29,10 +29,11 @@ class GKTClientResNet(nn.Module):
         norm = partial(nn.BatchNorm, use_running_average=not train,
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype)
         x = x.astype(self.dtype)
-        x = nn.Conv(16, (3, 3), padding=1, use_bias=False, name="conv1")(x)
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=False, dtype=self.dtype,
+                    name="conv1")(x)
         x = nn.relu(norm(name="bn1")(x))
         for b in range(self.n_blocks):
-            x = BasicBlock(16, 1, norm, name=f"block{b}")(x)
+            x = BasicBlock(16, 1, norm, dtype=self.dtype, name=f"block{b}")(x)
         features = x
         pooled = jnp.mean(x, axis=(1, 2)).astype(jnp.float32)
         logits = nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(pooled)
@@ -54,6 +55,7 @@ class GKTServerResNet(nn.Module):
         for stage, (filters, strides) in enumerate([(32, 2), (64, 2)]):
             for b in range(self.n):
                 x = BasicBlock(filters, strides if b == 0 else 1, norm,
+                               dtype=self.dtype,
                                name=f"layer{stage + 2}_block{b}")(x)
         x = jnp.mean(x, axis=(1, 2))
         return nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(

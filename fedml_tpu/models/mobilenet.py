@@ -19,14 +19,17 @@ class _DepthwiseSeparable(nn.Module):
     filters: int
     strides: int
     norm: Any
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
         in_ch = x.shape[-1]
         x = nn.Conv(in_ch, (3, 3), strides=self.strides, padding=1,
-                    feature_group_count=in_ch, use_bias=False, name="dw")(x)
+                    feature_group_count=in_ch, use_bias=False,
+                    dtype=self.dtype, name="dw")(x)
         x = nn.relu(self.norm(name="bn1")(x))
-        x = nn.Conv(self.filters, (1, 1), use_bias=False, name="pw")(x)
+        x = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="pw")(x)
         return nn.relu(self.norm(name="bn2")(x))
 
 
@@ -41,10 +44,11 @@ class MobileNet(nn.Module):
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype)
         x = x.astype(self.dtype)
         x = nn.Conv(32, (3, 3), strides=1, padding=1, use_bias=False,
-                    name="conv1")(x)
+                    dtype=self.dtype, name="conv1")(x)
         x = nn.relu(norm(name="bn1")(x))
         for i, (filters, strides) in enumerate(_CFG):
-            x = _DepthwiseSeparable(filters, strides, norm, name=f"block{i}")(x)
+            x = _DepthwiseSeparable(filters, strides, norm, dtype=self.dtype,
+                                    name=f"block{i}")(x)
         x = jnp.mean(x, axis=(1, 2))
         return nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(
             x.astype(jnp.float32))

@@ -41,12 +41,15 @@ class SqueezeExcite(nn.Module):
     ``mobilenet_v3.py:64-81``, divide=4)."""
     channels: int
     divide: int = 4
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
         s = jnp.mean(x, axis=(1, 2))
-        s = nn.relu(nn.Dense(self.channels // self.divide, name="fc1")(s))
-        s = h_sigmoid(nn.Dense(self.channels, name="fc2")(s))
+        s = nn.relu(nn.Dense(self.channels // self.divide, dtype=self.dtype,
+                             name="fc1")(s))
+        s = h_sigmoid(nn.Dense(self.channels, dtype=self.dtype,
+                               name="fc2")(s))
         return x * s[:, None, None, :]
 
 
@@ -60,6 +63,7 @@ class _Bneck(nn.Module):
     use_hs: bool  # h-swish if True else ReLU
     strides: int
     norm: Any
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
@@ -67,16 +71,18 @@ class _Bneck(nn.Module):
         in_ch = x.shape[-1]
         y = x
         if self.exp_size != in_ch:
-            y = nn.Conv(self.exp_size, (1, 1), use_bias=False, name="expand")(y)
+            y = nn.Conv(self.exp_size, (1, 1), use_bias=False,
+                        dtype=self.dtype, name="expand")(y)
             y = act(self.norm(name="bn1")(y))
         y = nn.Conv(self.exp_size, (self.kernel, self.kernel),
                     strides=self.strides, padding=self.kernel // 2,
                     feature_group_count=self.exp_size, use_bias=False,
-                    name="dw")(y)
+                    dtype=self.dtype, name="dw")(y)
         y = act(self.norm(name="bn2")(y))
         if self.use_se:
-            y = SqueezeExcite(self.exp_size, name="se")(y)
-        y = nn.Conv(self.out_channels, (1, 1), use_bias=False, name="project")(y)
+            y = SqueezeExcite(self.exp_size, dtype=self.dtype, name="se")(y)
+        y = nn.Conv(self.out_channels, (1, 1), use_bias=False,
+                    dtype=self.dtype, name="project")(y)
         y = self.norm(name="bn3")(y)
         if self.strides == 1 and in_ch == self.out_channels:
             y = y + x
@@ -139,17 +145,18 @@ class MobileNetV3(nn.Module):
 
         stem = _make_divisible(16 * self.multiplier)
         x = nn.Conv(stem, (3, 3), strides=2, padding=1, use_bias=False,
-                    name="stem")(x)
+                    dtype=self.dtype, name="stem")(x)
         x = h_swish(norm(name="bn_stem")(x))
         for i, (k, e, c, se, hs, s) in enumerate(cfg):
             x = _Bneck(k, _make_divisible(e * self.multiplier),
                        _make_divisible(c * self.multiplier), se, hs, s, norm,
-                       name=f"bneck{i}")(x)
+                       dtype=self.dtype, name=f"bneck{i}")(x)
         head = _make_divisible(last_exp * self.multiplier)
-        x = nn.Conv(head, (1, 1), use_bias=False, name="head_conv")(x)
+        x = nn.Conv(head, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="head_conv")(x)
         x = h_swish(norm(name="bn_head")(x))
         x = jnp.mean(x, axis=(1, 2))
-        x = h_swish(nn.Dense(1280, name="head_fc")(x))
+        x = h_swish(nn.Dense(1280, dtype=self.dtype, name="head_fc")(x))
         if self.dropout_rate > 0:
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         return nn.Dense(self.num_classes, dtype=jnp.float32,

@@ -23,19 +23,24 @@ class BasicBlock(nn.Module):
     filters: int
     strides: int = 1
     norm: ModuleDef = None
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
+        # dtype threads into every conv: with bf16 it casts the fp32 params
+        # to bf16 at apply time so the MXU runs 1-pass bf16 matmuls (fp32
+        # convs are ~6x slower); master params/optimizer stay fp32
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
         residual = x
-        y = nn.Conv(self.filters, (3, 3), strides=self.strides, padding=1,
-                    use_bias=False, name="conv1")(x)
+        y = conv(self.filters, (3, 3), strides=self.strides, padding=1,
+                 name="conv1")(x)
         y = self.norm(name="bn1")(y)
         y = nn.relu(y)
-        y = nn.Conv(self.filters, (3, 3), padding=1, use_bias=False, name="conv2")(y)
+        y = conv(self.filters, (3, 3), padding=1, name="conv2")(y)
         y = self.norm(name="bn2")(y)
         if residual.shape != y.shape:
-            residual = nn.Conv(self.filters, (1, 1), strides=self.strides,
-                               use_bias=False, name="downsample_conv")(x)
+            residual = conv(self.filters, (1, 1), strides=self.strides,
+                            name="downsample_conv")(x)
             residual = self.norm(name="downsample_bn")(residual)
         return nn.relu(y + residual)
 
@@ -53,12 +58,14 @@ class CifarResNet(nn.Module):
         norm = partial(nn.BatchNorm, use_running_average=not train,
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype)
         x = x.astype(self.dtype)
-        x = nn.Conv(16, (3, 3), padding=1, use_bias=False, name="conv1")(x)
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=False, dtype=self.dtype,
+                    name="conv1")(x)
         x = norm(name="bn1")(x)
         x = nn.relu(x)
         for stage, (filters, strides) in enumerate([(16, 1), (32, 2), (64, 2)]):
             for block in range(n):
                 x = BasicBlock(filters, strides if block == 0 else 1, norm,
+                               dtype=self.dtype,
                                name=f"layer{stage + 1}_block{block}")(x)
         x = jnp.mean(x, axis=(1, 2))
         return nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(

@@ -32,19 +32,21 @@ class _BasicBlockGN(nn.Module):
     filters: int
     strides: int
     norm: Any
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
         residual = x
-        y = nn.Conv(self.filters, (3, 3), strides=self.strides, padding=1,
-                    use_bias=False, name="conv1")(x)
+        y = conv(self.filters, (3, 3), strides=self.strides, padding=1,
+                 name="conv1")(x)
         y = self.norm(name="bn1")(y)
         y = nn.relu(y)
-        y = nn.Conv(self.filters, (3, 3), padding=1, use_bias=False, name="conv2")(y)
+        y = conv(self.filters, (3, 3), padding=1, name="conv2")(y)
         y = self.norm(name="bn2")(y)
         if residual.shape != y.shape:
-            residual = nn.Conv(self.filters, (1, 1), strides=self.strides,
-                               use_bias=False, name="downsample_conv")(x)
+            residual = conv(self.filters, (1, 1), strides=self.strides,
+                            name="downsample_conv")(x)
             residual = self.norm(name="downsample_bn")(residual)
         return nn.relu(y + residual)
 
@@ -53,20 +55,22 @@ class _BottleneckGN(nn.Module):
     filters: int
     strides: int
     norm: Any
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
         residual = x
-        y = nn.Conv(self.filters, (1, 1), use_bias=False, name="conv1")(x)
+        y = conv(self.filters, (1, 1), name="conv1")(x)
         y = nn.relu(self.norm(name="bn1")(y))
-        y = nn.Conv(self.filters, (3, 3), strides=self.strides, padding=1,
-                    use_bias=False, name="conv2")(y)
+        y = conv(self.filters, (3, 3), strides=self.strides, padding=1,
+                 name="conv2")(y)
         y = nn.relu(self.norm(name="bn2")(y))
-        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False, name="conv3")(y)
+        y = conv(self.filters * 4, (1, 1), name="conv3")(y)
         y = self.norm(name="bn3")(y)
         if residual.shape != y.shape:
-            residual = nn.Conv(self.filters * 4, (1, 1), strides=self.strides,
-                               use_bias=False, name="downsample_conv")(x)
+            residual = conv(self.filters * 4, (1, 1), strides=self.strides,
+                            name="downsample_conv")(x)
             residual = self.norm(name="downsample_bn")(residual)
         return nn.relu(y + residual)
 
@@ -85,18 +89,19 @@ class ResNetGN(nn.Module):
         block_cls = _BasicBlockGN if self.block == "basic" else _BottleneckGN
         x = x.astype(self.dtype)
         if self.small_input:
-            x = nn.Conv(64, (3, 3), padding=1, use_bias=False, name="conv1")(x)
+            x = nn.Conv(64, (3, 3), padding=1, use_bias=False,
+                        dtype=self.dtype, name="conv1")(x)
             x = nn.relu(norm(name="bn1")(x))
         else:
             x = nn.Conv(64, (7, 7), strides=2, padding=3, use_bias=False,
-                        name="conv1")(x)
+                        dtype=self.dtype, name="conv1")(x)
             x = nn.relu(norm(name="bn1")(x))
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
         for stage, size in enumerate(self.stage_sizes):
             filters = 64 * (2 ** stage)
             for b in range(size):
                 strides = 2 if (stage > 0 and b == 0) else 1
-                x = block_cls(filters, strides, norm,
+                x = block_cls(filters, strides, norm, dtype=self.dtype,
                               name=f"layer{stage + 1}_block{b}")(x)
         x = jnp.mean(x, axis=(1, 2))
         return nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(

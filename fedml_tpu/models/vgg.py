@@ -34,7 +34,8 @@ class VGG(nn.Module):
             if v == "M":
                 x = nn.max_pool(x, (2, 2), strides=(2, 2))
             else:
-                x = nn.Conv(v, (3, 3), padding=1, name=f"conv{conv_i}")(x)
+                x = nn.Conv(v, (3, 3), padding=1, dtype=self.dtype,
+                            name=f"conv{conv_i}")(x)
                 if self.batch_norm:
                     x = nn.BatchNorm(use_running_average=not train,
                                      momentum=0.9, epsilon=1e-5,
@@ -43,7 +44,7 @@ class VGG(nn.Module):
                 conv_i += 1
         x = x.reshape((x.shape[0], -1))
         for i, h in enumerate(self.classifier_dims):
-            x = nn.relu(nn.Dense(h, name=f"fc{i}")(x))
+            x = nn.relu(nn.Dense(h, dtype=self.dtype, name=f"fc{i}")(x))
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(
             x.astype(jnp.float32))
