@@ -1,0 +1,23 @@
+"""TPU compute ops: blockwise/flash attention, ring (sequence-parallel)
+attention, and Pallas TPU kernels.
+
+The reference has no attention anywhere -- its sequence models are 2-layer
+LSTMs over short fixed windows (SURVEY.md section 5.7) and its only
+"long-context" story is truncation in preprocessing. This package is the
+net-new long-context layer the TPU rebuild makes first-class:
+
+- :mod:`fedml_tpu.ops.attention` -- single-device blockwise attention with an
+  online softmax (flash semantics, O(T) memory in the sequence).
+- :mod:`fedml_tpu.ops.ring_attention` -- the same computation with the
+  sequence sharded over a mesh axis; K/V blocks rotate around the ring via
+  ``ppermute`` over ICI while every shard keeps only its own Q.
+- :mod:`fedml_tpu.ops.pallas_attention` -- fused flash-attention forward as a
+  Pallas TPU kernel (VMEM-blocked, MXU matmuls), with a recompute backward.
+"""
+
+from fedml_tpu.ops.attention import blockwise_attention, mha
+from fedml_tpu.ops.pallas_attention import flash_attention
+from fedml_tpu.ops.ring_attention import make_ring_attention, ring_attention
+
+__all__ = ["blockwise_attention", "mha", "ring_attention",
+           "make_ring_attention", "flash_attention"]

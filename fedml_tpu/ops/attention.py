@@ -1,0 +1,150 @@
+"""Blockwise attention with an online softmax (flash semantics) in pure JAX.
+
+This is the local building block of :mod:`fedml_tpu.ops.ring_attention`: it
+scans KV in blocks carrying ``(acc, row_sum, row_max)`` so the full
+``[T, T]`` score matrix never materializes -- O(T) memory in sequence
+length, and every matmul is a large bf16-friendly contraction for the MXU.
+
+No reference counterpart exists (the reference has no attention at all,
+SURVEY.md section 5.7); the algorithm is the standard online-softmax
+reformulation (Flash Attention), expressed with ``lax.scan`` so XLA fuses
+the rescaling into the matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _block_scores(q, k, scale, bias_block):
+    # q [Bq, H, D] x k [Bk, H, D] -> [H, Bq, Bk], fp32 accumulation
+    s = jnp.einsum("qhd,khd->hqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if bias_block is not None:
+        s = s + bias_block
+    return s
+
+
+def _online_step(carry, q, k, v, scale, bias_block):
+    """One KV-block update of the online softmax.
+
+    carry: ``acc [H, Bq, D] f32``, ``row_sum [H, Bq] f32``,
+    ``row_max [H, Bq] f32``.
+    """
+    acc, row_sum, row_max = carry
+    s = _block_scores(q, k, scale, bias_block)  # [H, Bq, Bk]
+    blk_max = jnp.max(s, axis=-1)
+    new_max = jnp.maximum(row_max, blk_max)
+    # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be exp(0)
+    correction = jnp.exp(row_max - new_max)
+    p = jnp.exp(s - new_max[..., None])
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    new_sum = row_sum * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("hqk,khd->hqd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    new_acc = acc * correction[..., None] + pv
+    return (new_acc, new_sum, jnp.where(new_max <= NEG_INF / 2,
+                                        row_max, new_max))
+
+
+def _finalize(acc, row_sum):
+    return acc / jnp.maximum(row_sum, 1e-30)[..., None]
+
+
+def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        block_size: int = 512, causal: bool = False,
+                        bias: Optional[jax.Array] = None,
+                        scale: Optional[float] = None,
+                        q_offset=0, k_offset=0) -> jax.Array:
+    """Attention over ``q/k/v [B, T, H, D]`` scanning KV in blocks.
+
+    ``bias`` (optional) broadcasts against ``[B, H, Tq, Tk]`` (additive,
+    pre-softmax -- use ``NEG_INF`` entries for masking). ``causal`` applies
+    the lower-triangular mask in GLOBAL positions ``q_offset + i`` vs
+    ``k_offset + j`` (the offsets -- static ints or traced scalars -- are
+    what lets ring attention reuse this with rotated KV shards). Output
+    matches ``softmax(q k^T * scale + bias) v`` exactly (up to fp32
+    reassociation).
+    """
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = scale if scale is not None else D ** -0.5
+    nblocks = -(-Tk // block_size)
+    pad = nblocks * block_size - Tk
+    if bias is not None:
+        # normalize broadcast dims so the per-batch vmap and per-block
+        # dynamic slice are exact
+        bias = jnp.broadcast_to(bias, (B, H, Tq, Tk))
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        mask_pad = jnp.arange(nblocks * block_size) < Tk
+        if bias is not None:
+            # keep bias block-sliceable (padded keys are masked anyway,
+            # so the pad value is irrelevant; 0 keeps it finite)
+            bias = jnp.pad(bias, ((0, 0),) * 3 + ((0, pad),))
+    else:
+        mask_pad = None
+
+    kb = k.reshape(B, nblocks, block_size, H, D)
+    vb = v.reshape(B, nblocks, block_size, H, D)
+
+    def one_batch(qb, kblocks, vblocks, bias_b):
+        def scan_fn(carry, xs):
+            kblk, vblk, j = xs
+            bias_blk = None
+            if bias_b is not None:
+                bias_blk = jax.lax.dynamic_slice_in_dim(
+                    bias_b, j * block_size, block_size, axis=2)
+            if causal:
+                qpos = q_offset + jnp.arange(Tq)[:, None]
+                kpos = (k_offset + j * block_size
+                        + jnp.arange(block_size)[None, :])
+                cmask = (kpos <= qpos)[None]  # [1, Tq, Bk]
+                bias_blk = (jnp.where(cmask, 0.0, NEG_INF) if bias_blk is None
+                            else bias_blk + jnp.where(cmask, 0.0, NEG_INF))
+            if mask_pad is not None:
+                pmask = jax.lax.dynamic_slice_in_dim(
+                    mask_pad, j * block_size, block_size)[None, None, :]
+                bias_blk = (jnp.where(pmask, 0.0, NEG_INF) if bias_blk is None
+                            else bias_blk + jnp.where(pmask, 0.0, NEG_INF))
+            return _online_step(carry, qb, kblk, vblk, scale, bias_blk), None
+
+        acc0 = jnp.zeros((H, Tq, D), jnp.float32)
+        sum0 = jnp.zeros((H, Tq), jnp.float32)
+        max0 = jnp.full((H, Tq), NEG_INF, jnp.float32)
+        (acc, rsum, _), _ = jax.lax.scan(
+            scan_fn, (acc0, sum0, max0),
+            (kblocks, vblocks, jnp.arange(nblocks)))
+        return _finalize(acc, rsum)  # [H, Tq, D]
+
+    bias_in = (bias if bias is not None
+               else None)
+    out = jax.vmap(one_batch, in_axes=(0, 0, 0,
+                                       0 if bias is not None else None))(
+        q, kb, vb, bias_in)
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B, Tq, H, D]
+
+
+def mha(q, k, v, causal: bool = False, scale: Optional[float] = None):
+    """Plain (materializing) multi-head attention -- the correctness oracle
+    the blockwise/ring/pallas paths are tested against."""
+    D = q.shape[-1]
+    scale = scale if scale is not None else D ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        Tq, Tk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((Tq, Tk), bool), k=Tk - Tq)
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+__all__ = ["blockwise_attention", "mha", "NEG_INF"]

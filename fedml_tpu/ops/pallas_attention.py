@@ -1,0 +1,167 @@
+"""Flash-attention forward as a fused Pallas TPU kernel.
+
+The hot op for long-context transformer workloads: one kernel instance
+computes a ``[BLOCK_Q, D]`` output tile by streaming KV blocks through VMEM
+with the online-softmax recurrence -- scores never touch HBM. Matmuls hit
+the MXU in the input dtype (bf16-friendly) with fp32 accumulation
+(``preferred_element_type``); the softmax state (running max / sum) lives in
+fp32 VMEM scratch across the KV grid dimension.
+
+Backward runs by recompute through :func:`fedml_tpu.ops.attention.
+blockwise_attention` (identical math, so gradients are exact); the fused
+kernel wins the forward where the memory traffic is. ``interpret=True`` is
+used automatically off-TPU so the same code path tests on CPU
+(``tests/test_ops.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from fedml_tpu.ops.attention import NEG_INF, blockwise_attention
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                scale, causal, block_q, block_k, seq_len):
+    qi = pl.program_id(0)   # query tile
+    kj = pl.program_id(1)   # kv tile (innermost grid dim)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def _body():
+        q = q_ref[:]                      # [block_q, D]
+        k = k_ref[:]                      # [block_k, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        ragged = seq_len % block_k != 0
+        if causal or ragged:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            valid = kpos < seq_len  # zero-padded keys must not attend
+            if causal:
+                valid = valid & (kpos <= qpos)
+            s = jnp.where(valid, s, NEG_INF)
+
+        # m/l scratch is lane-replicated [bq, 128] (the fp32 VMEM tile is
+        # (8, 128); a [bq, 1] buffer would fight the layout) -- column 0 is
+        # the value
+        m_prev = m_ref[:, :1]             # [bq, 1]
+        blk_max = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, blk_max)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_ref[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, D]
+        acc_ref[:] = acc_ref[:] * corr + pv
+        m_keep = jnp.where(m_new <= NEG_INF / 2, m_prev, m_new)
+        m_ref[:] = jnp.broadcast_to(m_keep, m_ref.shape)
+
+    if causal:
+        # skip KV tiles strictly above the diagonal band
+        pl.when(kj * block_k <= qi * block_q + (block_q - 1))(_body)
+    else:
+        _body()
+
+    @pl.when(kj == pl.num_programs(1) - 1)
+    def _finalize():
+        o_ref[:] = (acc_ref[:]
+                    / jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def _fwd_one_head(q, k, v, *, scale, causal, block_q, block_k, k_len,
+                  interpret):
+    Tq, D = q.shape
+    Tk = k.shape[0]
+    grid = (pl.cdiv(Tq, block_q), pl.cdiv(Tk, block_k))
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, seq_len=k_len)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_q, D), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_k, D), lambda i, j: (j, 0)),
+            pl.BlockSpec((block_k, D), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_q, D), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((Tq, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
+                    block_k=128):
+    """Fused attention ``[B, T, H, D] -> [B, T, H, D]``.
+
+    Forward is the Pallas kernel (per ``(batch, head)`` via vmap -- the
+    kernel grid covers query x kv tiles); backward recomputes through the
+    pure-JAX blockwise path. Sequence lengths must be multiples of the
+    block sizes after padding (handled here); D should be a multiple of
+    128 for MXU alignment (typical head dims 128/256).
+    """
+    return _fa_fwd(q, k, v, causal, scale, block_q, block_k)[0]
+
+
+def _fa_fwd(q, k, v, causal, scale, block_q, block_k):
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale_ = scale if scale is not None else D ** -0.5
+    interpret = jax.default_backend() != "tpu"
+    bq, bk = min(block_q, Tq), min(block_k, Tk)
+    pad_q = (-Tq) % bq
+    pad_k = (-Tk) % bk
+    qp = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0))) if pad_q else q
+    kp = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0))) if pad_k else k
+    vp = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0))) if pad_k else v
+    # padded KV rows are masked inside the kernel (kpos < seq_len);
+    # padded q rows are sliced off below
+    fn = functools.partial(_fwd_one_head, scale=scale_, causal=causal,
+                           block_q=bq, block_k=bk, k_len=Tk,
+                           interpret=interpret)
+    # [B, T, H, D]: outer vmap strips batch, inner maps the head axis
+    # (axis 1 of the remaining [T, H, D]) so the kernel sees [T, D]
+    per_head = jax.vmap(fn, in_axes=1, out_axes=1)
+    out = jax.vmap(per_head)(qp, kp, vp)
+    if pad_q:
+        out = out[:, :Tq]
+    return out, (q, k, v)
+
+
+def _fa_bwd(causal, scale, block_q, block_k, res, g):
+    q, k, v = res
+    scale_ = scale if scale is not None else q.shape[-1] ** -0.5
+
+    def ref(q, k, v):
+        return blockwise_attention(q, k, v, causal=causal, scale=scale_,
+                                   block_size=max(block_k, 128))
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+__all__ = ["flash_attention"]
