@@ -99,13 +99,16 @@ def _ring_body(q, k, v, axis_name, causal, scale, block_size):
 def make_ring_attention(mesh, axis_name: str = SEQ_AXIS,
                         causal: bool = False,
                         scale: Optional[float] = None,
-                        block_size: int = 512):
+                        block_size: int = 512,
+                        batch_axis: Optional[str] = None):
     """Build ``fn(q, k, v) -> out`` with ``[B, T, H, D]`` arrays whose T is
-    sharded over ``mesh[axis_name]``. The returned fn is jittable and
-    differentiable (JAX transposes the ppermutes automatically)."""
+    sharded over ``mesh[axis_name]`` (and, when ``batch_axis`` is given, B
+    sharded over that axis too -- dp x sp without gathering the batch).
+    The returned fn is jittable and differentiable (JAX transposes the
+    ppermutes automatically)."""
     body = partial(_ring_body, axis_name=axis_name, causal=causal,
                    scale=scale, block_size=block_size)
-    spec = P(None, axis_name, None, None)
+    spec = P(batch_axis, axis_name, None, None)
     return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)
 

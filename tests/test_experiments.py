@@ -144,6 +144,19 @@ def test_main_fednas_search_and_train(tmp_path):
     assert api2.round_idx == 1
 
 
+def test_main_longcontext_seq_parallel(tmp_path):
+    """Sequence-parallel LM training over the 8-device CPU mesh (2 data x
+    4 seq): loss must fall on the synthetic token stream."""
+    from fedml_tpu.experiments import main_longcontext
+    _, losses = main_longcontext.main(
+        ["--n_data", "2", "--n_seq", "4", "--steps", "8",
+         "--batch_size", "4", "--seq_len", "32", "--lr", "0.003",
+         "--n_train", "32", "--ci", "1",
+         "--run_dir", str(tmp_path / "lc")])
+    assert len(losses) == 8
+    assert losses[-1] < losses[0]
+
+
 def test_rnn_dataset_spec_selection():
     """Sequence datasets route to the per-token NWP spec (reference trainer
     selection, standalone main_fedavg.py:269-275)."""

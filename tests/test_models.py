@@ -142,3 +142,27 @@ class TestFactory:
     def test_unknown_model_raises(self):
         with pytest.raises(ValueError, match="unknown model"):
             models.create_model(None, "nope", 10)
+
+    @pytest.mark.parametrize("name,x_shape,x_dtype", [
+        ("resnet56", (1, 16, 16, 3), jnp.float32),
+        ("cnn", (1, 28, 28), jnp.float32),
+        ("mobilenet", (1, 32, 32, 3), jnp.float32),
+        ("efficientnet-b0", (1, 32, 32, 3), jnp.float32),
+        ("vgg11", (1, 32, 32, 3), jnp.float32),
+        ("transformer", (1, 12), jnp.int32),
+    ])
+    def test_model_dtype_bf16_threads_to_compute(self, name, x_shape,
+                                                 x_dtype):
+        # --model_dtype bf16 must reach the compute path for EVERY zoo
+        # branch (regression: efficientnet/vgg silently dropped it);
+        # params stay fp32 masters, logits fp32
+        import types
+        args = types.SimpleNamespace(model_dtype="bf16")
+        model = models.create_model(args, name, 10)
+        assert model.dtype == jnp.bfloat16
+        v = model.init(jax.random.PRNGKey(0), jnp.zeros(x_shape, x_dtype))
+        leaves = jax.tree.leaves(v["params"])
+        assert all(p.dtype == jnp.float32 for p in leaves
+                   if jnp.issubdtype(p.dtype, jnp.floating))
+        out = model.apply(v, jnp.zeros(x_shape, x_dtype))
+        assert out.dtype == jnp.float32
