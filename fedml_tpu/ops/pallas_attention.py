@@ -1,17 +1,17 @@
-"""Flash-attention forward as a fused Pallas TPU kernel.
+"""Flash attention (forward + backward) as fused Pallas TPU kernels.
 
-The hot op for long-context transformer workloads: one kernel instance
-computes a ``[BLOCK_Q, D]`` output tile by streaming KV blocks through VMEM
-with the online-softmax recurrence -- scores never touch HBM. Matmuls hit
+The hot op for long-context transformer workloads. Forward: one kernel
+instance computes a ``[BLOCK_Q, D]`` output tile by streaming KV blocks
+through VMEM with the online-softmax recurrence -- scores never touch HBM --
+and emits the per-row logsumexp. Backward: two kernels re-form the
+probabilities from the saved logsumexp (no second online pass needed) and
+accumulate ``dq`` (query-tile outer loop) and ``dk``/``dv`` (KV-tile outer
+loop), the standard flash-attention backward decomposition. All matmuls hit
 the MXU in the input dtype (bf16-friendly) with fp32 accumulation
-(``preferred_element_type``); the softmax state (running max / sum) lives in
-fp32 VMEM scratch across the KV grid dimension.
+(``preferred_element_type``); softmax state lives in fp32 VMEM scratch.
 
-Backward runs by recompute through :func:`fedml_tpu.ops.attention.
-blockwise_attention` (identical math, so gradients are exact); the fused
-kernel wins the forward where the memory traffic is. ``interpret=True`` is
-used automatically off-TPU so the same code path tests on CPU
-(``tests/test_ops.py``).
+``interpret=True`` is used automatically off-TPU so the same code paths
+test on CPU against the materializing oracle (``tests/test_ops.py``).
 """
 
 from __future__ import annotations
@@ -23,11 +23,45 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from fedml_tpu.ops.attention import NEG_INF, blockwise_attention
+from fedml_tpu.ops.attention import NEG_INF
+
+# lse/delta ride as [T, LANES] lane-replicated fp32 (the fp32 VMEM tile is
+# (8, 128); a [T, 1] operand would fight the layout) -- column 0 is the
+# value. Lane replication in HBM costs 128x on a per-row scalar; it is the
+# same layout the upstream TPU flash kernel uses for its l/m outputs
+# (jax/experimental/pallas/ops/tpu/flash_attention.py: NUM_LANES-wide l/m),
+# trading HBM for never relayouting sublanes<->lanes inside the kernel.
+_LANES = 128
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                scale, causal, block_q, block_k, seq_len):
+def _use_interpret() -> bool:
+    """Pallas interpret mode off-TPU only. The real chip can register
+    under a plugin platform name (here: ``axon``), so keying on
+    ``jax.default_backend() != 'tpu'`` would silently interpret on
+    hardware -- detect TPUs by device_kind instead."""
+    dev = jax.devices()[0]
+    return "tpu" not in (dev.device_kind or "").lower() and \
+        dev.platform != "tpu"
+
+
+def _mask(s, *, qi, kj, block_q, block_k, seq_len, causal):
+    """NEG_INF-mask invalid scores: zero-padded keys always, upper triangle
+    when causal. Static no-op when nothing can be invalid."""
+    ragged = seq_len % block_k != 0
+    if not (causal or ragged):
+        return s
+    qpos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    kpos = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    valid = kpos < seq_len
+    if causal:
+        valid = valid & (kpos <= qpos)
+    return jnp.where(valid, s, NEG_INF)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, causal, block_q, block_k, seq_len):
     qi = pl.program_id(0)   # query tile
     kj = pl.program_id(1)   # kv tile (innermost grid dim)
 
@@ -43,20 +77,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
-        ragged = seq_len % block_k != 0
-        if causal or ragged:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            valid = kpos < seq_len  # zero-padded keys must not attend
-            if causal:
-                valid = valid & (kpos <= qpos)
-            s = jnp.where(valid, s, NEG_INF)
+        s = _mask(s, qi=qi, kj=kj, block_q=block_q, block_k=block_k,
+                  seq_len=seq_len, causal=causal)
 
-        # m/l scratch is lane-replicated [bq, 128] (the fp32 VMEM tile is
-        # (8, 128); a [bq, 1] buffer would fight the layout) -- column 0 is
-        # the value
         m_prev = m_ref[:, :1]             # [bq, 1]
         blk_max = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, blk_max)
@@ -80,8 +103,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(kj == pl.num_programs(1) - 1)
     def _finalize():
-        o_ref[:] = (acc_ref[:]
-                    / jnp.maximum(l_ref[:, :1], 1e-30)).astype(o_ref.dtype)
+        l = l_ref[:, :1]
+        o_ref[:] = (acc_ref[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        # fully-masked rows (l == 0): any finite lse works -- the backward
+        # re-masks scores to NEG_INF, so exp(s - lse) is 0 regardless
+        lse = jnp.where(l > 0, m_ref[:, :1] + jnp.log(jnp.maximum(l, 1e-30)),
+                        0.0)
+        lse_ref[:] = jnp.broadcast_to(lse, lse_ref.shape)
 
 
 def _fwd_one_head(q, k, v, *, scale, causal, block_q, block_k, k_len,
@@ -100,15 +128,144 @@ def _fwd_one_head(q, k, v, *, scale, causal, block_q, block_k, k_len,
             pl.BlockSpec((block_k, D), lambda i, j: (j, 0)),
             pl.BlockSpec((block_k, D), lambda i, j: (j, 0)),
         ],
-        out_specs=pl.BlockSpec((block_q, D), lambda i, j: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((Tq, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((block_q, D), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_q, _LANES), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((Tq, _LANES), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
+
+
+def _probs_and_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *, qi, kj,
+                  scale, causal, block_q, block_k, seq_len):
+    """Shared backward re-formation: rebuild ``p = exp(s - lse)`` from the
+    saved logsumexp and form ``ds = p * (dO v^T - delta)`` -- the one block
+    both backward kernels must compute identically."""
+    s = jax.lax.dot_general(
+        q_ref[:], k_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    s = _mask(s, qi=qi, kj=kj, block_q=block_q, block_k=block_k,
+              seq_len=seq_len, causal=causal)
+    p = jnp.exp(s - lse_ref[:, :1])
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    dov = jax.lax.dot_general(
+        do_ref[:], v_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)  # [bq, bk]
+    ds = p * (dov - dl_ref[:, :1])
+    return p, ds
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref,
+               acc_ref, *, scale, causal, block_q, block_k, seq_len):
+    """Query-tile outer loop: accumulate ``dq = sum_k ds @ k * scale``."""
+    qi = pl.program_id(0)
+    kj = pl.program_id(1)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _body():
+        _, ds = _probs_and_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+                              qi=qi, kj=kj, scale=scale, causal=causal,
+                              block_q=block_q, block_k=block_k,
+                              seq_len=seq_len)
+        acc_ref[:] += scale * jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(kj * block_k <= qi * block_q + (block_q - 1))(_body)
+    else:
+        _body()
+
+    @pl.when(kj == pl.num_programs(1) - 1)
+    def _finalize():
+        dq_ref[:] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref,
+                dv_ref, dk_acc, dv_acc, *, scale, causal, block_q, block_k,
+                seq_len):
+    """KV-tile outer loop: ``dv = sum_q p^T @ dO``, ``dk = sum_q ds^T @ q``."""
+    kj = pl.program_id(0)
+    qi = pl.program_id(1)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _body():
+        p, ds = _probs_and_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+                              qi=qi, kj=kj, scale=scale, causal=causal,
+                              block_q=block_q, block_k=block_k,
+                              seq_len=seq_len)
+        # dv += p^T dO : contract over the q rows
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[:], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dk_acc[:] += scale * jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[:], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # tiles entirely above the diagonal contribute nothing
+        pl.when(qi * block_q + (block_q - 1) >= kj * block_k)(_body)
+    else:
+        _body()
+
+    @pl.when(qi == pl.num_programs(1) - 1)
+    def _finalize():
+        dk_ref[:] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_one_head(q, k, v, do, lse, dl, *, scale, causal, block_q, block_k,
+                  k_len, interpret):
+    Tq, D = q.shape
+    Tk = k.shape[0]
+    nq, nk = pl.cdiv(Tq, block_q), pl.cdiv(Tk, block_k)
+    q_spec = pl.BlockSpec((block_q, D), lambda i, j: (i, 0))
+    k_spec = pl.BlockSpec((block_k, D), lambda i, j: (j, 0))
+    r_spec = pl.BlockSpec((block_q, _LANES), lambda i, j: (i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=k_len),
+        grid=(nq, nk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+        out_specs=pl.BlockSpec((block_q, D), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((Tq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, dl)
+    # kv-outer grid: index maps see (kj, qi)
+    qk_spec = pl.BlockSpec((block_q, D), lambda j, i: (i, 0))
+    kk_spec = pl.BlockSpec((block_k, D), lambda j, i: (j, 0))
+    rk_spec = pl.BlockSpec((block_q, _LANES), lambda j, i: (i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=k_len),
+        grid=(nk, nq),
+        in_specs=[qk_spec, kk_spec, kk_spec, qk_spec, rk_spec, rk_spec],
+        out_specs=[pl.BlockSpec((block_k, D), lambda j, i: (j, 0)),
+                   pl.BlockSpec((block_k, D), lambda j, i: (j, 0))],
+        out_shape=[jax.ShapeDtypeStruct((Tk, D), k.dtype),
+                   jax.ShapeDtypeStruct((Tk, D), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, dl)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -116,50 +273,65 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
                     block_k=128):
     """Fused attention ``[B, T, H, D] -> [B, T, H, D]``.
 
-    Forward is the Pallas kernel (per ``(batch, head)`` via vmap -- the
-    kernel grid covers query x kv tiles); backward recomputes through the
-    pure-JAX blockwise path. Sequence lengths must be multiples of the
-    block sizes after padding (handled here); D should be a multiple of
-    128 for MXU alignment (typical head dims 128/256).
+    Forward and backward are Pallas kernels (per ``(batch, head)`` via a
+    double vmap -- each kernel grid covers query x kv tiles). Ragged
+    sequence lengths are padded here and masked in-kernel; D should be a
+    multiple of 128 for MXU alignment (typical head dims 128/256).
     """
     return _fa_fwd(q, k, v, causal, scale, block_q, block_k)[0]
+
+
+def _pad_t(x, pad):
+    return jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0))) if pad else x
+
+
+def _double_vmap(fn):
+    """[B, T, H, ...] operands -> per-(batch, head) kernel calls: outer
+    vmap strips batch, inner maps the head axis (axis 1 of the remaining
+    [T, H, ...]) so the kernel sees [T, ...]."""
+    return jax.vmap(jax.vmap(fn, in_axes=1, out_axes=1))
 
 
 def _fa_fwd(q, k, v, causal, scale, block_q, block_k):
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale_ = scale if scale is not None else D ** -0.5
-    interpret = jax.default_backend() != "tpu"
+    interpret = _use_interpret()
     bq, bk = min(block_q, Tq), min(block_k, Tk)
-    pad_q = (-Tq) % bq
-    pad_k = (-Tk) % bk
-    qp = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0))) if pad_q else q
-    kp = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0))) if pad_k else k
-    vp = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0))) if pad_k else v
-    # padded KV rows are masked inside the kernel (kpos < seq_len);
-    # padded q rows are sliced off below
+    qp = _pad_t(q, (-Tq) % bq)
+    kp = _pad_t(k, (-Tk) % bk)
+    vp = _pad_t(v, (-Tk) % bk)
     fn = functools.partial(_fwd_one_head, scale=scale_, causal=causal,
                            block_q=bq, block_k=bk, k_len=Tk,
                            interpret=interpret)
-    # [B, T, H, D]: outer vmap strips batch, inner maps the head axis
-    # (axis 1 of the remaining [T, H, D]) so the kernel sees [T, D]
-    per_head = jax.vmap(fn, in_axes=1, out_axes=1)
-    out = jax.vmap(per_head)(qp, kp, vp)
-    if pad_q:
-        out = out[:, :Tq]
-    return out, (q, k, v)
+    out, lse = _double_vmap(fn)(qp, kp, vp)
+    out, lse = out[:, :Tq], lse[:, :Tq, :, 0]  # drop q padding + lanes
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, scale, block_q, block_k, res, g):
-    q, k, v = res
-    scale_ = scale if scale is not None else q.shape[-1] ** -0.5
-
-    def ref(q, k, v):
-        return blockwise_attention(q, k, v, causal=causal, scale=scale_,
-                                   block_size=max(block_k, 128))
-
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale_ = scale if scale is not None else D ** -0.5
+    interpret = _use_interpret()
+    bq, bk = min(block_q, Tq), min(block_k, Tk)
+    pad_q, pad_k = (-Tq) % bq, (-Tk) % bk
+    # delta_i = dO_i . O_i (the -sum_j ds_ij term of the softmax backward)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    rep = lambda x: jnp.broadcast_to(  # [B, T, H] -> lane-replicated
+        x[..., None], x.shape + (_LANES,))
+    qp, dop = _pad_t(q, pad_q), _pad_t(g.astype(q.dtype), pad_q)
+    kp, vp = _pad_t(k, pad_k), _pad_t(v, pad_k)
+    # padded q rows: dO rows are zero => ds rows are zero => no dk/dv
+    # contribution; their dq rows are sliced off below
+    lse_p = _pad_t(rep(lse), pad_q)
+    dl_p = _pad_t(rep(delta), pad_q)
+    fn = functools.partial(_bwd_one_head, scale=scale_, causal=causal,
+                           block_q=bq, block_k=bk, k_len=Tk,
+                           interpret=interpret)
+    dq, dk, dv = _double_vmap(fn)(qp, kp, vp, dop, lse_p, dl_p)
+    return dq[:, :Tq], dk[:, :Tk], dv[:, :Tk]
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)

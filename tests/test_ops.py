@@ -140,6 +140,37 @@ def test_flash_attention_grad_matches_mha():
                                    atol=5e-4, rtol=5e-4)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_grad_ragged_and_noncausal(causal):
+    # fused backward on ragged Tk (padded keys must produce zero dk/dv
+    # rows and not pollute dq); oracle = blockwise VJP (same convention)
+    q, _, _ = _qkv(t=40)
+    _, k, v = _qkv(seed=1, t=24)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal, None, 16, 16) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(blockwise_attention(q, k, v, causal=causal,
+                                           block_size=64) ** 2)
+
+    g_f = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_f, g_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_flash_attention_bf16():
+    q, k, v = _qkv(dtype=jnp.bfloat16)
+    out = flash_attention(q, k, v, True, None, 16, 16)
+    assert out.dtype == jnp.bfloat16
+    g = jax.grad(lambda q: jnp.sum(
+        flash_attention(q, k, v, True, None, 16, 16).astype(jnp.float32)))(q)
+    assert g.dtype == jnp.bfloat16
+    assert bool(jnp.isfinite(g.astype(jnp.float32)).all())
+
+
 def test_transformer_lm_forward_and_train_step():
     from fedml_tpu.models.transformer import TransformerLM
 
