@@ -18,6 +18,7 @@ from __future__ import annotations
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from fedml_tpu.ops.pallas_attention import flash_attention
@@ -85,10 +86,24 @@ class TransformerLM(nn.Module):
             x.astype(jnp.float32))
 
 
+def lm_loss(logits, tgt):
+    """Masked next-token NLL: mean over positions with ``tgt >= 0``.
+
+    THE loss convention shared by every LM training path (sp / tp / pp
+    steps, their oracles in tests and the multichip dryrun) -- keep one
+    definition so the implementations and their oracles cannot drift.
+    """
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    mask = (tgt >= 0).astype(jnp.float32)
+    nll = -jnp.take_along_axis(
+        lp, jnp.maximum(tgt, 0)[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
 def transformer_nwp(vocab_size: int = 10004, **kw):
     """StackOverflow-NWP-shaped config (vocab 10000 + 4 specials, matching
     ``fedml_tpu.data.stackoverflow``)."""
     return TransformerLM(vocab_size=vocab_size, **kw)
 
 
-__all__ = ["TransformerLM", "transformer_nwp"]
+__all__ = ["TransformerLM", "transformer_nwp", "lm_loss"]

@@ -79,12 +79,8 @@ def make_seq_parallel_lm_step(model, mesh, tx: Optional[Any] = None,
         return params, jax.device_put(tx.init(params), rep)
 
     def loss_fn(params, idx, tgt):
-        logits = model.apply({"params": params}, idx)  # [B, T, V]
-        lp = jax.nn.log_softmax(logits.astype(jnp.float32))
-        mask = (tgt >= 0).astype(jnp.float32)
-        nll = -jnp.take_along_axis(
-            lp, jnp.maximum(tgt, 0)[..., None], axis=-1)[..., 0]
-        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        from fedml_tpu.models.transformer import lm_loss
+        return lm_loss(model.apply({"params": params}, idx), tgt)
 
     @partial(jax.jit,
              in_shardings=(rep, rep, x_sh, x_sh),

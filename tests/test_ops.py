@@ -218,13 +218,10 @@ def test_seq_parallel_lm_step_matches_unsharded():
     params0 = jax.tree.map(lambda a: np.asarray(a).copy(), params)
     new_params, _, loss = step_fn(params, opt_state, idx, tgt)
 
+    from fedml_tpu.models.transformer import lm_loss
+
     def ref_loss(p):
-        lg = local.apply({"params": p}, idx).astype(jnp.float32)
-        lp = jax.nn.log_softmax(lg)
-        mask = (tgt >= 0).astype(jnp.float32)
-        nll = -jnp.take_along_axis(
-            lp, jnp.maximum(tgt, 0)[..., None], axis=-1)[..., 0]
-        return jnp.sum(nll * mask) / jnp.sum(mask)
+        return lm_loss(local.apply({"params": p}, idx), tgt)
 
     ref_l, ref_g = jax.value_and_grad(ref_loss)(params0)
     np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
@@ -259,18 +256,52 @@ def test_tensor_parallel_lm_step_matches_unsharded():
     params0 = jax.tree.map(lambda a: np.asarray(a).copy(), params)
     new_params, _, loss = step_fn(params, opt_state, idx, tgt)
 
+    from fedml_tpu.models.transformer import lm_loss
+
     def ref_loss(p):
-        lg = local.apply({"params": p}, idx).astype(jnp.float32)
-        lp = jax.nn.log_softmax(lg)
-        mask = (tgt >= 0).astype(jnp.float32)
-        nll = -jnp.take_along_axis(
-            lp, jnp.maximum(tgt, 0)[..., None], axis=-1)[..., 0]
-        return jnp.sum(nll * mask) / jnp.sum(mask)
+        return lm_loss(local.apply({"params": p}, idx), tgt)
 
     ref_l, ref_g = jax.value_and_grad(ref_loss)(params0)
     np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
     ref_new = jax.tree.map(lambda p, g: p - 0.1 * g, params0, ref_g)
     for a, b in zip(jax.tree.leaves(new_params), jax.tree.leaves(ref_new)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_pipeline_parallel_lm_step_matches_unsharded():
+    # GPipe pp over a 4-stage mesh, 2 microbatches: one jitted step must
+    # match the single-device TransformerLM step on identical params
+    import optax
+
+    from fedml_tpu.models.transformer import TransformerLM
+    from fedml_tpu.parallel.pipeline_parallel import (
+        init_pp_params, make_pp_lm_step, make_pp_mesh, unstack_pp_params)
+    from fedml_tpu.parallel.seq_parallel import shift_targets
+
+    mesh = make_pp_mesh(4)
+    idx = jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0, 50)
+    tgt = shift_targets(idx)
+    params, model = init_pp_params(mesh, jax.random.PRNGKey(1), idx,
+                                   vocab_size=50, n_heads=2, d_model=32,
+                                   max_len=32)
+    flat0 = unstack_pp_params(
+        jax.tree.map(lambda a: np.asarray(a).copy(), params), 4)
+    tx = optax.sgd(0.1)
+    prep_fn, step_fn = make_pp_lm_step(model, mesh, tx, n_micro=2)
+    idx_m, tgt_m = prep_fn(idx, tgt)
+    new_params, _, loss = step_fn(params, tx.init(params), idx_m, tgt_m)
+
+    from fedml_tpu.models.transformer import lm_loss
+
+    def ref_loss(p):
+        return lm_loss(model.apply({"params": p}, idx), tgt)
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(flat0)
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
+    ref_new = jax.tree.map(lambda p, g: p - 0.1 * g, flat0, ref_g)
+    got = unstack_pp_params(new_params, 4)
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(ref_new)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-4, rtol=1e-4)
 
