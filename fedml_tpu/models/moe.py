@@ -1,0 +1,130 @@
+"""Mixture-of-Experts transformer (Switch-style top-1 routing).
+
+Net-new capability for the TPU rebuild (the reference has no conditional
+computation anywhere): a drop-in replacement for the Transformer MLP where
+each token routes to one of ``n_experts`` expert MLPs. TPU-first design:
+routing is FIXED-CAPACITY einsum dispatch -- a one-hot ``[tokens, E, C]``
+combine tensor instead of ragged gather/scatter, so shapes stay static,
+everything is a batched matmul on the MXU, and the expert dimension is a
+plain array axis that shards over an ``expert`` mesh axis (ep; see
+:mod:`fedml_tpu.parallel.expert_parallel` and
+``__graft_entry__.dryrun_multichip`` case 9).
+
+Tokens overflowing an expert's capacity are dropped (their block output is
+the residual identity) -- the standard Switch trade; the auxiliary
+load-balancing loss (sown into the ``losses`` collection) keeps drops
+rare. The attention sublayer is shared with the dense transformer via
+``_Block``'s ``mlp_factory`` seam -- one attention implementation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.models.transformer import _Block
+
+
+class MoEMLP(nn.Module):
+    """Top-1 routed expert MLP over flattened tokens.
+
+    Input ``[N, C]`` -> output ``[N, C]``; the Switch load-balancing aux
+    loss is sown as ``losses/moe_aux`` (collect with
+    ``apply(..., mutable=['losses'])``). Expert params are stacked on a
+    leading ``E`` axis (``wi [E, C, H]``, ``wo [E, H, C]``) so ep sharding
+    is a PartitionSpec on that axis.
+    """
+    n_experts: int = 8
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        N, C = x.shape
+        E = self.n_experts
+        H = self.mlp_ratio * C
+        cap = max(1, int(self.capacity_factor * N / E))
+
+        gates = jax.nn.softmax(
+            nn.Dense(E, dtype=jnp.float32, name="router")(
+                x.astype(jnp.float32)))                    # [N, E]
+        expert = jnp.argmax(gates, axis=-1)                # [N]
+        onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)
+        # position of each token within its expert's queue
+        pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0    # [N, E]
+        keep = (pos >= 0) & (pos < cap)
+        # dispatch/combine tensor [N, E, C(ap)]
+        disp = (onehot * keep)[:, :, None] * jax.nn.one_hot(
+            jnp.clip(pos, 0, cap - 1).astype(jnp.int32), cap,
+            dtype=jnp.float32)
+        gate_val = jnp.sum(gates * onehot * keep, axis=-1)  # [N]
+
+        wi = self.param("wi", nn.initializers.lecun_normal(), (E, C, H),
+                        jnp.float32).astype(self.dtype)
+        wo = self.param("wo", nn.initializers.lecun_normal(), (E, H, C),
+                        jnp.float32).astype(self.dtype)
+        # route tokens into per-expert buffers, run the expert MLPs as one
+        # batched matmul pair, and combine back -- all einsums
+        xin = jnp.einsum("nec,nd->ecd", disp.astype(self.dtype),
+                         x.astype(self.dtype))              # [E, C(ap), C]
+        h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", xin, wi))
+        out = jnp.einsum("ech,ehd->ecd", h, wo)             # [E, Cap, C]
+        y = jnp.einsum("nec,ecd->nd", disp.astype(self.dtype),
+                       out) * gate_val[:, None].astype(self.dtype)
+
+        # Switch aux loss: E * sum_e (fraction routed to e) * (mean gate e)
+        frac = jnp.mean(onehot, axis=0)
+        mean_gate = jnp.mean(gates, axis=0)
+        self.sow("losses", "moe_aux", E * jnp.sum(frac * mean_gate))
+        return y.astype(x.dtype)
+
+
+def MoEBlock(n_heads, n_experts=8, mlp_ratio=4, capacity_factor=1.25,
+             dtype=jnp.float32, attention_fn=None, **kw):
+    """Transformer block with the MLP replaced by :class:`MoEMLP` --
+    :class:`~fedml_tpu.models.transformer._Block` with an MoE
+    ``mlp_factory`` (shared attention implementation)."""
+    return _Block(n_heads, mlp_ratio, dtype, attention_fn,
+                  mlp_factory=partial(MoEMLP, n_experts, mlp_ratio,
+                                      capacity_factor, dtype), **kw)
+
+
+class MoETransformerLM(nn.Module):
+    """Causal LM with MoE blocks: same surface as
+    :class:`fedml_tpu.models.transformer.TransformerLM` (token ids
+    ``[B, T]`` -> logits ``[B, T, vocab]``), MoE aux losses sown into the
+    ``losses`` collection (apply with ``mutable=['losses']`` to collect)."""
+    vocab_size: int
+    n_layers: int = 4
+    n_heads: int = 4
+    d_model: int = 256
+    max_len: int = 2048
+    n_experts: int = 8
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+    attention_fn: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, idx, train: bool = False):
+        B, T = idx.shape
+        tok = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                       name="tok_embed")(idx)
+        pos = nn.Embed(self.max_len, self.d_model, dtype=self.dtype,
+                       name="pos_embed")(jnp.arange(T)[None])
+        x = tok + pos
+        for i in range(self.n_layers):
+            x = MoEBlock(self.n_heads, self.n_experts, self.mlp_ratio,
+                         self.capacity_factor, self.dtype,
+                         self.attention_fn, name=f"block{i}")(x, train)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        return nn.Dense(self.vocab_size, dtype=jnp.float32, name="head")(
+            x.astype(jnp.float32))
+
+
+__all__ = ["MoEMLP", "MoEBlock", "MoETransformerLM"]

@@ -25,10 +25,16 @@ from fedml_tpu.ops.pallas_attention import flash_attention
 
 
 class _Block(nn.Module):
+    """Pre-LN transformer block. ``mlp_factory`` (e.g. a bound
+    :class:`fedml_tpu.models.moe.MoEMLP`) swaps the dense MLP for an
+    alternative operating on flattened ``[B*T, C]`` tokens -- THE seam
+    that keeps exactly one attention implementation across the dense and
+    MoE transformers."""
     n_heads: int
     mlp_ratio: int = 4
     dtype: Any = jnp.float32
     attention_fn: Optional[Callable] = None
+    mlp_factory: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -49,6 +55,9 @@ class _Block(nn.Module):
         x = x + nn.Dense(C, use_bias=False, dtype=self.dtype,
                          name="proj")(att)
         h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        if self.mlp_factory is not None:
+            y = self.mlp_factory(name="moe")(h.reshape(B * T, C))
+            return x + y.reshape(B, T, C)
         h = nn.gelu(nn.Dense(self.mlp_ratio * C, dtype=self.dtype,
                              name="mlp_up")(h))
         return x + nn.Dense(C, dtype=self.dtype, name="mlp_down")(h)

@@ -1,0 +1,83 @@
+"""Expert parallelism (ep): MoE experts sharded over an ``expert`` axis.
+
+The last letter of the mesh-parallelism inventory (dp
+:mod:`fedml_tpu.parallel.engine`, sp :mod:`.seq_parallel`, tp
+:mod:`.tensor_parallel`, pp :mod:`.pipeline_parallel`): the stacked
+expert weights of :class:`fedml_tpu.models.moe.MoEMLP` (``wi [E, C, H]``,
+``wo [E, H, C]``) get ``P(expert)`` on their leading axis and GSPMD
+partitions the dispatch/expert/combine einsums -- each device computes
+its experts' token buffers, the combine einsum's contraction over ``E``
+becomes the all-reduce. No manual collectives, same step contract as the
+sp/tp builders.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from fedml_tpu.parallel.mesh import make_2d_mesh
+
+DATA_AXIS = "data"
+EXPERT_AXIS = "expert"
+
+# coefficient on the Switch load-balancing aux loss -- single-sourced so
+# the step builder and its oracles (tests, dryrun) cannot drift
+MOE_AUX_WEIGHT = 0.01
+
+
+def make_ep_mesh(n_data: int, n_expert: int, devices=None):
+    return make_2d_mesh(n_data, n_expert, (DATA_AXIS, EXPERT_AXIS),
+                        devices)
+
+
+def ep_param_shardings(params, mesh):
+    """Experts (leaves named ``wi``/``wo`` with a leading E axis) shard
+    over ``expert``; everything else replicates."""
+    def lookup(path, leaf):
+        key = "/".join(str(p.key) for p in path if hasattr(p, "key"))
+        expert = key.endswith("wi") or key.endswith("wo")
+        return NamedSharding(mesh, P(EXPERT_AXIS) if expert else P())
+
+    return jax.tree_util.tree_map_with_path(lookup, params)
+
+
+def make_ep_lm_step(model, mesh, tx: Optional[Any] = None,
+                    data_axis: str = DATA_AXIS):
+    """``(init_fn, step_fn)`` for an MoE LM (``model.apply`` returning
+    logits, with MoE aux losses sown into the ``losses`` collection)."""
+    from fedml_tpu.models.transformer import lm_loss
+
+    tx = tx if tx is not None else optax.sgd(1e-3)
+    x_sh = NamedSharding(mesh, P(data_axis, None))
+
+    def init_fn(rng, example_idx):
+        vs = model.init(rng, example_idx)
+        p_sh = ep_param_shardings(vs["params"], mesh)
+        params = jax.tree.map(jax.device_put, vs["params"], p_sh)
+        return params, tx.init(params)
+
+    def loss_fn(params, idx, tgt):
+        logits, aux = model.apply({"params": params}, idx,
+                                  mutable=["losses"])
+        moe_aux = sum(jax.tree.leaves(aux.get("losses", {})), 0.0)
+        return lm_loss(logits, tgt) + MOE_AUX_WEIGHT * moe_aux
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step_fn(params, opt_state, idx, tgt):
+        idx = jax.lax.with_sharding_constraint(idx, x_sh)
+        tgt = jax.lax.with_sharding_constraint(tgt, x_sh)
+        loss, grads = jax.value_and_grad(loss_fn)(params, idx, tgt)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return init_fn, step_fn
+
+
+__all__ = ["make_ep_mesh", "make_ep_lm_step", "ep_param_shardings",
+           "MOE_AUX_WEIGHT",
+           "DATA_AXIS", "EXPERT_AXIS"]

@@ -19,6 +19,17 @@ CLIENT_AXIS = "clients"
 MODEL_AXIS = "model"
 
 
+def make_2d_mesh(n_a: int, n_b: int, axis_names, devices=None):
+    """Generic ``(n_a, n_b)`` device grid -- the shared constructor behind
+    the dp x sp / dp x tp / dp x ep meshes (each just names the axes)."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    need = n_a * n_b
+    if need > len(devices):
+        raise ValueError(f"mesh needs {need} devices, have {len(devices)}")
+    return Mesh(np.array(devices[:need]).reshape(n_a, n_b),
+                tuple(axis_names))
+
+
 def make_client_mesh(n_client_shards=None, n_model_shards=1, devices=None):
     """Build a ``(clients, model)`` mesh over available devices.
 

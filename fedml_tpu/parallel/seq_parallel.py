@@ -37,14 +37,8 @@ SEQ_AXIS = "seq"
 
 def make_seq_mesh(n_data: int, n_seq: int, devices=None):
     """``(data, seq)`` mesh: dp across ``data``, sp across ``seq``."""
-    import numpy as np
-
-    devices = list(jax.devices()) if devices is None else list(devices)
-    need = n_data * n_seq
-    if need > len(devices):
-        raise ValueError(f"mesh needs {need} devices, have {len(devices)}")
-    return Mesh(np.array(devices[:need]).reshape(n_data, n_seq),
-                (DATA_AXIS, SEQ_AXIS))
+    from fedml_tpu.parallel.mesh import make_2d_mesh
+    return make_2d_mesh(n_data, n_seq, (DATA_AXIS, SEQ_AXIS), devices)
 
 
 def seq_parallel_model(model_cls, mesh, *, block_size: int = 512, **kw):

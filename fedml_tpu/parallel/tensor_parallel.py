@@ -38,14 +38,8 @@ MODEL_AXIS = "model"
 
 
 def make_tp_mesh(n_data: int, n_model: int, devices=None):
-    import numpy as np
-
-    devices = list(jax.devices()) if devices is None else list(devices)
-    need = n_data * n_model
-    if need > len(devices):
-        raise ValueError(f"mesh needs {need} devices, have {len(devices)}")
-    return Mesh(np.array(devices[:need]).reshape(n_data, n_model),
-                (DATA_AXIS, MODEL_AXIS))
+    from fedml_tpu.parallel.mesh import make_2d_mesh
+    return make_2d_mesh(n_data, n_model, (DATA_AXIS, MODEL_AXIS), devices)
 
 
 def _tp_spec(path: str, ndim: int) -> P:

@@ -306,6 +306,72 @@ def test_pipeline_parallel_lm_step_matches_unsharded():
                                    atol=1e-4, rtol=1e-4)
 
 
+def test_moe_mlp_routing_and_capacity():
+    # every kept token's output is its expert's MLP of it, scaled by the
+    # gate; overflowed tokens produce zeros
+    from fedml_tpu.models.moe import MoEMLP
+
+    m = MoEMLP(n_experts=4, mlp_ratio=2, capacity_factor=0.5)
+    x = jax.random.normal(jax.random.PRNGKey(0), (32, 16))
+    vs = m.init(jax.random.PRNGKey(1), x)
+    y, col = m.apply(vs, x, mutable=["losses"])
+    aux = col["losses"]["moe_aux"][0]
+    assert y.shape == x.shape and np.isfinite(float(aux))
+    # manual re-route for token 0 (always within capacity)
+    gates = jax.nn.softmax(
+        x @ vs["params"]["router"]["kernel"]
+        + vs["params"]["router"]["bias"])
+    e0 = int(jnp.argmax(gates[0]))
+    wi, wo = vs["params"]["wi"], vs["params"]["wo"]
+    ref0 = jax.nn.gelu(x[0] @ wi[e0]) @ wo[e0] * gates[0, e0]
+    np.testing.assert_allclose(np.asarray(y[0]), np.asarray(ref0),
+                               atol=1e-5, rtol=1e-5)
+    # capacity 0.5 * 32 / 4 = 4 tokens/expert: drops must exist and be 0
+    expert = np.asarray(jnp.argmax(gates, axis=-1))
+    counts = np.bincount(expert, minlength=4)
+    assert counts.max() > 4  # at least one expert overflows at this seed
+    dropped = np.where([np.allclose(np.asarray(y[i]), 0) for i in
+                        range(32)])[0]
+    assert len(dropped) >= counts.max() - 4
+
+
+def test_expert_parallel_lm_step_matches_unsharded():
+    # ep on a 2x4 (data, expert) mesh: expert weights sharded over the
+    # expert axis, one jitted step == the single-device step
+    import optax
+
+    from fedml_tpu.models.moe import MoETransformerLM
+    from fedml_tpu.models.transformer import lm_loss
+    from fedml_tpu.parallel.expert_parallel import (
+        MOE_AUX_WEIGHT, make_ep_lm_step, make_ep_mesh)
+    from fedml_tpu.parallel.seq_parallel import shift_targets
+    from fedml_tpu.parallel.tensor_parallel import tp_attention
+
+    mesh = make_ep_mesh(2, 4)
+    kw = dict(vocab_size=50, n_layers=2, n_heads=2, d_model=16, max_len=32,
+              n_experts=4, attention_fn=tp_attention(block_size=16))
+    model = MoETransformerLM(**kw)
+    idx = jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0, 50)
+    tgt = shift_targets(idx)
+    init_fn, step_fn = make_ep_lm_step(model, mesh, optax.sgd(0.1))
+    params, opt_state = init_fn(jax.random.PRNGKey(1), idx)
+    assert "expert" in str(params["block0"]["moe"]["wi"].sharding.spec)
+    params0 = jax.tree.map(lambda a: np.asarray(a).copy(), params)
+    new_params, _, loss = step_fn(params, opt_state, idx, tgt)
+
+    def ref_loss(p):
+        logits, aux = model.apply({"params": p}, idx, mutable=["losses"])
+        moe_aux = sum(jax.tree.leaves(aux.get("losses", {})), 0.0)
+        return lm_loss(logits, tgt) + MOE_AUX_WEIGHT * moe_aux
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(params0)
+    np.testing.assert_allclose(float(loss), float(ref_l), rtol=1e-5)
+    ref_new = jax.tree.map(lambda p, g: p - 0.1 * g, params0, ref_g)
+    for a, b in zip(jax.tree.leaves(new_params), jax.tree.leaves(ref_new)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
 def test_transformer_with_ring_attention_matches_local():
     from fedml_tpu.models.transformer import TransformerLM
 
