@@ -1,8 +1,9 @@
 #!/usr/bin/env bash
 # Fast CI tier -- the runnable analog of the reference's CI scripts
 # (CI-script-fedavg.sh:31-58: a short federated run plus the
-# federated==centralized equivalence asserts), targeted at < 2 minutes on
-# a CPU host. The full suite (including the slow-marked algorithm-family
+# federated==centralized equivalence asserts), targeted at ~2 minutes on
+# a CPU host (attention micro-correctness included; heavy parallel-step
+# tests are slow-marked). The full suite (including the slow-marked algorithm-family
 # integration tests) is `python -m pytest tests/ -q`.
 set -euo pipefail
 cd "$(dirname "$0")/.."

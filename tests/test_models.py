@@ -146,10 +146,14 @@ class TestFactory:
     @pytest.mark.parametrize("name,x_shape,x_dtype", [
         ("resnet56", (1, 16, 16, 3), jnp.float32),
         ("cnn", (1, 28, 28), jnp.float32),
-        ("mobilenet", (1, 32, 32, 3), jnp.float32),
-        ("efficientnet-b0", (1, 32, 32, 3), jnp.float32),
-        ("vgg11", (1, 32, 32, 3), jnp.float32),
-        ("transformer", (1, 12), jnp.int32),
+        pytest.param("mobilenet", (1, 32, 32, 3), jnp.float32,
+                     marks=pytest.mark.slow),
+        pytest.param("efficientnet-b0", (1, 32, 32, 3), jnp.float32,
+                     marks=pytest.mark.slow),
+        pytest.param("vgg11", (1, 32, 32, 3), jnp.float32,
+                     marks=pytest.mark.slow),
+        pytest.param("transformer", (1, 12), jnp.int32,
+                     marks=pytest.mark.slow),
     ])
     def test_model_dtype_bf16_threads_to_compute(self, name, x_shape,
                                                  x_dtype):

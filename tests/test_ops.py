@@ -69,6 +69,7 @@ def test_ring_attention_matches_mha(causal):
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_differentiable():
     mesh = Mesh(np.array(jax.devices()[:8]), ("seq",))
     q, k, v = _qkv()
@@ -111,6 +112,7 @@ def test_flash_attention_ragged_tk(causal):
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_ragged_block():
     # per-device shard length (96/8=12) not a multiple of block_size=8
     mesh = Mesh(np.array(jax.devices()[:8]), ("seq",))
@@ -124,6 +126,7 @@ def test_ring_attention_ragged_block():
                                    atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_flash_attention_grad_matches_mha():
     q, k, v = _qkv()
 
@@ -140,6 +143,7 @@ def test_flash_attention_grad_matches_mha():
                                    atol=5e-4, rtol=5e-4)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_attention_grad_ragged_and_noncausal(causal):
     # fused backward on ragged Tk (padded keys must produce zero dk/dv
@@ -161,6 +165,7 @@ def test_flash_attention_grad_ragged_and_noncausal(causal):
                                    atol=5e-4, rtol=5e-4)
 
 
+@pytest.mark.slow
 def test_flash_attention_bf16():
     q, k, v = _qkv(dtype=jnp.bfloat16)
     out = flash_attention(q, k, v, True, None, 16, 16)
@@ -171,6 +176,7 @@ def test_flash_attention_bf16():
     assert bool(jnp.isfinite(g.astype(jnp.float32)).all())
 
 
+@pytest.mark.slow
 def test_transformer_lm_forward_and_train_step():
     from fedml_tpu.models.transformer import TransformerLM
 
@@ -195,6 +201,7 @@ def test_transformer_lm_forward_and_train_step():
     assert np.isfinite(float(l0)) and float(l1) < float(l0)
 
 
+@pytest.mark.slow
 def test_seq_parallel_lm_step_matches_unsharded():
     # dp x sp: 2x4 mesh, batch over "data", sequence over "seq"; one full
     # jitted train step must match the single-device step exactly
@@ -231,6 +238,7 @@ def test_seq_parallel_lm_step_matches_unsharded():
                                    atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_tensor_parallel_lm_step_matches_unsharded():
     # Megatron tp on a 2x4 (data, model) mesh: sharded qkv/proj/mlp params,
     # one jitted step must match the single-device step
@@ -269,6 +277,7 @@ def test_tensor_parallel_lm_step_matches_unsharded():
                                    atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_pipeline_parallel_lm_step_matches_unsharded():
     # GPipe pp over a 4-stage mesh, 2 microbatches: one jitted step must
     # match the single-device TransformerLM step on identical params
@@ -306,6 +315,7 @@ def test_pipeline_parallel_lm_step_matches_unsharded():
                                    atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_moe_mlp_routing_and_capacity():
     # every kept token's output is its expert's MLP of it, scaled by the
     # gate; overflowed tokens produce zeros
@@ -335,6 +345,7 @@ def test_moe_mlp_routing_and_capacity():
     assert len(dropped) >= counts.max() - 4
 
 
+@pytest.mark.slow
 def test_expert_parallel_lm_step_matches_unsharded():
     # ep on a 2x4 (data, expert) mesh: expert weights sharded over the
     # expert axis, one jitted step == the single-device step
@@ -372,6 +383,7 @@ def test_expert_parallel_lm_step_matches_unsharded():
                                    atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_transformer_with_ring_attention_matches_local():
     from fedml_tpu.models.transformer import TransformerLM
 
