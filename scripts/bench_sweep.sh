@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Flagship-bench sweep for a live TPU: the measurement plan that continues
+# docs/PERFORMANCE.md when hardware is back. Each run prints bench.py's
+# one-JSON-line result; the device is probed first so a dead tunnel fails
+# fast instead of wedging (see PERFORMANCE.md incident note).
+#
+# Usage: bash scripts/bench_sweep.sh [outdir]   (default ./bench_results)
+set -uo pipefail
+cd "$(dirname "$0")/.."
+out="${1:-bench_results}"
+mkdir -p "$out"
+
+if ! timeout 120 python -c "import jax; print(jax.devices()[0])"; then
+    echo "device probe failed -- tunnel down; aborting sweep" >&2
+    exit 1
+fi
+
+run() { # name, extra bench.py flags...
+    local name="$1"; shift
+    echo "== $name: bench.py $* =="
+    timeout 2400 python bench.py --rounds 2 "$@" \
+        >"$out/$name.json" 2>"$out/$name.err"
+    cat "$out/$name.json"
+}
+
+# 1. current default (lanes K8, bf16 convs) -- reproduces the 83.4 rph row
+run lanes_k8 --client_chunk 8
+# 2. halve HBM data residency (gather traffic) on top of it
+run lanes_k8_data_bf16 --client_chunk 8 --device_dtype bf16
+# 3. more lanes: K=12 (K=16 was pathological; bisect the knee)
+run lanes_k12 --client_chunk 12
+# 4. op-level profile of the default config for the MFU breakdown
+run lanes_k8_profile --client_chunk 8 --profile_dir "$out/trace"
+
+echo "sweep done -> $out/"
