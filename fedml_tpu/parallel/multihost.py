@@ -103,6 +103,29 @@ def global_cohort(mesh, cohort_data):
     return jax.tree.map(place, cohort_data)
 
 
+def global_put(mesh, tree, spec):
+    """Place a host-replicated pytree as globally-sharded arrays.
+
+    Every process holds identical host values (same seeds everywhere) and
+    contributes the shards its local devices own
+    (``jax.make_array_from_callback``); single-process falls back to
+    ``device_put``. The generic form of :func:`global_cohort`, used by the
+    sp/tp/ep step builders for params (``P()``) and batches."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    def place(x):
+        sh = NamedSharding(mesh, spec)
+        if jax.process_count() == 1:
+            return jax.device_put(x, sh)
+        x = np.asarray(x)
+        return jax.make_array_from_callback(x.shape, sh,
+                                            lambda idx: x[idx])
+
+    return jax.tree.map(place, tree)
+
+
 def gather_metrics(tree):
     """Fetch round outputs to every host as numpy.
 
@@ -141,4 +164,5 @@ def sync(tag: str = "fedml_tpu"):
 
 
 __all__ = ["maybe_initialize_distributed", "is_primary", "global_cohort",
+           "global_put",
            "gather_metrics", "sync"]

@@ -63,14 +63,19 @@ def make_seq_parallel_lm_step(model, mesh, tx: Optional[Any] = None,
     token's target lives in the next shard, so the shift cannot be done
     shard-locally). ``tgt`` entries < 0 are ignored (loss mask).
     """
+    from fedml_tpu.parallel.multihost import global_put
+
     tx = tx if tx is not None else optax.sgd(1e-3)
     x_sh = NamedSharding(mesh, P(data_axis, seq_axis))
     rep = NamedSharding(mesh, P())
 
     def init_fn(rng, example_idx):
+        # global_put handles multi-host meshes (each process contributes
+        # its local shards; params replicate identically from shared seeds)
         vs = model.init(rng, example_idx)
-        params = jax.device_put(vs["params"], rep)
-        return params, jax.device_put(tx.init(params), rep)
+        params = global_put(mesh, vs["params"], P())
+        return params, global_put(mesh, tx.init(vs["params"]), P())
+
 
     def loss_fn(params, idx, tgt):
         from fedml_tpu.models.transformer import lm_loss
@@ -88,6 +93,18 @@ def make_seq_parallel_lm_step(model, mesh, tx: Optional[Any] = None,
     return init_fn, step_fn
 
 
+def place_lm_batch(mesh, idx, tgt, data_axis: str = DATA_AXIS,
+                   seq_axis: str = SEQ_AXIS):
+    """Host-replicated ``[B, T]`` batches -> global arrays sharded
+    ``P(data, seq)``. Required on multi-host meshes (each process holds
+    the identical host batch and contributes its local shards);
+    single-process it is a plain sharded device_put."""
+    from fedml_tpu.parallel.multihost import global_put
+
+    return (global_put(mesh, idx, P(data_axis, seq_axis)),
+            global_put(mesh, tgt, P(data_axis, seq_axis)))
+
+
 def shift_targets(idx, pad_id: int = -1):
     """Global next-token targets: ``tgt[t] = idx[t+1]``, last position
     masked. Do this on the HOST-side full sequence before sharding."""
@@ -95,5 +112,5 @@ def shift_targets(idx, pad_id: int = -1):
         [idx[:, 1:], jnp.full_like(idx[:, :1], pad_id)], axis=1)
 
 
-__all__ = ["make_seq_mesh", "make_seq_parallel_lm_step",
+__all__ = ["make_seq_mesh", "make_seq_parallel_lm_step", "place_lm_batch",
            "seq_parallel_model", "shift_targets", "DATA_AXIS", "SEQ_AXIS"]

@@ -71,8 +71,35 @@ def main():
     out = gather_metrics(new_state)
     m = gather_metrics(info["metrics"])
     checksum = float(sum(np.float64(x).sum() for x in jax.tree.leaves(out)))
+
+    # second program: one SEQUENCE-PARALLEL LM step on the same global
+    # mesh reshaped (data=nproc, seq=local devices) -- proves the sp path
+    # (ring attention ppermute + GSPMD collectives) spans processes
+    import optax
+
+    from fedml_tpu.models.transformer import TransformerLM
+    from fedml_tpu.parallel.seq_parallel import (
+        make_seq_mesh, make_seq_parallel_lm_step, place_lm_batch,
+        seq_parallel_model, shift_targets)
+
+    sp_mesh = make_seq_mesh(nproc, len(devices) // nproc)
+    sp_model = seq_parallel_model(
+        TransformerLM, sp_mesh, block_size=8, vocab_size=50, n_layers=1,
+        n_heads=2, d_model=32, max_len=32)
+    sp_idx = jax.random.randint(jax.random.PRNGKey(11), (4, 32), 0, 50)
+    sp_tgt = shift_targets(sp_idx)
+    init_fn, step_fn = make_seq_parallel_lm_step(sp_model, sp_mesh,
+                                                 optax.sgd(0.1))
+    sp_params, sp_opt = init_fn(jax.random.PRNGKey(12), sp_idx)
+    sp_new, _, sp_loss = step_fn(sp_params, sp_opt,
+                                 *place_lm_batch(sp_mesh, sp_idx, sp_tgt))
+    sp_out = gather_metrics(sp_new)
+    sp_checksum = float(sum(np.float64(x).sum()
+                            for x in jax.tree.leaves(sp_out)))
+
     print(f"RESULT process={idx} count={float(m['count'].sum()):.0f} "
-          f"checksum={checksum:.10e}", flush=True)
+          f"checksum={checksum:.10e} sp_loss={float(sp_loss):.8e} "
+          f"sp_checksum={sp_checksum:.10e}", flush=True)
 
 
 if __name__ == "__main__":

@@ -70,13 +70,44 @@ def test_two_process_round_matches_single_process():
         assert line, out[-2000:]
         parts = dict(kv.split("=") for kv in line[0].split()[1:])
         results[int(parts["process"])] = (float(parts["checksum"]),
-                                          float(parts["count"]))
+                                          float(parts["count"]),
+                                          float(parts["sp_loss"]),
+                                          float(parts["sp_checksum"]))
     assert set(results) == {0, 1}
     # both processes computed the identical replicated result
     assert results[0] == results[1]
     ref_checksum, ref_count = _single_process_reference()
     assert results[0][1] == ref_count == 112.0  # every sample trained once
     np.testing.assert_allclose(results[0][0], ref_checksum, rtol=1e-6)
+    # sp step spans processes too: compare to this process's 8-device run
+    sp_ref_loss, sp_ref_checksum = _single_process_sp_reference()
+    np.testing.assert_allclose(results[0][2], sp_ref_loss, rtol=1e-5)
+    np.testing.assert_allclose(results[0][3], sp_ref_checksum, rtol=1e-6)
+
+
+def _single_process_sp_reference():
+    """The worker's sp step on this process's 8-device CPU mesh
+    (data=2 x seq=4, same seeds)."""
+    import optax
+
+    from fedml_tpu.models.transformer import TransformerLM
+    from fedml_tpu.parallel.seq_parallel import (
+        make_seq_mesh, make_seq_parallel_lm_step, place_lm_batch,
+        seq_parallel_model, shift_targets)
+
+    mesh = make_seq_mesh(2, 4)
+    model = seq_parallel_model(
+        TransformerLM, mesh, block_size=8, vocab_size=50, n_layers=1,
+        n_heads=2, d_model=32, max_len=32)
+    idx = jax.random.randint(jax.random.PRNGKey(11), (4, 32), 0, 50)
+    tgt = shift_targets(idx)
+    init_fn, step_fn = make_seq_parallel_lm_step(model, mesh,
+                                                 optax.sgd(0.1))
+    params, opt = init_fn(jax.random.PRNGKey(12), idx)
+    new, _, loss = step_fn(params, opt, *place_lm_batch(mesh, idx, tgt))
+    checksum = float(sum(np.float64(np.asarray(x)).sum()
+                         for x in jax.tree.leaves(new)))
+    return float(loss), checksum
 
 
 def test_multihost_helpers_single_process():
