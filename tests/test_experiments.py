@@ -165,3 +165,14 @@ def test_rnn_dataset_spec_selection():
         ["--dataset", "synthetic_sequences", "--model", "rnn_fed_shakespeare",
          "--lr", "0.5"] + TINY)
     assert api.spec.name == "nwp"
+
+
+def test_federated_transformer_nwp():
+    """TransformerLM drops into the federated NWP seam via the factory
+    (--model transformer): a FedAvg round over sequence clients."""
+    from fedml_tpu.experiments import main_fedavg
+    api, _ = main_fedavg.main(
+        ["--dataset", "synthetic_sequences", "--model", "transformer",
+         "--lr", "0.1", "--n_train", "64", "--n_test", "16"] + TINY)
+    assert api.spec.name == "nwp"
+    assert api.round_idx == 2
