@@ -14,22 +14,48 @@ import jax.numpy as jnp
 from fedml_tpu.core.trainer import TrainSpec
 
 
-def _apply_model(model, state, x, rng, train):
+def _apply_model(model, state, x, rng, train, with_sown=False):
+    """Apply with train-time collection handling.
+
+    ``with_sown=True`` (the loss_fn path in every spec) also collects
+    losses the model sows (the MoE load-balancing aux, ``models/moe.py``)
+    and returns ``(out, new_state, aux_scalar)``; aux is 0.0 for models
+    that sow nothing, so non-MoE behavior is unchanged. ``with_sown=
+    False`` (eval/metrics path) returns ``(out, new_state)`` -- sow is a
+    no-op when the collection is not mutable."""
     variables = dict(state)
     rngs = ({"dropout": rng, "droppath": jax.random.fold_in(rng, 7)}
             if (train and rng is not None) else None)
-    if "batch_stats" in state and train:
-        out, mutated = model.apply(variables, x, train=True,
-                                   mutable=["batch_stats"], rngs=rngs)
+    mutable = ((["losses"] if with_sown else [])
+               + (["batch_stats"]
+                  if ("batch_stats" in state and train) else []))
+    if not mutable:
+        out = model.apply(variables, x, train=train, rngs=rngs)
+        return out, state
+    out, mutated = model.apply(variables, x, train=train, mutable=mutable,
+                               rngs=rngs)
+    new_state = state
+    if "batch_stats" in mutated:
         new_state = dict(state)
         new_state["batch_stats"] = mutated["batch_stats"]
+    if not with_sown:
         return out, new_state
-    out = model.apply(variables, x, train=train, rngs=rngs)
-    return out, state
+    aux = sum(jax.tree.leaves(mutated.get("losses", {})), 0.0)
+    return out, new_state, aux
+
+
+def _init_state(model, example_x, rng):
+    """Shared spec init: sown diagnostics (e.g. the MoE aux loss) are
+    per-apply values, not model state -- they must not enter the
+    aggregated pytree."""
+    variables = dict(model.init(rng, example_x, train=False))
+    variables.pop("losses", None)
+    return variables
 
 
 def make_classification_spec(model, example_x, num_classes=None,
-                             name="classification", augment_fn=None):
+                             name="classification", augment_fn=None,
+                             aux_loss_weight=0.01):
     """Softmax cross-entropy classification over ``[B, C]`` logits.
 
     Applying log_softmax to whatever the model emits reproduces the reference
@@ -43,8 +69,7 @@ def make_classification_spec(model, example_x, num_classes=None,
     """
 
     def init_fn(rng):
-        variables = model.init(rng, example_x, train=False)
-        return dict(variables)
+        return _init_state(model, example_x, rng)
 
     def _loss_and_metrics(logits, y, mask):
         logp = jax.nn.log_softmax(logits.astype(jnp.float32))
@@ -58,9 +83,10 @@ def make_classification_spec(model, example_x, num_classes=None,
         return loss, metrics
 
     def loss_fn(state, batch, rng, train):
-        logits, new_state = _apply_model(model, state, batch["x"], rng, train)
+        logits, new_state, aux = _apply_model(model, state, batch["x"],
+                                              rng, train, with_sown=True)
         loss, metrics = _loss_and_metrics(logits, batch["y"], batch["mask"])
-        return loss, (new_state, metrics)
+        return loss + aux_loss_weight * aux, (new_state, metrics)
 
     def metrics_fn(state, batch):
         logits, _ = _apply_model(model, state, batch["x"], None, False)
@@ -72,16 +98,19 @@ def make_classification_spec(model, example_x, num_classes=None,
 
 
 def make_seq_classification_spec(model, example_x, ignore_index=0,
-                                 name="nwp"):
+                                 name="nwp", aux_loss_weight=0.01):
     """Per-token cross-entropy over ``[B, T, V]`` logits with padding-id
     masking -- semantics of the reference NWP trainer
     (``my_model_trainer_nwp.py:24``: ``CrossEntropyLoss(ignore_index=0)``).
     Token mask = sample mask x (y != ignore_index).
+
+    Losses the model sows (the MoE load-balancing aux,
+    ``models/moe.py``) are added at ``aux_loss_weight`` during training
+    -- federated MoE trains with balanced routing out of the box.
     """
 
     def init_fn(rng):
-        variables = model.init(rng, example_x, train=False)
-        return dict(variables)
+        return _init_state(model, example_x, rng)
 
     def _loss_and_metrics(logits, y, mask):
         tok_mask = (y != ignore_index).astype(jnp.float32) * mask[:, None]
@@ -95,9 +124,10 @@ def make_seq_classification_spec(model, example_x, ignore_index=0,
                       "correct": correct, "count": count}
 
     def loss_fn(state, batch, rng, train):
-        logits, new_state = _apply_model(model, state, batch["x"], rng, train)
+        logits, new_state, aux = _apply_model(model, state, batch["x"],
+                                              rng, train, with_sown=True)
         loss, metrics = _loss_and_metrics(logits, batch["y"], batch["mask"])
-        return loss, (new_state, metrics)
+        return loss + aux_loss_weight * aux, (new_state, metrics)
 
     def metrics_fn(state, batch):
         logits, _ = _apply_model(model, state, batch["x"], None, False)
@@ -109,7 +139,8 @@ def make_seq_classification_spec(model, example_x, ignore_index=0,
 
 
 def make_segmentation_spec(model, example_x, num_classes,
-                           ignore_index=255, name="segmentation"):
+                           ignore_index=255, name="segmentation",
+                           aux_loss_weight=0.01):
     """Per-pixel cross-entropy over ``[B, H, W, C]`` logits with
     ignore-label masking (reference FedSeg ``MyModelTrainer`` loss). Metrics
     carry a summed ``[C, C]`` confusion matrix so the aggregator computes
@@ -117,8 +148,7 @@ def make_segmentation_spec(model, example_x, num_classes,
     from fedml_tpu.core.seg_eval import confusion_matrix
 
     def init_fn(rng):
-        variables = model.init(rng, example_x, train=False)
-        return dict(variables)
+        return _init_state(model, example_x, rng)
 
     def _loss_and_metrics(logits, y, mask):
         y = y.astype(jnp.int32)
@@ -139,9 +169,10 @@ def make_segmentation_spec(model, example_x, num_classes,
         return loss, metrics
 
     def loss_fn(state, batch, rng, train):
-        logits, new_state = _apply_model(model, state, batch["x"], rng, train)
+        logits, new_state, aux = _apply_model(model, state, batch["x"],
+                                              rng, train, with_sown=True)
         loss, metrics = _loss_and_metrics(logits, batch["y"], batch["mask"])
-        return loss, (new_state, metrics)
+        return loss + aux_loss_weight * aux, (new_state, metrics)
 
     def metrics_fn(state, batch):
         logits, _ = _apply_model(model, state, batch["x"], None, False)
@@ -152,13 +183,13 @@ def make_segmentation_spec(model, example_x, num_classes,
                      name=name)
 
 
-def make_multilabel_spec(model, example_x, name="tag_prediction"):
+def make_multilabel_spec(model, example_x, name="tag_prediction",
+                         aux_loss_weight=0.01):
     """Sigmoid BCE multilabel (reference ``my_model_trainer_tag_prediction.py``
     for stackoverflow_lr: BCELoss + top-k precision/recall style counts)."""
 
     def init_fn(rng):
-        variables = model.init(rng, example_x, train=False)
-        return dict(variables)
+        return _init_state(model, example_x, rng)
 
     def _loss_and_metrics(probs, y, mask):
         probs = jnp.clip(probs.astype(jnp.float32), 1e-7, 1 - 1e-7)
@@ -175,9 +206,10 @@ def make_multilabel_spec(model, example_x, name="tag_prediction"):
                       "correct": tp}  # correct == true positives for acc parity
 
     def loss_fn(state, batch, rng, train):
-        probs, new_state = _apply_model(model, state, batch["x"], rng, train)
+        probs, new_state, aux = _apply_model(model, state, batch["x"],
+                                             rng, train, with_sown=True)
         loss, metrics = _loss_and_metrics(probs, batch["y"], batch["mask"])
-        return loss, (new_state, metrics)
+        return loss + aux_loss_weight * aux, (new_state, metrics)
 
     def metrics_fn(state, batch):
         probs, _ = _apply_model(model, state, batch["x"], None, False)

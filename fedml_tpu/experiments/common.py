@@ -74,6 +74,8 @@ def add_base_args(parser: argparse.ArgumentParser):
                    help="keep device-resident floating image data in "
                         "bfloat16 (half the HBM footprint; default keeps "
                         "source dtype; integer data is never cast)")
+    p.add_argument("--moe_experts", type=int, default=8,
+                   help="expert count for --model moe_transformer")
     p.add_argument("--model_dtype", type=str, default=None,
                    choices=("bf16", "bfloat16"),
                    help="compute-dtype for the model zoo: bf16 runs convs/"

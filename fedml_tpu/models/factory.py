@@ -73,4 +73,8 @@ def create_model(args, model_name, output_dim):
         return models.RNNStackOverflow(vocab_size=output_dim - 4)
     if model_name in ("transformer", "transformer_nwp"):
         return models.transformer_nwp(vocab_size=output_dim, **dt)
+    if model_name == "moe_transformer":
+        experts = getattr(args, "moe_experts", 8) if args else 8
+        return models.MoETransformerLM(vocab_size=output_dim,
+                                       n_experts=experts, **dt)
     raise ValueError(f"unknown model: {model_name}")

@@ -176,3 +176,17 @@ def test_federated_transformer_nwp():
          "--lr", "0.1", "--n_train", "64", "--n_test", "16"] + TINY)
     assert api.spec.name == "nwp"
     assert api.round_idx == 2
+
+
+def test_federated_moe_transformer():
+    """Federated MoE: the NWP spec collects the sown load-balancing aux
+    loss during local training, and the sown collection never enters the
+    aggregated model state."""
+    from fedml_tpu.experiments import main_fedavg
+    api, state = main_fedavg.main(
+        ["--dataset", "synthetic_sequences", "--model", "moe_transformer",
+         "--moe_experts", "4", "--lr", "0.1",
+         "--n_train", "64", "--n_test", "16"] + TINY)
+    assert api.round_idx == 2
+    assert "losses" not in state
+    assert "wi" in state["params"]["block0"]["moe"]

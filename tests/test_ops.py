@@ -383,6 +383,29 @@ def test_expert_parallel_lm_step_matches_unsharded():
                                    atol=1e-4, rtol=1e-4)
 
 
+def test_nwp_spec_collects_moe_aux_loss():
+    # the federated NWP spec must include the sown load-balancing aux in
+    # the TRAINING loss (weight>0 vs weight=0 differ) and keep it out of
+    # the init state
+    from fedml_tpu.algorithms.specs import make_seq_classification_spec
+    from fedml_tpu.models.moe import MoETransformerLM
+
+    model = MoETransformerLM(vocab_size=30, n_layers=1, n_heads=2,
+                             d_model=16, max_len=16, n_experts=4)
+    x = jax.random.randint(jax.random.PRNGKey(0), (4, 8), 1, 30)
+    batch = {"x": x, "y": jnp.roll(x, -1, axis=1),
+             "mask": jnp.ones(4, jnp.float32)}
+    spec = make_seq_classification_spec(model, x[:1])
+    spec0 = make_seq_classification_spec(model, x[:1], aux_loss_weight=0.0)
+    state = spec.init_fn(jax.random.PRNGKey(1))
+    assert "losses" not in state
+    rng = jax.random.PRNGKey(2)
+    l_with, _ = spec.loss_fn(state, batch, rng, True)
+    l_without, _ = spec0.loss_fn(state, batch, rng, True)
+    assert float(l_with) != float(l_without)
+    assert float(l_with) > float(l_without)  # aux is nonnegative
+
+
 @pytest.mark.slow
 def test_transformer_with_ring_attention_matches_local():
     from fedml_tpu.models.transformer import TransformerLM
