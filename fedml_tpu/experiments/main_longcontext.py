@@ -38,6 +38,8 @@ def main(argv=None):
       help="total optimizer steps (0 = one pass per comm_round epochs)")
     p("--ring_block", type=int, default=512,
       help="KV block size inside each ring step")
+    p("--moe", type=int, default=0,
+      help="1 = Switch-MoE blocks (--moe_experts) instead of dense MLPs")
     args = parser.parse_args(argv)
     if args.ci:
         args.seq_len = min(args.seq_len, 64)
@@ -73,11 +75,19 @@ def main(argv=None):
               max_len=args.seq_len,
               dtype=(jnp.bfloat16 if args.model_dtype in ("bf16", "bfloat16")
                      else jnp.float32))
+    model_cls = TransformerLM
+    if args.moe:
+        # Switch MoE composes with sp: experts replicate over the mesh,
+        # ring attention still shards the sequence; the sp step collects
+        # the sown load-balancing aux
+        from fedml_tpu.models.moe import MoETransformerLM
+        model_cls = MoETransformerLM
+        kw["n_experts"] = args.moe_experts
     if n_seq > 1:
-        model = seq_parallel_model(TransformerLM, mesh,
+        model = seq_parallel_model(model_cls, mesh,
                                    block_size=args.ring_block, **kw)
     else:
-        model = TransformerLM(**kw)  # flash-attention local path
+        model = model_cls(**kw)  # flash-attention local path
 
     # synthetic token stream (zero-egress); real corpora drop in via the
     # stackoverflow/shakespeare loaders' token ids

@@ -52,7 +52,8 @@ def seq_parallel_model(model_cls, mesh, *, block_size: int = 512, **kw):
 
 def make_seq_parallel_lm_step(model, mesh, tx: Optional[Any] = None,
                               data_axis: str = DATA_AXIS,
-                              seq_axis: str = SEQ_AXIS):
+                              seq_axis: str = SEQ_AXIS,
+                              aux_loss_weight: float = 0.01):
     """Build ``(init_fn, step_fn)`` for next-token LM training with the
     sequence sharded over ``mesh[seq_axis]``.
 
@@ -79,7 +80,12 @@ def make_seq_parallel_lm_step(model, mesh, tx: Optional[Any] = None,
 
     def loss_fn(params, idx, tgt):
         from fedml_tpu.models.transformer import lm_loss
-        return lm_loss(model.apply({"params": params}, idx), tgt)
+        # collect sown losses (MoE load-balancing aux; 0.0 for dense
+        # models) so MoE composes with sequence parallelism
+        logits, mut = model.apply({"params": params}, idx,
+                                  mutable=["losses"])
+        aux = sum(jax.tree.leaves(mut.get("losses", {})), 0.0)
+        return lm_loss(logits, tgt) + aux_loss_weight * aux
 
     @partial(jax.jit,
              in_shardings=(rep, rep, x_sh, x_sh),

@@ -80,7 +80,8 @@ def tp_attention(block_size: int = 512):
 
 
 def make_tp_lm_step(model, mesh, tx: Optional[Any] = None,
-                    data_axis: str = DATA_AXIS):
+                    data_axis: str = DATA_AXIS,
+                    aux_loss_weight: float = 0.01):
     """Build ``(init_fn, step_fn)`` with Megatron-sharded params.
 
     ``init_fn(rng, example_idx) -> (params, opt_state)`` places every
@@ -102,7 +103,12 @@ def make_tp_lm_step(model, mesh, tx: Optional[Any] = None,
 
     def loss_fn(params, idx, tgt):
         from fedml_tpu.models.transformer import lm_loss
-        return lm_loss(model.apply({"params": params}, idx), tgt)
+        # collect sown losses (MoE load-balancing aux; 0.0 for dense
+        # models) so MoE composes with tensor parallelism
+        logits, mut = model.apply({"params": params}, idx,
+                                  mutable=["losses"])
+        aux = sum(jax.tree.leaves(mut.get("losses", {})), 0.0)
+        return lm_loss(logits, tgt) + aux_loss_weight * aux
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def step_fn(params, opt_state, idx, tgt):

@@ -157,6 +157,19 @@ def test_main_longcontext_seq_parallel(tmp_path):
     assert losses[-1] < losses[0]
 
 
+def test_main_longcontext_moe_seq_parallel(tmp_path):
+    """Switch-MoE + sequence parallelism composed: ring attention shards
+    the sequence while expert MLPs route tokens; loss must fall."""
+    from fedml_tpu.experiments import main_longcontext
+    _, losses = main_longcontext.main(
+        ["--n_data", "2", "--n_seq", "4", "--steps", "10", "--moe", "1",
+         "--moe_experts", "4", "--batch_size", "4", "--seq_len", "32",
+         "--lr", "0.01", "--n_train", "32", "--ci", "1",
+         "--run_dir", str(tmp_path / "lcmoe")])
+    assert len(losses) == 10
+    assert min(losses[-3:]) < losses[0]
+
+
 def test_rnn_dataset_spec_selection():
     """Sequence datasets route to the per-token NWP spec (reference trainer
     selection, standalone main_fedavg.py:269-275)."""
