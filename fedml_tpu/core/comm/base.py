@@ -12,6 +12,14 @@ from __future__ import annotations
 
 import abc
 
+#: Synthesized by transports when a peer's pipe dies WITHOUT a clean in-band
+#: shutdown (process crash, power-off, network partition). ``sender_id`` is
+#: the lost rank. ``DistributedManager`` fails fast on it by default (the
+#: reference's aggregator blocks forever on a dead client,
+#: ``FedAVGAggregator.py:50-56``); FSMs may register a handler to re-cohort
+#: instead.
+MSG_TYPE_PEER_LOST = "__peer_lost__"
+
 
 class Observer(abc.ABC):
     @abc.abstractmethod

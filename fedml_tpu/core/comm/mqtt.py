@@ -8,6 +8,12 @@ mirror-image. Payload is ``Message.to_json()`` with ndarray->list codec.
 ``paho-mqtt`` is not part of the baked environment; the class raises a clear
 error at construction when unavailable. No broker address is hardcoded
 (the reference shipped one in-tree -- a noted defect, ``client_manager.py:22``).
+
+For tests (no broker in the image) the constructor accepts a
+``client_factory`` returning any paho-compatible client object (``connect``,
+``subscribe``, ``publish``, ``loop_forever``, ``loop_stop``, ``disconnect``,
+``on_connect``/``on_message`` attributes) -- see
+``tests/test_comm_mqtt.py``'s in-memory broker.
 """
 
 from __future__ import annotations
@@ -23,39 +29,46 @@ except Exception:  # pragma: no cover
     _HAS_PAHO = False
 
 
+def _paho_factory(client_id: str):  # pragma: no cover - needs paho
+    try:  # paho-mqtt >= 2.0 requires an explicit callback API version
+        return mqtt.Client(mqtt.CallbackAPIVersion.VERSION1,
+                           client_id=client_id)
+    except AttributeError:  # paho-mqtt 1.x
+        return mqtt.Client(client_id=client_id)
+
+
 class MqttCommManager(BaseCommunicationManager):
-    def __init__(self, host, port, topic_prefix="fedml", client_id=0, client_num=0):
-        if not _HAS_PAHO:
-            raise RuntimeError(
-                "paho-mqtt is not installed; the MQTT bridge is optional. "
-                "Use the 'local' transport for simulation.")
+    def __init__(self, host, port, topic_prefix="fedml", client_id=0,
+                 client_num=0, client_factory=None):
+        if client_factory is None:
+            if not _HAS_PAHO:
+                raise RuntimeError(
+                    "paho-mqtt is not installed; the MQTT bridge is optional. "
+                    "Use the 'local' transport for simulation.")
+            client_factory = _paho_factory
         self._topic = topic_prefix
         self.client_id = client_id
         self.client_num = client_num
         self._observers = []
-        try:  # paho-mqtt >= 2.0 requires an explicit callback API version
-            self._client = mqtt.Client(
-                mqtt.CallbackAPIVersion.VERSION1, client_id=str(client_id))
-        except AttributeError:  # paho-mqtt 1.x
-            self._client = mqtt.Client(client_id=str(client_id))
+        self._client = client_factory(str(client_id))
         self._client.on_connect = self._on_connect
         self._client.on_message = self._on_message
         self._client.connect(host, port)
 
-    def _on_connect(self, client, userdata, flags, rc):  # pragma: no cover
+    def _on_connect(self, client, userdata, flags, rc):
         if self.client_id == 0:  # server subscribes to every client's uplink
             for cid in range(1, self.client_num + 1):
                 client.subscribe(self._topic + str(cid))
         else:  # client subscribes to its downlink
             client.subscribe(self._topic + "0_" + str(self.client_id))
 
-    def _on_message(self, client, userdata, msg):  # pragma: no cover
+    def _on_message(self, client, userdata, msg):
         m = Message()
         m.init_from_json_string(msg.payload.decode("utf-8"))
         for obs in self._observers:
             obs.receive_message(m.get_type(), m)
 
-    def send_message(self, msg: Message):  # pragma: no cover
+    def send_message(self, msg: Message):
         receiver = msg.get_receiver_id()
         if self.client_id == 0:
             topic = self._topic + "0_" + str(receiver)
@@ -69,9 +82,9 @@ class MqttCommManager(BaseCommunicationManager):
     def remove_observer(self, observer):
         self._observers.remove(observer)
 
-    def handle_receive_message(self):  # pragma: no cover
+    def handle_receive_message(self):
         self._client.loop_forever()
 
-    def stop_receive_message(self):  # pragma: no cover
+    def stop_receive_message(self):
         self._client.loop_stop()
         self._client.disconnect()

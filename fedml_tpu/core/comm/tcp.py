@@ -25,15 +25,22 @@ device-bridge paradigms (same role as ``mqtt.py``, without a broker).
 from __future__ import annotations
 
 import json
+import logging
 import socket
 import struct
 import threading
 
-from fedml_tpu.core.comm.base import BaseCommunicationManager
+from fedml_tpu.core.comm.base import (BaseCommunicationManager,
+                                      MSG_TYPE_PEER_LOST)
 from fedml_tpu.core.message import Message
 
 _HDR = struct.Struct("!I")
 _MAX_FRAME = 256 * 1024 * 1024
+
+#: In-band clean-shutdown frame from a client: distinguishes "this rank is
+#: done and hanging up" from a crash, so only EOF-without-GOODBYE raises
+#: MSG_TYPE_PEER_LOST at the server.
+MSG_TYPE_GOODBYE = "__goodbye__"
 
 
 def _send_frame(sock, payload: bytes):
@@ -70,6 +77,20 @@ def _recv_frame(sock) -> bytes:
     return _recv_exact(sock, n)
 
 
+def _hard_close(sock):
+    # shutdown() before close(): closing an fd does NOT wake a thread
+    # blocked in recv() on it (the fd can even be reused under it);
+    # shutdown(SHUT_RDWR) interrupts the recv with EOF deterministically
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
 class TcpCommManager(BaseCommunicationManager):
     """Star-topology TCP transport.
 
@@ -84,7 +105,14 @@ class TcpCommManager(BaseCommunicationManager):
         self.world_size = int(world_size)
         self._observers = []
         self._running = False
+        # _lock guards peer membership (and the client's single pipe);
+        # per-peer _send_locks serialize writes per connection so one
+        # stalled peer (full OS send buffer) can only wedge sends TO that
+        # peer, never the membership lock or the whole hub
         self._lock = threading.Lock()
+        self._send_locks = {}
+        self._loop_active = False  # client receive loop running?
+        self._stopping = False  # our own teardown (quenches PEER_LOST)
         if self.rank == 0:
             self._listener = socket.create_server((host, port))
             self._listener.settimeout(timeout)
@@ -108,6 +136,7 @@ class TcpCommManager(BaseCommunicationManager):
                 conn.settimeout(None)
                 _enable_keepalive(conn)
                 self._peers[peer_rank] = conn
+                self._send_locks[peer_rank] = threading.Lock()
         else:
             # retry the dial until the server is up (launch order between
             # hosts is not coordinated) or the timeout elapses
@@ -140,10 +169,25 @@ class TcpCommManager(BaseCommunicationManager):
             if receiver == 0:  # self-addressed: dispatch locally
                 self._dispatch(msg)
                 return
-            if receiver not in self._peers:
-                raise KeyError(f"no connected peer with rank {receiver}")
             with self._lock:
-                _send_frame(self._peers[receiver], payload)
+                dest = self._peers.get(receiver)
+                slock = self._send_locks.get(receiver)
+            if dest is None:
+                raise KeyError(
+                    f"no connected peer with rank {receiver} (never joined, "
+                    "its transport died -- see MSG_TYPE_PEER_LOST -- or it "
+                    "said goodbye)")
+            try:
+                with slock:
+                    _send_frame(dest, payload)
+            except OSError as e:
+                # the peer died between lookup and write: unroute it and
+                # dispatch PEER_LOST (dedup'd against its serve thread),
+                # then surface a typed error to the direct caller
+                self._drop_peer(receiver, lost=True)
+                raise ConnectionError(
+                    f"peer rank {receiver} transport died mid-send "
+                    "(MSG_TYPE_PEER_LOST dispatched)") from e
         else:
             # clients have one pipe -- to the server; rank 0 routes
             with self._lock:
@@ -153,48 +197,148 @@ class TcpCommManager(BaseCommunicationManager):
         """Blocking receive loop dispatching to observers until STOP."""
         self._running = True
         if self.rank == 0:
-            threads = [threading.Thread(target=self._serve_peer, args=(c,),
-                                        daemon=True)
-                       for c in self._peers.values()]
+            threads = [threading.Thread(target=self._serve_peer,
+                                        args=(conn, rank), daemon=True)
+                       for rank, conn in self._peers.items()]
             for t in threads:
                 t.start()
             for t in threads:
                 t.join()
+            # mirror the client branch: when the loop ends because every
+            # peer died (no STOP ever arrived), release the listener and
+            # quench late notifications instead of leaking the port
+            self._running = False
+            self._stopping = True
+            self.close()
         else:
-            while self._running:
-                try:
-                    frame = _recv_frame(self._sock)
-                except (ConnectionError, OSError):
-                    break
-                msg = Message()
-                msg.init_from_json_string(frame.decode())
-                if not self._dispatch(msg):
-                    break
-            self.close()  # release the server's serve thread promptly
+            self._loop_active = True
+            try:
+                while True:
+                    try:
+                        frame = _recv_frame(self._sock)
+                    except (ConnectionError, OSError):
+                        if self._running:  # EOF without our own shutdown
+                            self._notify_peer_lost(0)
+                        break
+                    if not self._running:
+                        # GOODBYE sent, draining until the server FINs us:
+                        # closing with unread inbound would RST and could
+                        # destroy the GOODBYE still queued at the server
+                        continue
+                    msg = Message()
+                    msg.init_from_json_string(frame.decode())
+                    if msg.get_type() == MSG_TYPE_PEER_LOST:
+                        logging.warning("tcp client: dropping in-band "
+                                        "reserved %s frame",
+                                        MSG_TYPE_PEER_LOST)
+                        continue
+                    if not self._dispatch(msg):
+                        break
+            finally:
+                self._loop_active = False
+                self.close()  # release the server's serve thread promptly
 
-    def _serve_peer(self, conn):
-        import logging
+    def _serve_peer(self, conn, peer_rank):
         while self._running:
             try:
                 frame = _recv_frame(conn)
             except (ConnectionError, OSError):
+                # dead peer (no GOODBYE, no STOP): unroute + tell the FSM
+                self._drop_peer(peer_rank, lost=True)
+                return
+            except ValueError:
+                # oversized frame header: a desynchronized or hostile
+                # stream -- there is no way to resynchronize framing, so
+                # the peer is lost (silently dying here would leave it
+                # routed with nobody reading its pipe)
+                logging.exception("tcp hub: unframeable stream from rank "
+                                  "%s", peer_rank)
+                self._drop_peer(peer_rank, lost=True)
                 return
             msg = Message()
-            msg.init_from_json_string(frame.decode())
+            try:
+                msg.init_from_json_string(frame.decode())
+            except Exception:
+                # malformed payload (corrupt bytes, version skew): same
+                # story -- treat the peer as lost, loudly
+                logging.exception("tcp hub: undecodable frame from rank "
+                                  "%s", peer_rank)
+                self._drop_peer(peer_rank, lost=True)
+                return
+            if msg.get_type() == MSG_TYPE_GOODBYE:
+                # clean hang-up: unroute WITHOUT a peer-lost dispatch
+                self._drop_peer(peer_rank, lost=False)
+                return
+            if msg.get_type() == MSG_TYPE_PEER_LOST:
+                # reserved: transport-synthesized only. An in-band frame
+                # of this type (bug or spoof) must not trigger fail-fast
+                # for a healthy rank, nor be relayed to one.
+                logging.warning("tcp hub: dropping in-band reserved "
+                                "%s frame from rank %s",
+                                MSG_TYPE_PEER_LOST, peer_rank)
+                continue
             receiver = int(msg.get_receiver_id())
             if receiver == 0:
-                if not self._dispatch(msg):
+                try:
+                    keep = self._dispatch(msg)
+                except Exception:
+                    # a broken FSM handler must not silently kill this
+                    # peer's serve thread (the hub would stop reading a
+                    # healthy client forever)
+                    logging.exception(
+                        "tcp hub: handler error for type=%s from rank %s",
+                        msg.get_type(), peer_rank)
+                    keep = True
+                if not keep:
                     # client-initiated stop: wake the sibling serve
                     # threads too (they are blocked in recv)
                     self.close()
                     return
-            elif receiver in self._peers:  # route client->client via hub
+            else:  # route client->client via hub
                 with self._lock:
-                    _send_frame(self._peers[receiver], frame)
-            else:  # unroutable: drop loudly, keep the pipe alive
-                logging.warning("tcp hub: dropping message for unknown "
-                                "rank %s (type=%s)", receiver,
-                                msg.get_type())
+                    dest = self._peers.get(receiver)
+                    slock = self._send_locks.get(receiver)
+                if dest is None:  # unroutable: drop loudly, keep pipe alive
+                    logging.warning("tcp hub: dropping message for unknown "
+                                    "rank %s (type=%s)", receiver,
+                                    msg.get_type())
+                else:
+                    try:
+                        with slock:
+                            _send_frame(dest, frame)
+                    except OSError:
+                        # DESTINATION died mid-relay; its own serve thread
+                        # may race to report it -- _drop_peer dedups. The
+                        # sender's pipe is healthy: keep serving it.
+                        self._drop_peer(receiver, lost=True)
+
+    def _drop_peer(self, peer_rank, lost):
+        """Unroute a peer; when ``lost`` (EOF/send-failure, not GOODBYE)
+        also dispatch MSG_TYPE_PEER_LOST. The pop doubles as dedup: two
+        threads can observe the same death (the peer's serve thread and a
+        relaying sibling), only the one that wins the pop notifies."""
+        with self._lock:
+            was = self._peers.pop(peer_rank, None)
+            self._send_locks.pop(peer_rank, None)
+        if was is None:
+            return
+        # close eagerly: after the pop, close() can no longer reach this
+        # socket, and a CLOSE_WAIT fd must not wait for GC. (Also FINs the
+        # peer promptly on the GOODBYE path -- its drain loop exits.)
+        _hard_close(was)
+        if lost:
+            self._notify_peer_lost(peer_rank)
+
+    def _notify_peer_lost(self, peer_rank):
+        """Dispatch MSG_TYPE_PEER_LOST unless this is our own shutdown
+        tearing the sockets down (then the silence is expected). Note the
+        flag is ``_stopping``, not ``_running``: sends can fail (and must
+        still notify) before the receive loop has ever started."""
+        if self._stopping:
+            return
+        lost = Message(MSG_TYPE_PEER_LOST, peer_rank, self.rank)
+        for obs in list(self._observers):
+            obs.receive_message(MSG_TYPE_PEER_LOST, lost)
 
     def _dispatch(self, msg: Message) -> bool:
         if msg.get_type() == "__stop__":
@@ -206,40 +350,62 @@ class TcpCommManager(BaseCommunicationManager):
 
     def stop_receive_message(self):
         self._running = False
-        try:
-            if self.rank == 0:
-                with self._lock:  # never interleave with a relay write
-                    for r, conn in self._peers.items():
+        self._stopping = True
+        if self.rank == 0:
+            with self._lock:
+                peers = list(self._peers.items())
+                slocks = dict(self._send_locks)
+            for r, conn in peers:
+                try:
+                    with slocks[r]:
                         _send_frame(conn, Message("__stop__", 0, r)
                                     .to_json().encode())
-            # clients: loop exits on server close or STOP frame
-        except OSError:
-            pass
-        self.close()
+                except OSError:
+                    pass  # peer died as we were waving; close handles it
+            self.close()
+        else:
+            # in-band goodbye: lets the server tell a clean hang-up from
+            # a crash (EOF alone now means MSG_TYPE_PEER_LOST there).
+            # SHUT_WR (not close) so inbound can still be drained -- an
+            # immediate close with unread inbound data would RST and
+            # could destroy the queued GOODBYE server-side.
+            try:
+                with self._lock:
+                    _send_frame(self._sock,
+                                Message(MSG_TYPE_GOODBYE, self.rank, 0)
+                                .to_json().encode())
+                self._sock.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+            if self._loop_active:
+                # the receive loop drains to EOF, then close()s. Bound
+                # that: if the server never consumes the GOODBYE (alive
+                # but stuck), force-close so the blocked recv wakes --
+                # SHUT_WR alone cannot unblock an in-flight recv
+                t = threading.Timer(5.0, lambda: _hard_close(self._sock))
+                t.daemon = True
+                t.start()
+                return
+            try:  # no loop running: drain inline (bounded) before close
+                self._sock.settimeout(5.0)
+                while self._sock.recv(65536):
+                    pass
+            except OSError:
+                pass
+            self.close()
 
     def close(self):
-        # shutdown() before close(): closing an fd does NOT wake a thread
-        # blocked in recv() on it (the fd can even be reused under it);
-        # shutdown(SHUT_RDWR) interrupts the recv with EOF deterministically
-        def hard_close(sock):
-            try:
-                sock.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                sock.close()
-            except OSError:
-                pass
-
         if self.rank == 0:
-            for conn in self._peers.values():
-                hard_close(conn)
+            with self._lock:
+                peers = list(self._peers.values())
+            for conn in peers:
+                _hard_close(conn)
             try:
                 self._listener.close()
             except OSError:
                 pass
         else:
-            hard_close(self._sock)
+            _hard_close(self._sock)
 
 
-__all__ = ["TcpCommManager"]
+__all__ = ["TcpCommManager", "MSG_TYPE_PEER_LOST", "MSG_TYPE_GOODBYE"]

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import logging
 
-from fedml_tpu.core.comm.base import Observer
+from fedml_tpu.core.comm.base import MSG_TYPE_PEER_LOST, Observer
 from fedml_tpu.core.message import Message
 
 
@@ -22,10 +22,18 @@ class DistributedManager(Observer):
         self.com_manager = comm_manager
         self.com_manager.add_observer(self)
         self.message_handler_dict = {}
+        self._lost_peer = None
 
     def run(self):
         self.register_message_receive_handlers()
         self.com_manager.handle_receive_message()
+        if self._lost_peer is not None:
+            raise RuntimeError(
+                f"rank {self.rank}: peer rank {self._lost_peer} died "
+                "mid-protocol (transport reported peer-lost and no "
+                f"'{MSG_TYPE_PEER_LOST}' handler is registered). Failing "
+                "fast instead of waiting forever; register a handler for "
+                "this type to re-cohort/continue instead.")
 
     def get_sender_id(self):
         return self.rank
@@ -33,6 +41,13 @@ class DistributedManager(Observer):
     def receive_message(self, msg_type, msg_params) -> None:
         handler = self.message_handler_dict.get(str(msg_type))
         if handler is None:
+            if str(msg_type) == MSG_TYPE_PEER_LOST:
+                # default fail-fast: stop the receive loop; run() raises
+                # once handle_receive_message unwinds (an exception here
+                # would die inside the transport's serve thread instead)
+                self._lost_peer = msg_params.get_sender_id()
+                self.finish()
+                return
             logging.warning("rank %d: no handler for message type %s", self.rank, msg_type)
             return
         handler(msg_params)

@@ -1,0 +1,154 @@
+"""MQTT bridge protocol test against an in-memory fake paho client.
+
+No broker exists in the image, so the broker is a dict of topic ->
+subscribed fake clients with synchronous delivery. What is under test is
+real: the topic scheme (server publishes ``<prefix>0_<cid>`` / subscribes
+``<prefix><cid>``, clients mirror-image -- reference
+``mqtt_comm_manager.py:47-120``), the Message JSON codec over the wire,
+observer dispatch, and the ndarray->list mobile codec round-trip.
+"""
+
+import numpy as np
+
+from fedml_tpu.core.comm.base import Observer
+from fedml_tpu.core.comm.mqtt import MqttCommManager
+from fedml_tpu.core.message import Message, lists_to_params, params_to_lists
+
+
+class FakeBroker:
+    def __init__(self):
+        self.subs = {}  # topic -> [FakeMqttClient]
+        self.published = []  # (topic, payload) log
+
+    def subscribe(self, topic, client):
+        self.subs.setdefault(topic, []).append(client)
+
+    def publish(self, topic, payload):
+        self.published.append((topic, payload))
+        for client in self.subs.get(topic, []):
+            client.deliver(topic, payload)
+
+
+class _Msg:
+    def __init__(self, topic, payload):
+        self.topic = topic
+        self.payload = payload
+
+
+class FakeMqttClient:
+    """paho-compatible surface; connect() fires on_connect synchronously."""
+
+    def __init__(self, broker, client_id):
+        self._broker = broker
+        self._id = client_id
+        self.on_connect = None
+        self.on_message = None
+        self.connected = False
+        self.loop_stopped = False
+
+    def connect(self, host, port):
+        self.connected = True
+        if self.on_connect is not None:
+            self.on_connect(self, None, {}, 0)
+
+    def subscribe(self, topic):
+        self._broker.subscribe(topic, self)
+
+    def publish(self, topic, payload=None):
+        if isinstance(payload, str):
+            payload = payload.encode("utf-8")
+        self._broker.publish(topic, payload)
+
+    def deliver(self, topic, payload):
+        if self.on_message is not None:
+            self.on_message(self, None, _Msg(topic, payload))
+
+    def loop_forever(self):  # the tests drive delivery synchronously
+        pass
+
+    def loop_stop(self):
+        self.loop_stopped = True
+
+    def disconnect(self):
+        self.connected = False
+
+
+class Recorder(Observer):
+    def __init__(self):
+        self.received = []
+
+    def receive_message(self, msg_type, msg):
+        self.received.append((msg_type, msg))
+
+
+def _managers(broker, n_clients):
+    factory = lambda cid: FakeMqttClient(broker, cid)
+    server = MqttCommManager("broker", 1883, client_id=0,
+                             client_num=n_clients, client_factory=factory)
+    clients = [MqttCommManager("broker", 1883, client_id=cid,
+                               client_factory=factory)
+               for cid in range(1, n_clients + 1)]
+    return server, clients
+
+
+def test_topic_scheme_and_roundtrip():
+    broker = FakeBroker()
+    server, clients = _managers(broker, n_clients=2)
+    server_obs, obs1, obs2 = Recorder(), Recorder(), Recorder()
+    server.add_observer(server_obs)
+    clients[0].add_observer(obs1)
+    clients[1].add_observer(obs2)
+
+    # downlink: server -> client 2 only
+    m = Message(type="init_config", sender_id=0, receiver_id=2)
+    m.add("round", 7)
+    server.send_message(m)
+    assert broker.published[-1][0] == "fedml0_2"
+    assert obs2.received and not obs1.received and not server_obs.received
+    msg_type, got = obs2.received[0]
+    assert msg_type == "init_config"
+    assert got.get("round") == 7
+    assert got.get_sender_id() == 0 and got.get_receiver_id() == 2
+
+    # uplink: client 1 -> server on its own topic
+    m = Message(type="model_update", sender_id=1, receiver_id=0)
+    clients[0].send_message(m)
+    assert broker.published[-1][0] == "fedml1"
+    assert server_obs.received[-1][0] == "model_update"
+    assert server_obs.received[-1][1].get_sender_id() == 1
+
+
+def test_mobile_codec_over_wire():
+    """ndarray payloads ride the JSON wire as nested lists and reconstruct
+    exactly (the reference's is_mobile tensor<->list codec,
+    ``fedml_api/distributed/fedavg/utils.py:5-14``)."""
+    broker = FakeBroker()
+    server, clients = _managers(broker, n_clients=1)
+    obs = Recorder()
+    clients[0].add_observer(obs)
+
+    params = {"w": np.arange(6, dtype=np.float32).reshape(2, 3) / 7.0,
+              "b": np.float32(0.25)}
+    m = Message(type="sync", sender_id=0, receiver_id=1)
+    m.add("params", params_to_lists(params))
+    server.send_message(m)
+
+    got = obs.received[0][1].get("params")
+    rebuilt = lists_to_params(got)
+    np.testing.assert_array_equal(rebuilt["w"],
+                                  np.asarray(params["w"], np.float32))
+    assert rebuilt["b"] == np.float32(0.25)
+
+
+def test_observer_remove_and_stop():
+    broker = FakeBroker()
+    server, clients = _managers(broker, n_clients=1)
+    obs = Recorder()
+    server.add_observer(obs)
+    server.remove_observer(obs)
+    m = Message(type="model_update", sender_id=1, receiver_id=0)
+    clients[0].send_message(m)
+    assert obs.received == []
+
+    server.stop_receive_message()
+    assert server._client.loop_stopped and not server._client.connected

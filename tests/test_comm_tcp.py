@@ -84,3 +84,141 @@ def test_full_star_protocol():
     server_thread.join(timeout=20)
     assert not any(t.is_alive() for t in threads)
     assert not server_thread.is_alive()
+
+
+def test_client_death_surfaces_at_server():
+    """A client that dies mid-round (no in-band STOP) must surface as a
+    MSG_TYPE_PEER_LOST dispatch at the server and unroute cleanly --
+    fail-fast where the reference's aggregator polls a flag array forever
+    (``FedAVGAggregator.py:50-56``)."""
+    from fedml_tpu.core.comm.tcp import MSG_TYPE_PEER_LOST
+
+    port = _free_port()
+    world = 2
+    server_rec = Recorder()
+    managers = {}
+
+    def client(rank):
+        m = TcpCommManager("localhost", port, rank, world, timeout=30.0)
+        managers[rank] = m
+        msg = Message("client_ready", rank, 0)
+        msg.add("payload", "up")
+        m.send_message(msg)
+        # crash WITHOUT stop_receive_message: hard socket teardown
+        m._sock.close()
+
+    t = threading.Thread(target=client, args=(1,), daemon=True)
+    t.start()
+    server = TcpCommManager("localhost", port, 0, world, timeout=30.0)
+    server.add_observer(server_rec)
+    server_thread = threading.Thread(target=server.handle_receive_message,
+                                     daemon=True)
+    server_thread.start()
+    t.join(timeout=20)
+
+    deadline = time.time() + 20
+    while (len(server_rec.messages) < 2 and time.time() < deadline):
+        time.sleep(0.01)
+    types = [m[0] for m in server_rec.messages]
+    assert types == ["client_ready", MSG_TYPE_PEER_LOST]
+    assert server_rec.messages[1][1] == 1  # sender_id = the lost rank
+
+    # the dead rank is unrouted: sending to it fails loudly, immediately
+    import pytest
+    with pytest.raises(KeyError, match="transport died"):
+        server.send_message(Message("sync_model", 0, 1))
+
+    server.stop_receive_message()
+    server_thread.join(timeout=20)
+    assert not server_thread.is_alive()
+
+
+def test_server_death_surfaces_at_client():
+    """Clients learn of a dead server (hard close, no STOP) the same way."""
+    from fedml_tpu.core.comm.tcp import MSG_TYPE_PEER_LOST
+
+    port = _free_port()
+    world = 2
+    rec = Recorder()
+    done = threading.Event()
+
+    def client(rank):
+        m = TcpCommManager("localhost", port, rank, world, timeout=30.0)
+        m.add_observer(rec)
+        m.handle_receive_message()
+        done.set()
+
+    t = threading.Thread(target=client, args=(1,), daemon=True)
+    t.start()
+    server = TcpCommManager("localhost", port, 0, world, timeout=30.0)
+    # simulate a server crash: tear sockets down without the STOP protocol
+    server.close()
+
+    assert done.wait(20), "client receive loop did not exit on server death"
+    assert [m[0] for m in rec.messages] == [MSG_TYPE_PEER_LOST]
+    assert rec.messages[0][1] == 0
+    t.join(timeout=20)
+
+
+def test_manager_fsm_fails_fast_on_peer_loss():
+    """The DistributedManager default (no handler registered for
+    MSG_TYPE_PEER_LOST): stop the loop and raise from run() -- never wait
+    on a dead peer."""
+    import pytest
+
+    from fedml_tpu.core.managers import ServerManager
+
+    port = _free_port()
+    world = 2
+
+    def client(rank):
+        m = TcpCommManager("localhost", port, rank, world, timeout=30.0)
+        msg = Message("client_ready", rank, 0)
+        m.send_message(msg)
+        m._sock.close()  # crash without STOP
+
+    t = threading.Thread(target=client, args=(1,), daemon=True)
+    t.start()
+    comm = TcpCommManager("localhost", port, 0, world, timeout=30.0)
+
+    class Fsm(ServerManager):
+        def register_message_receive_handlers(self):
+            self.register_message_receive_handler("client_ready",
+                                                  lambda m: None)
+
+    fsm = Fsm(None, comm, rank=0, size=world)
+    with pytest.raises(RuntimeError, match="peer rank 1 died"):
+        fsm.run()
+    t.join(timeout=20)
+
+
+def test_clean_client_goodbye_is_not_a_crash():
+    """stop_receive_message on a client sends an in-band GOODBYE: the
+    server unroutes it silently -- no MSG_TYPE_PEER_LOST, no fail-fast."""
+    from fedml_tpu.core.comm.tcp import MSG_TYPE_PEER_LOST
+
+    port = _free_port()
+    world = 2
+    rec = Recorder()
+
+    def client(rank):
+        m = TcpCommManager("localhost", port, rank, world, timeout=30.0)
+        msg = Message("client_ready", rank, 0)
+        m.send_message(msg)
+        m.stop_receive_message()  # clean, protocol-complete hang-up
+
+    t = threading.Thread(target=client, args=(1,), daemon=True)
+    t.start()
+    server = TcpCommManager("localhost", port, 0, world, timeout=30.0)
+    server.add_observer(rec)
+    server_thread = threading.Thread(target=server.handle_receive_message,
+                                     daemon=True)
+    server_thread.start()
+    t.join(timeout=20)
+
+    # serve loop drains: last peer said goodbye -> loop ends, no peer-lost
+    server_thread.join(timeout=20)
+    assert not server_thread.is_alive()
+    types = [m[0] for m in rec.messages]
+    assert types == ["client_ready"], types
+    assert MSG_TYPE_PEER_LOST not in types
