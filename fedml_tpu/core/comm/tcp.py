@@ -291,7 +291,10 @@ class TcpCommManager(BaseCommunicationManager):
                     keep = True
                 if not keep:
                     # client-initiated stop: wake the sibling serve
-                    # threads too (they are blocked in recv)
+                    # threads too (they are blocked in recv). Mark our own
+                    # teardown FIRST -- the EOFs we are about to cause on
+                    # healthy siblings must not dispatch PEER_LOST
+                    self._stopping = True
                     self.close()
                     return
             else:  # route client->client via hub

@@ -77,14 +77,24 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     nblocks = -(-Tk // block_size)
     pad = nblocks * block_size - Tk
     if bias is not None:
-        # normalize broadcast dims so the per-batch vmap and per-block
-        # dynamic slice are exact
-        bias = jnp.broadcast_to(bias, (B, H, Tq, Tk))
+        # keep the caller's broadcast dims SINGLETON (no broadcast_to: a
+        # [1, 1, 1, Tk] mask must stay O(T), not balloon to [B, H, Tq, Tk]
+        # -- the O(T^2) the online-softmax design exists to avoid); only
+        # a full Tk axis is ever sliced per block, singleton axes ride
+        # numpy broadcasting into the [H, Bq, Bk] score block
+        if bias.ndim > 4:
+            raise ValueError(f"bias rank {bias.ndim} > 4")
+        bias = bias.reshape((1,) * (4 - bias.ndim) + bias.shape)
+        for ax, full in enumerate((B, H, Tq, Tk)):
+            if bias.shape[ax] not in (1, full):
+                raise ValueError(
+                    f"bias axis {ax} is {bias.shape[ax]}, expected 1 or "
+                    f"{full} (broadcast against [B, H, Tq, Tk])")
     if pad:
         k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
         mask_pad = jnp.arange(nblocks * block_size) < Tk
-        if bias is not None:
+        if bias is not None and bias.shape[3] != 1:
             # keep bias block-sliceable (padded keys are masked anyway,
             # so the pad value is irrelevant; 0 keeps it finite)
             bias = jnp.pad(bias, ((0, 0),) * 3 + ((0, pad),))
@@ -99,8 +109,9 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             kblk, vblk, j = xs
             bias_blk = None
             if bias_b is not None:
-                bias_blk = jax.lax.dynamic_slice_in_dim(
-                    bias_b, j * block_size, block_size, axis=2)
+                bias_blk = (bias_b if bias_b.shape[2] == 1 else
+                            jax.lax.dynamic_slice_in_dim(
+                                bias_b, j * block_size, block_size, axis=2))
             if causal:
                 qpos = q_offset + jnp.arange(Tq)[:, None]
                 kpos = (k_offset + j * block_size
@@ -123,10 +134,13 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             (kblocks, vblocks, jnp.arange(nblocks)))
         return _finalize(acc, rsum)  # [H, Tq, D]
 
-    bias_in = (bias if bias is not None
-               else None)
-    out = jax.vmap(one_batch, in_axes=(0, 0, 0,
-                                       0 if bias is not None else None))(
+    if bias is not None and bias.shape[0] == B:
+        bias_in, bias_ax = bias, 0
+    elif bias is not None:  # singleton batch axis: share across the vmap
+        bias_in, bias_ax = bias[0], None
+    else:
+        bias_in, bias_ax = None, None
+    out = jax.vmap(one_batch, in_axes=(0, 0, 0, bias_ax))(
         q, kb, vb, bias_in)
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B, Tq, H, D]
 

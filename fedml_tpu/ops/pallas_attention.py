@@ -292,11 +292,25 @@ def _double_vmap(fn):
     return jax.vmap(jax.vmap(fn, in_axes=1, out_axes=1))
 
 
+def _require_hw_head_dim(D, interpret):
+    """On real TPU hardware the kernel's lane layout requires the head dim
+    to fill 128-wide tiles; interpret mode (CPU tests) takes any D. Fail
+    loudly up front instead of leaving a Mosaic layout error to decipher
+    (ADVICE r3)."""
+    if not interpret and D % 128:
+        raise ValueError(
+            f"flash_attention on TPU hardware requires head_dim D to be a "
+            f"multiple of 128 (got D={D}); use "
+            "fedml_tpu.ops.attention.blockwise_attention for small head "
+            "dims (same flash semantics, XLA-scheduled)")
+
+
 def _fa_fwd(q, k, v, causal, scale, block_q, block_k):
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale_ = scale if scale is not None else D ** -0.5
     interpret = _use_interpret()
+    _require_hw_head_dim(D, interpret)
     bq, bk = min(block_q, Tq), min(block_k, Tk)
     qp = _pad_t(q, (-Tq) % bq)
     kp = _pad_t(k, (-Tk) % bk)

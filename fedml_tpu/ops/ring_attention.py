@@ -13,8 +13,10 @@ exactly ``softmax(QK^T)V`` for the full sequence.
 
 Communication/compute overlap note: the matmuls of ring step ``s`` and the
 ppermute delivering step ``s+1``'s KV are independent; under ``jit`` XLA's
-latency-hiding scheduler overlaps them (the explicit double-buffer is the
-Pallas pattern in ``/opt/skills/guides/pallas_guide.md`` section 18).
+latency-hiding scheduler overlaps them. (An explicit double-buffered
+variant -- prefetch the next KV shard while computing on the current one --
+is the standard Ring Attention formulation, Liu et al. 2023,
+arXiv:2310.01889; see PAPERS.md.)
 """
 
 from __future__ import annotations

@@ -35,13 +35,32 @@ def make_ep_mesh(n_data: int, n_expert: int, devices=None):
                         devices)
 
 
-def ep_param_shardings(params, mesh):
-    """Experts (leaves named ``wi``/``wo`` with a leading E axis) shard
-    over ``expert``; everything else replicates."""
+def ep_param_shardings(params, mesh, n_experts=None):
+    """Expert weights shard over ``expert``; everything else replicates.
+
+    A leaf is an expert stack only when it is named ``wi``/``wo`` AND
+    lives under an ``moe`` module (anchored on path components -- a future
+    non-expert param merely *ending* in "wi" must not silently shard,
+    ADVICE r3). The leading axis must divide the expert mesh axis (and
+    equal ``n_experts`` when given), else this raises.
+    """
+    n_ep = mesh.shape[EXPERT_AXIS]
+
     def lookup(path, leaf):
-        key = "/".join(str(p.key) for p in path if hasattr(p, "key"))
-        expert = key.endswith("wi") or key.endswith("wo")
-        return NamedSharding(mesh, P(EXPERT_AXIS) if expert else P())
+        parts = [str(p.key) for p in path if hasattr(p, "key")]
+        expert = "moe" in parts[:-1] and parts[-1] in ("wi", "wo")
+        if not expert:
+            return NamedSharding(mesh, P())
+        if n_experts is not None and leaf.shape[0] != n_experts:
+            raise ValueError(
+                f"ep_param_shardings: '{'/'.join(parts)}' leading axis "
+                f"{leaf.shape[0]} != n_experts={n_experts}")
+        if leaf.shape[0] % n_ep:
+            raise ValueError(
+                f"ep_param_shardings: '{'/'.join(parts)}' has "
+                f"{leaf.shape[0]} experts, not divisible by the "
+                f"{n_ep}-way expert mesh axis")
+        return NamedSharding(mesh, P(EXPERT_AXIS))
 
     return jax.tree_util.tree_map_with_path(lookup, params)
 
@@ -57,7 +76,8 @@ def make_ep_lm_step(model, mesh, tx: Optional[Any] = None,
 
     def init_fn(rng, example_idx):
         vs = model.init(rng, example_idx)
-        p_sh = ep_param_shardings(vs["params"], mesh)
+        p_sh = ep_param_shardings(vs["params"], mesh,
+                                  getattr(model, "n_experts", None))
         params = jax.tree.map(jax.device_put, vs["params"], p_sh)
         return params, tx.init(params)
 

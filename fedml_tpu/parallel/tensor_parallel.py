@@ -42,26 +42,52 @@ def make_tp_mesh(n_data: int, n_model: int, devices=None):
     return make_2d_mesh(n_data, n_model, (DATA_AXIS, MODEL_AXIS), devices)
 
 
+# Megatron placement by EXACT Flax module name (a path COMPONENT, never a
+# substring -- a future 'projector' module must not silently become
+# row-parallel). Module names from models/transformer.py::_Block.
+_COL_PARALLEL = frozenset({"qkv", "mlp_up"})    # output-feature sharded
+_ROW_PARALLEL = frozenset({"proj", "mlp_down"})  # input-feature sharded
+# >=2D params that are INTENTIONALLY replicated (embeddings, LN-free head,
+# MoE experts -- expert sharding belongs to the ep axis, not tp); any other
+# >=2D param is unknown to the placement table and raises.
+_KNOWN_REPLICATED = frozenset({"tok_embed", "pos_embed", "head", "embedding",
+                               "moe"})
+
+
 def _tp_spec(path: str, ndim: int) -> P:
-    """Megatron placement by param role (matched on the Flax module path
-    names used by :class:`fedml_tpu.models.transformer.TransformerLM`)."""
+    parts = path.split("/")
     if ndim < 2:  # biases, LN scales: replicated
         return P()
-    if ("qkv" in path) or ("mlp_up" in path):
+    if any(p in _COL_PARALLEL for p in parts):
         return P(None, MODEL_AXIS)      # column-parallel
-    if ("proj" in path) or ("mlp_down" in path):
+    if any(p in _ROW_PARALLEL for p in parts):
         return P(MODEL_AXIS, None)      # row-parallel
-    return P()                          # embed / head / everything else
+    if any(p in _KNOWN_REPLICATED for p in parts):
+        return P()
+    raise ValueError(
+        f"tp_param_shardings: no Megatron placement known for >=2D param "
+        f"'{path}' -- add its module name to _COL_PARALLEL/_ROW_PARALLEL/"
+        "_KNOWN_REPLICATED rather than silently replicating")
 
 
 def tp_param_shardings(params, mesh) -> Any:
-    """PyTree of ``NamedSharding`` mirroring ``params``."""
+    """PyTree of ``NamedSharding`` mirroring ``params``. Validates that
+    every sharded dimension divides the ``model`` mesh axis (an indivisible
+    dim would make GSPMD pad-and-mask, silently wasting compute)."""
+    n_model = mesh.shape[MODEL_AXIS]
     flat = jax.tree_util.tree_flatten_with_path(params)[0]
     specs = {}
     for path, leaf in flat:
         key = "/".join(str(p.key) for p in path
                        if hasattr(p, "key"))
-        specs[key] = NamedSharding(mesh, _tp_spec(key, jnp.ndim(leaf)))
+        spec = _tp_spec(key, jnp.ndim(leaf))
+        for dim, axis in enumerate(spec):
+            if axis == MODEL_AXIS and leaf.shape[dim] % n_model:
+                raise ValueError(
+                    f"tp_param_shardings: '{key}' dim {dim} of size "
+                    f"{leaf.shape[dim]} does not divide the {n_model}-way "
+                    "model axis")
+        specs[key] = NamedSharding(mesh, spec)
 
     def lookup(path, leaf):
         key = "/".join(str(p.key) for p in path if hasattr(p, "key"))

@@ -97,9 +97,30 @@ def main():
     sp_checksum = float(sum(np.float64(x).sum()
                             for x in jax.tree.leaves(sp_out)))
 
+    # third program: one TENSOR-PARALLEL LM step with the Megatron model
+    # axis spanning BOTH processes (mesh data=1 x model=8 over the global
+    # device list) -- the per-block all-reduces ride the cross-process
+    # (DCN-analog) transport, not just intra-process ICI
+    from fedml_tpu.parallel.tensor_parallel import (
+        make_tp_lm_step, make_tp_mesh, tp_attention)
+
+    tp_mesh = make_tp_mesh(1, len(devices))
+    tp_model = TransformerLM(vocab_size=50, n_layers=1, n_heads=8,
+                             d_model=32, max_len=32,
+                             attention_fn=tp_attention(block_size=16))
+    tp_idx = jax.random.randint(jax.random.PRNGKey(21), (4, 32), 0, 50)
+    tp_tgt = shift_targets(tp_idx)
+    tp_init, tp_step = make_tp_lm_step(tp_model, tp_mesh, optax.sgd(0.1))
+    tp_params, tp_opt = tp_init(jax.random.PRNGKey(22), tp_idx)
+    tp_new, _, tp_loss = tp_step(tp_params, tp_opt, tp_idx, tp_tgt)
+    tp_out = gather_metrics(tp_new)
+    tp_checksum = float(sum(np.float64(x).sum()
+                            for x in jax.tree.leaves(tp_out)))
+
     print(f"RESULT process={idx} count={float(m['count'].sum()):.0f} "
           f"checksum={checksum:.10e} sp_loss={float(sp_loss):.8e} "
-          f"sp_checksum={sp_checksum:.10e}", flush=True)
+          f"sp_checksum={sp_checksum:.10e} tp_loss={float(tp_loss):.8e} "
+          f"tp_checksum={tp_checksum:.10e}", flush=True)
 
 
 if __name__ == "__main__":

@@ -222,3 +222,40 @@ def test_clean_client_goodbye_is_not_a_crash():
     types = [m[0] for m in rec.messages]
     assert types == ["client_ready"], types
     assert MSG_TYPE_PEER_LOST not in types
+
+
+def test_client_initiated_stop_no_spurious_peer_lost():
+    """An in-band __stop__ from one client tears the hub down WITHOUT
+    reporting healthy siblings as lost peers."""
+    from fedml_tpu.core.comm.tcp import MSG_TYPE_PEER_LOST
+
+    port = _free_port()
+    world = 3
+    rec = Recorder()
+    managers = {}
+
+    def client(rank, stopper):
+        m = TcpCommManager("localhost", port, rank, world, timeout=30.0)
+        managers[rank] = m
+        m.send_message(Message("client_ready", rank, 0))
+        if stopper:
+            time.sleep(0.5)  # let both HELLOs land first
+            m.send_message(Message("__stop__", rank, 0))
+        m.handle_receive_message()
+
+    threads = [threading.Thread(target=client, args=(1, True), daemon=True),
+               threading.Thread(target=client, args=(2, False), daemon=True)]
+    for t in threads:
+        t.start()
+    server = TcpCommManager("localhost", port, 0, world, timeout=30.0)
+    server.add_observer(rec)
+    server_thread = threading.Thread(target=server.handle_receive_message,
+                                     daemon=True)
+    server_thread.start()
+    server_thread.join(timeout=20)
+    for t in threads:
+        t.join(timeout=20)
+    assert not server_thread.is_alive()
+    types = [m[0] for m in rec.messages]
+    assert MSG_TYPE_PEER_LOST not in types, types
+    assert types.count("client_ready") == 2
