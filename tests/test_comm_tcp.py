@@ -234,12 +234,16 @@ def test_client_initiated_stop_no_spurious_peer_lost():
     rec = Recorder()
     managers = {}
 
+    both_ready = threading.Event()
+
     def client(rank, stopper):
         m = TcpCommManager("localhost", port, rank, world, timeout=30.0)
         managers[rank] = m
         m.send_message(Message("client_ready", rank, 0))
         if stopper:
-            time.sleep(0.5)  # let both HELLOs land first
+            # only stop once BOTH client_readys were observed (a sleep
+            # here is a race under CI load)
+            assert both_ready.wait(20)
             m.send_message(Message("__stop__", rank, 0))
         m.handle_receive_message()
 
@@ -252,6 +256,11 @@ def test_client_initiated_stop_no_spurious_peer_lost():
     server_thread = threading.Thread(target=server.handle_receive_message,
                                      daemon=True)
     server_thread.start()
+    deadline = time.time() + 20
+    while (sum(1 for m in rec.messages if m[0] == "client_ready") < 2
+           and time.time() < deadline):
+        time.sleep(0.01)
+    both_ready.set()
     server_thread.join(timeout=20)
     for t in threads:
         t.join(timeout=20)

@@ -1,0 +1,53 @@
+"""Data prepare/verify CLI (VERDICT r3 missing #3): fixtures written by
+``python -m fedml_tpu.data.prepare fixture`` must satisfy the REAL loaders
+(verify runs them), committed fixtures must stay loadable, and a
+mislaid directory must fail with the documented layout."""
+
+import numpy as np
+import pytest
+
+from fedml_tpu.data.prepare import DATASETS, LAYOUTS, main
+
+FIXDIR = __file__.rsplit("/", 1)[0] + "/fixtures"
+
+
+def test_layout_docs_cover_all_datasets(capsys):
+    for ds in DATASETS:
+        assert main(["layout", ds]) == 0
+    out = capsys.readouterr().out
+    assert "fed_emnist_train.h5" in out and "user_dict.csv" in out
+
+
+@pytest.mark.parametrize("ds", ["fed_cifar100", "leaf_shakespeare",
+                                "stackoverflow_lr", "cifar10", "susy"])
+def test_fixture_roundtrips_through_real_loader(ds, tmp_path, capsys):
+    rc = main(["fixture", ds, "--data_dir", str(tmp_path / ds)])
+    assert rc == 0
+    assert f"{ds}: OK" in capsys.readouterr().out
+
+
+def test_committed_fixtures_load():
+    from fedml_tpu.data.leaf import load_leaf_mnist
+    from fedml_tpu.data.tff_h5 import load_fed_emnist
+
+    t = load_fed_emnist(FIXDIR + "/fed_emnist")
+    assert len(t[4]) == 2 and t[2]["x"].shape[1:] == (28, 28)
+    t = load_leaf_mnist(FIXDIR + "/leaf_mnist")
+    assert len(t[4]) == 2 and t[2]["x"].shape[1:] == (784,)
+
+
+def test_verify_missing_dir_prints_layout(tmp_path, capsys):
+    rc = main(["verify", "fed_emnist", "--data_dir", str(tmp_path / "nope")])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "INVALID" in err and "fed_emnist_train.h5" in err
+
+
+def test_fixture_matches_layout_promise(tmp_path):
+    # the fed_shakespeare layout says snippets are utf8 bytes >= 80 chars
+    main(["fixture", "fed_shakespeare", "--data_dir", str(tmp_path)])
+    import h5py
+    with h5py.File(str(tmp_path / "shakespeare_train.h5")) as f:
+        cids = list(f["examples"])
+        snips = f["examples"][cids[0]]["snippets"][()]
+        assert all(len(s) >= 80 for s in snips)
