@@ -176,6 +176,15 @@ def _verify_imagenet(d, clients):
     return _summarize_8tuple("imagenet", t)
 
 
+#: registry (train-time) dataset names accepted as aliases, so the name a
+#: user verifies is the name they can train with (fedml_tpu/data/
+#: registry.py::load_dataset is the single train-time switch; this CLI
+#: only adds format-variant names the registry folds into flags)
+ALIASES = {"mnist": "leaf_mnist", "femnist": "fed_emnist",
+           "shakespeare": "leaf_shakespeare", "ILSVRC2012": "imagenet",
+           "gld23k": "landmarks", "gld160k": "landmarks"}
+
+
 def _verify_landmarks(d, clients):
     from fedml_tpu.data.imagefolder import load_landmarks_federated
     t = load_landmarks_federated(d, image_size=8, client_num=clients)
@@ -301,7 +310,9 @@ def _fx_stackoverflow(d, n_clients, rng):
 def _fx_cifar10(d, n_clients, rng):
     base = os.path.join(d, "cifar-10-batches-py")
     os.makedirs(base, exist_ok=True)
-    per = 40
+    # the LDA partitioner needs >= 10 samples per client (with slack for
+    # the skewed draw); verify() loads with max(n_clients, 10) clients
+    per = max(40, 8 * max(n_clients, 10))
     for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
         blob = {b"data": rng.integers(0, 256, (per, 3072), np.uint8),
                 b"labels": rng.integers(0, 10, per).tolist()}
@@ -312,7 +323,8 @@ def _fx_cifar10(d, n_clients, rng):
 def _fx_cifar100(d, n_clients, rng):
     base = os.path.join(d, "cifar-100-python")
     os.makedirs(base, exist_ok=True)
-    for name, per in (("train", 200), ("test", 40)):
+    n_tr = max(200, 40 * max(n_clients, 10))
+    for name, per in (("train", n_tr), ("test", n_tr // 5)):
         blob = {b"data": rng.integers(0, 256, (per, 3072), np.uint8),
                 b"fine_labels": rng.integers(0, 100, per).tolist()}
         with open(os.path.join(base, name), "wb") as f:
@@ -321,18 +333,20 @@ def _fx_cifar100(d, n_clients, rng):
 
 def _fx_cinic10(d, n_clients, rng):
     os.makedirs(d, exist_ok=True)
+    n_tr = max(160, 16 * max(n_clients, 10))
     np.savez(os.path.join(d, "cinic10.npz"),
-             x_train=rng.random((160, 32, 32, 3)).astype(np.float32),
-             y_train=rng.integers(0, 10, 160),
-             x_test=rng.random((40, 32, 32, 3)).astype(np.float32),
-             y_test=rng.integers(0, 10, 40))
+             x_train=rng.random((n_tr, 32, 32, 3)).astype(np.float32),
+             y_train=rng.integers(0, 10, n_tr),
+             x_test=rng.random((n_tr // 4, 32, 32, 3)).astype(np.float32),
+             y_test=rng.integers(0, 10, n_tr // 4))
 
 
 def _fx_susy(d, n_clients, rng):
     os.makedirs(d, exist_ok=True)
+    n = max(128, 16 * n_clients)
     rows = np.concatenate(
-        [rng.integers(0, 2, (128, 1)).astype(np.float32),
-         rng.random((128, 18), np.float32)], axis=1)
+        [rng.integers(0, 2, (n, 1)).astype(np.float32),
+         rng.random((n, 18), np.float32)], axis=1)
     np.savetxt(os.path.join(d, "SUSY.csv"), rows, delimiter=",", fmt="%.6f")
 
 
@@ -344,8 +358,10 @@ def _write_png(path, rng):
 
 def _fx_imagenet(d, n_clients, rng):
     # >= 10 train samples per client must be feasible for the LDA
-    # partitioner's min-size retry loop (core/partition.py)
-    for split, per in (("train", 16), ("val", 4)):
+    # partitioner's min-size retry loop (core/partition.py); scale with
+    # the requested client count
+    per_train = max(16, 8 * n_clients)
+    for split, per in (("train", per_train), ("val", per_train // 4)):
         for cls in ("n01440764", "n01443537"):
             cdir = os.path.join(d, split, cls)
             os.makedirs(cdir, exist_ok=True)
@@ -394,13 +410,14 @@ def main(argv=None):
         prog="python -m fedml_tpu.data.prepare",
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("command", choices=("layout", "verify", "fixture"))
-    p.add_argument("dataset", choices=sorted(DATASETS))
+    p.add_argument("dataset", choices=sorted(DATASETS) + sorted(ALIASES))
     p.add_argument("--data_dir", default=None,
                    help="dataset root (required for verify/fixture)")
     p.add_argument("--clients", type=int, default=None,
                    help="verify: truncate to N clients (fast check); "
                         "fixture: clients to generate (default 3)")
     args = p.parse_args(argv)
+    args.dataset = ALIASES.get(args.dataset, args.dataset)
 
     if args.command == "layout":
         print(f"# expected layout for {args.dataset}\n{LAYOUTS[args.dataset]}")
@@ -412,11 +429,13 @@ def main(argv=None):
         rng = np.random.default_rng(0)
         fixture_fn(args.data_dir, args.clients or 3, rng)
         print(f"wrote {args.dataset} fixture under {args.data_dir}")
-    # verify always runs (fixture immediately proves itself loadable)
+    # verify always runs (fixture immediately proves itself loadable);
+    # loader schema errors (missing keys, infeasible partitions, bad
+    # shapes) surface as INVALID + the documented layout, not a traceback
     try:
         print(verify_fn(args.data_dir, args.clients))
-    except FileNotFoundError as e:
-        print(f"INVALID: {e}", file=sys.stderr)
+    except (FileNotFoundError, ValueError, KeyError, OSError) as e:
+        print(f"INVALID: {type(e).__name__}: {e}", file=sys.stderr)
         print(f"expected layout:\n{LAYOUTS[args.dataset]}", file=sys.stderr)
         return 1
     return 0
