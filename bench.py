@@ -238,6 +238,11 @@ def main():
 
     import jax
 
+    from fedml_tpu.utils.compile_cache import enable_compilation_cache
+
+    # persistent XLA cache: the degrade ladder re-compiles per rung
+    # (113-163 s each on TPU); cached rungs start measuring immediately
+    enable_compilation_cache()
     device = jax.devices()[0]
     mode = 0 if args.flat else args.mode
 

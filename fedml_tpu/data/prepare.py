@@ -311,7 +311,8 @@ def _fx_cifar10(d, n_clients, rng):
     base = os.path.join(d, "cifar-10-batches-py")
     os.makedirs(base, exist_ok=True)
     # the LDA partitioner needs >= 10 samples per client (with slack for
-    # the skewed draw); verify() loads with max(n_clients, 10) clients
+    # the skewed draw); _verify_cifar loads with (clients or 10) clients,
+    # so size for whichever is larger
     per = max(40, 8 * max(n_clients, 10))
     for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
         blob = {b"data": rng.integers(0, 256, (per, 3072), np.uint8),

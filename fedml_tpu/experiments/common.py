@@ -118,6 +118,8 @@ def setup(args, run_name=None):
     if getattr(args, "platform", None):
         import jax
         jax.config.update("jax_platforms", args.platform)
+    from fedml_tpu.utils.compile_cache import enable_compilation_cache
+    enable_compilation_cache()
     proc, nproc = maybe_initialize_distributed()
     init_logging(proctitle=run_name)
     logging.info("args = %s (process %d/%d)", vars(args), proc, nproc)

@@ -1,0 +1,45 @@
+"""Persistent XLA compilation cache (VERDICT r3 weak #5).
+
+The flagship bench compiles 113-163 s per config on the TPU and the
+degrade ladder can walk six configs -- ~15 min of pure compilation before
+the first measured round. XLA's persistent cache keys compiled executables
+by (HLO, compile options, device kind), so re-runs of the same config --
+across processes and across rounds of this continuous build -- skip
+compilation entirely.
+
+Opt-out with FEDML_TPU_COMPILE_CACHE=0; point elsewhere with
+FEDML_TPU_COMPILE_CACHE=/path.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+DEFAULT_DIR = os.path.expanduser("~/.cache/fedml_tpu/xla")
+
+
+def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
+    """Enable jax's persistent compilation cache. Returns the directory in
+    use, or None when disabled/unsupported. Safe to call more than once."""
+    if cache_dir is None:  # an explicit caller argument beats the env
+        env = os.environ.get("FEDML_TPU_COMPILE_CACHE")
+        if env == "0":
+            return None
+        cache_dir = env or DEFAULT_DIR
+    import jax
+
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # default min-compile-time gate (1 s) would skip tiny programs --
+        # fine; but cache every size of entry once it qualifies
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # jax version without the knobs: run uncached
+        logging.info("compilation cache unavailable: %s", e)
+        return None
+    return cache_dir
+
+
+__all__ = ["enable_compilation_cache", "DEFAULT_DIR"]

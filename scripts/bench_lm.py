@@ -25,16 +25,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-_PEAK = (("v5 lite", 197.0), ("v5e", 197.0), ("v5p", 459.0),
-         ("v6", 918.0), ("v4", 275.0), ("v3", 123.0))
-
-
-def peak_tflops(device):
-    kind = getattr(device, "device_kind", "").lower()
-    for key, tf in _PEAK:
-        if key in kind:
-            return tf
-    return 197.0
+from bench import peak_flops  # single source for per-chip peak TFLOPS
 
 
 def main():
@@ -56,6 +47,8 @@ def main():
         args.d_model, args.n_layers, args.seq = 256, 2, 128
         args.batch, args.vocab, args.repeats = 2, 512, 3
 
+    from fedml_tpu.utils.compile_cache import enable_compilation_cache
+    enable_compilation_cache()
     import jax
     import jax.numpy as jnp
     import optax
@@ -100,7 +93,7 @@ def main():
     fwd_per_token = L * (24 * d * d + 2 * T * d) + 2 * d * V
     flops_step = 3 * fwd_per_token * B * T
     achieved = flops_step / sec
-    peak = peak_tflops(dev) * 1e12
+    peak = peak_flops(dev)
     n_params = sum(x.size for x in jax.tree.leaves(params))
     print(json.dumps({
         "metric": f"TransformerLM train step (d{d} L{L} T{T} B{B} V{V}, "

@@ -110,9 +110,15 @@ def main():
     p.add_argument("--configs", default="bf16_lanes,fp32_lanes,bf16_flat,"
                                         "fp32_flat")
     args = p.parse_args()
+    if args.flagship and args.platform == "cpu":
+        p.error("--flagship is the full 32-client/50k/20-epoch recipe; "
+                "it would grind for days on CPU -- pass --platform default "
+                "to run it on the environment's TPU")
     if args.platform == "cpu":
         import jax
         jax.config.update("jax_platforms", "cpu")
+    from fedml_tpu.utils.compile_cache import enable_compilation_cache
+    enable_compilation_cache()
     if args.flagship:
         args.clients, args.n_train, args.image, args.epochs = 32, 50_000, 32, 20
     os.makedirs(args.outdir, exist_ok=True)
