@@ -73,6 +73,16 @@ def load_native():
             ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p]
+        lib.pack_lanes_fill.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float)]
         _lib = lib
     except Exception as e:  # missing g++, sandboxed tmp, bad build, ...
         logging.info("native packing unavailable (%s); using Python path", e)
@@ -100,6 +110,44 @@ def native_pack_schedule(ns, batch_size, epochs, S, seed):
         mask.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
     return {"idx": idx.astype(np.int32), "mask": mask,
             "n": n.astype(np.float32)}
+
+
+def native_pack_lanes_fill(idx, mask, ns, steps_pc, members, offsets, K, L):
+    """C++-backed lane relayout (the [K, L, B] fill of packing.pack_lanes;
+    LPT membership comes from the caller as CSR). Returns the output dict
+    or None when the library is unavailable."""
+    import numpy as np
+
+    lib = load_native()
+    if lib is None:
+        return None
+    C, S, B = idx.shape
+    idx = np.ascontiguousarray(idx, np.int32)
+    mask = np.ascontiguousarray(mask, np.float32)
+    ns = np.ascontiguousarray(ns, np.float32)
+    steps_pc = np.ascontiguousarray(steps_pc, np.int64)
+    members = np.ascontiguousarray(members, np.int64)
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    out = {"idx": np.zeros((K, L, B), np.int32),
+           "mask": np.zeros((K, L, B), np.float32),
+           "slot": np.zeros((K, L), np.int32),
+           "local_step": np.zeros((K, L), np.int32),
+           "flush": np.zeros((K, L), np.float32),
+           "flush_n": np.zeros((K, L), np.float32),
+           "flush_steps": np.zeros((K, L), np.float32)}
+    as_p = lambda a, t: a.ctypes.data_as(ctypes.POINTER(t))
+    lib.pack_lanes_fill(
+        as_p(idx, ctypes.c_int32), as_p(mask, ctypes.c_float),
+        as_p(ns, ctypes.c_float), as_p(steps_pc, ctypes.c_int64),
+        as_p(members, ctypes.c_int64), as_p(offsets, ctypes.c_int64),
+        C, S, B, K, L,
+        as_p(out["idx"], ctypes.c_int32), as_p(out["mask"], ctypes.c_float),
+        as_p(out["slot"], ctypes.c_int32),
+        as_p(out["local_step"], ctypes.c_int32),
+        as_p(out["flush"], ctypes.c_float),
+        as_p(out["flush_n"], ctypes.c_float),
+        as_p(out["flush_steps"], ctypes.c_float))
+    return out
 
 
 def native_pack_cohort(client_datasets, batch_size, epochs, S, seed):

@@ -61,6 +61,25 @@ void shuffle_idx(std::vector<int64_t>& v, Rng& rng) {
   }
 }
 
+// Strided thread-pool dispatch shared by every entry point: work(i) must
+// write disjoint output rows per i.
+template <typename F>
+void parallel_for(int64_t n, F work) {
+  int64_t nthreads =
+      std::min<int64_t>(n, std::max(1u, std::thread::hardware_concurrency()));
+  if (nthreads <= 1 || n == 1) {
+    for (int64_t i = 0; i < n; i++) work(i);
+    return;
+  }
+  std::vector<std::thread> pool;
+  for (int64_t t = 0; t < nthreads; t++) {
+    pool.emplace_back([&, t]() {
+      for (int64_t i = t; i < n; i += nthreads) work(i);
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
 }  // namespace
 
 extern "C" {
@@ -100,19 +119,7 @@ void pack_schedule(const int64_t* n, int64_t C, int64_t S, int64_t B,
       }
     }
   };
-  int64_t nthreads = std::min<int64_t>(
-      C, std::max(1u, std::thread::hardware_concurrency()));
-  if (nthreads <= 1 || C == 1) {
-    for (int64_t c = 0; c < C; c++) work(c);
-    return;
-  }
-  std::vector<std::thread> pool;
-  for (int64_t t = 0; t < nthreads; t++) {
-    pool.emplace_back([&, t]() {
-      for (int64_t c = t; c < C; c += nthreads) work(c);
-    });
-  }
-  for (auto& th : pool) th.join();
+  parallel_for(C, work);
 }
 
 // Gather client rows into the dense cohort tensor.
@@ -135,19 +142,48 @@ void pack_gather(const uint8_t* const* srcs, const int64_t* idx,
       }
     }
   };
-  int64_t nthreads = std::min<int64_t>(
-      C, std::max(1u, std::thread::hardware_concurrency()));
-  if (nthreads <= 1 || C == 1) {
-    for (int64_t c = 0; c < C; c++) work(c);
-    return;
-  }
-  std::vector<std::thread> pool;
-  for (int64_t t = 0; t < nthreads; t++) {
-    pool.emplace_back([&, t]() {
-      for (int64_t c = t; c < C; c += nthreads) work(c);
-    });
-  }
-  for (auto& th : pool) th.join();
+  parallel_for(C, work);
+}
+
+// Re-lay a cohort schedule into packed lanes (engine.LaneRunner layout).
+// LPT lane membership is decided by the (cheap) caller; this fills the
+// lane-major arrays -- the per-round O(C*S*B) relayout -- threaded per
+// lane. Mirrors packing.pack_lanes exactly (tested byte-equal).
+//   idx/mask            : cohort schedule            [C, S, B]
+//   ns                  : client sample counts       [C] float32
+//   steps_pc            : true step count per client [C]
+//   members / offsets   : CSR lane membership (members[offsets[k] ..
+//                         offsets[k+1]) = cohort ids of lane k, LPT order)
+//   out_* (zeroed by caller): idx/mask [K, L, B]; slot, local_step int32
+//   [K, L]; flush, flush_n, flush_steps float32 [K, L]
+void pack_lanes_fill(const int32_t* idx, const float* mask, const float* ns,
+                     const int64_t* steps_pc, const int64_t* members,
+                     const int64_t* offsets, int64_t C, int64_t S, int64_t B,
+                     int64_t K, int64_t L, int32_t* out_idx, float* out_mask,
+                     int32_t* slot, int32_t* local_step, float* flush,
+                     float* flush_n, float* flush_steps) {
+  auto work = [&](int64_t k) {
+    int64_t pos = 0;
+    for (int64_t m = offsets[k]; m < offsets[k + 1]; m++) {
+      int64_t c = members[m];
+      if (c < 0 || c >= C) continue;  // malformed CSR: never memcpy OOB
+      int64_t sc = steps_pc[c];
+      if (sc <= 0) continue;
+      std::memcpy(out_idx + (k * L + pos) * B, idx + c * S * B,
+                  sizeof(int32_t) * sc * B);
+      std::memcpy(out_mask + (k * L + pos) * B, mask + c * S * B,
+                  sizeof(float) * sc * B);
+      for (int64_t s = 0; s < sc; s++) {
+        slot[k * L + pos + s] = (int32_t)c;
+        local_step[k * L + pos + s] = (int32_t)s;
+      }
+      flush[k * L + pos + sc - 1] = 1.0f;
+      flush_n[k * L + pos + sc - 1] = ns[c];
+      flush_steps[k * L + pos + sc - 1] = (float)sc;
+      pos += sc;
+    }
+  };
+  parallel_for(K, work);
 }
 
 }  // extern "C"

@@ -222,7 +222,7 @@ def lane_max_load(steps_per_client, n_lanes) -> int:
     return int(loads.max())
 
 
-def pack_lanes(sched, n_lanes, step_bucket=8, l_max=None):
+def pack_lanes(sched, n_lanes, step_bucket=8, l_max=None, native="auto"):
     """Re-lay a packed cohort schedule ``[C, S, B]`` into ``n_lanes``
     PACKED LANES for single-dispatch rounds (``engine.LaneRunner``).
 
@@ -271,6 +271,20 @@ def pack_lanes(sched, n_lanes, step_bucket=8, l_max=None):
         if l_max < loads.max():
             raise ValueError(f"l_max={l_max} < max lane load {loads.max()}")
         L = int(l_max)
+
+    if packing_backend(native) == "native":
+        # the heavy part -- the O(C*S*B) lane-major relayout -- runs in
+        # the C++ shim (threaded per lane); the LPT above is O(C log C)
+        # host numpy either way. Output is byte-equal to the loop below.
+        from fedml_tpu.native import native_pack_lanes_fill
+        members = np.asarray([c for ms in lanes for c in ms], np.int64)
+        offsets = np.zeros(K + 1, np.int64)
+        np.cumsum([len(ms) for ms in lanes], out=offsets[1:])
+        out = native_pack_lanes_fill(idx, mask, ns, steps_pc, members,
+                                     offsets, K, L)
+        if out is not None:
+            out["trip"] = int(loads.max())
+            return out
 
     out_idx = np.zeros((K, L, B), np.int32)
     out_mask = np.zeros((K, L, B), np.float32)

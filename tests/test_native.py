@@ -123,3 +123,25 @@ def test_fallback_when_disabled(monkeypatch):
     assert out["x"].shape[0] == 2  # python path still works
     # restore lazy state for other tests
     monkeypatch.setattr(native, "_tried", False)
+
+
+def test_native_pack_lanes_matches_python():
+    # the C++ lane relayout must be BYTE-equal to the numpy path on a
+    # ragged cohort (incl. a zero-sample client and K > C clamping)
+    import numpy as np
+    import pytest
+
+    from fedml_tpu.native import native_available
+    from fedml_tpu.parallel.packing import pack_lanes, pack_schedule
+
+    if not native_available():
+        pytest.skip("native toolchain unavailable")
+    rng = np.random.default_rng(11)
+    sched = pack_schedule([17, 3, 0, 40, 8, 23], batch_size=4, epochs=2,
+                          rng=rng, native=False)
+    for n_lanes in (1, 3, 8):
+        a = pack_lanes(sched, n_lanes, native=True)
+        b = pack_lanes(sched, n_lanes, native=False)
+        assert set(a) == set(b)
+        for k in b:
+            np.testing.assert_array_equal(a[k], b[k], err_msg=k)
