@@ -1,0 +1,132 @@
+"""Distributed-paradigm protocol over the TCP transport: ServerManager +
+ClientManager FSMs drive TWO full FedAvg control-plane rounds across real
+sockets (init_config -> local update -> model upload -> weighted aggregate
+-> sync -> finish), weights riding the ndarray<->list mobile codec.
+
+Message-type parity with the reference FSMs
+(``fedml_api/distributed/fedavg/message_define.py``): S2C init/sync,
+C2S model upload. The transport-level STOP replaces
+``MPI.COMM_WORLD.Abort()``.
+"""
+
+import socket
+import threading
+
+import numpy as np
+
+from fedml_tpu.core.comm.tcp import TcpCommManager
+from fedml_tpu.core.managers import ClientManager, ServerManager
+from fedml_tpu.core.message import (Message, lists_to_params,
+                                    params_to_lists)
+
+MSG_S2C_INIT = "init_config"
+MSG_S2C_SYNC = "sync_model_to_client"
+MSG_C2S_MODEL = "send_model_to_server"
+ROUNDS = 2
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class FedAvgServerFsm(ServerManager):
+    def __init__(self, args, comm, size, weights0, client_ns):
+        super().__init__(args, comm, rank=0, size=size)
+        self.weights = dict(weights0)
+        self.client_ns = client_ns  # rank -> sample count
+        self.round = 0
+        self.pending = {}
+        self.history = []
+
+    def start(self):
+        for r in range(1, self.size):
+            m = Message(MSG_S2C_INIT, 0, r)
+            m.add("params", params_to_lists(self.weights))
+            m.add("round", 0)
+            self.send_message(m)
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MSG_C2S_MODEL,
+                                              self._on_model)
+
+    def _on_model(self, msg):
+        sender = msg.get_sender_id()
+        self.pending[sender] = lists_to_params(msg.get("params"))
+        if len(self.pending) < self.size - 1:
+            return
+        # weighted FedAvg aggregate (the reference's host-side loop)
+        total = sum(self.client_ns.values())
+        agg = {k: sum(self.client_ns[r] * self.pending[r][k]
+                      for r in self.pending) / total
+               for k in self.weights}
+        self.weights = agg
+        self.history.append(agg)
+        self.pending = {}
+        self.round += 1
+        if self.round >= ROUNDS:
+            self.finish()  # STOP frames release every client loop
+            return
+        for r in range(1, self.size):
+            m = Message(MSG_S2C_SYNC, 0, r)
+            m.add("params", params_to_lists(self.weights))
+            m.add("round", self.round)
+            self.send_message(m)
+
+
+class FedAvgClientFsm(ClientManager):
+    """Deterministic 'local training': w <- w + rank (checkable oracle)."""
+
+    def __init__(self, args, comm, rank, size):
+        super().__init__(args, comm, rank=rank, size=size)
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MSG_S2C_INIT, self._on_sync)
+        self.register_message_receive_handler(MSG_S2C_SYNC, self._on_sync)
+
+    def _on_sync(self, msg):
+        w = lists_to_params(msg.get("params"))
+        local = {k: v + np.float32(self.rank) for k, v in w.items()}
+        out = Message(MSG_C2S_MODEL, self.rank, 0)
+        out.add("params", params_to_lists(local))
+        out.add("num_samples", 1)
+        self.send_message(out)
+
+
+def test_two_round_fedavg_protocol_over_tcp():
+    port = _free_port()
+    size = 3
+    w0 = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+          "b": np.zeros(3, np.float32)}
+    client_ns = {1: 10.0, 2: 30.0}
+
+    def run_client(rank):
+        comm = TcpCommManager("localhost", port, rank, size, timeout=30.0)
+        fsm = FedAvgClientFsm(None, comm, rank, size)
+        fsm.run()  # exits via the server's STOP
+
+    threads = [threading.Thread(target=run_client, args=(r,), daemon=True)
+               for r in (1, 2)]
+    for t in threads:
+        t.start()
+    comm = TcpCommManager("localhost", port, 0, size, timeout=30.0)
+    server = FedAvgServerFsm(None, comm, size, w0, client_ns)
+    server.register_message_receive_handlers()
+    server.start()
+    server_thread = threading.Thread(target=server.com_manager
+                                     .handle_receive_message, daemon=True)
+    server_thread.start()
+    server_thread.join(timeout=30)
+    for t in threads:
+        t.join(timeout=30)
+    assert not server_thread.is_alive()
+    assert not any(t.is_alive() for t in threads)
+
+    # oracle: each round adds weighted_mean(rank) = (10*1 + 30*2)/40 = 1.75
+    assert len(server.history) == ROUNDS
+    for r, agg in enumerate(server.history, start=1):
+        np.testing.assert_allclose(agg["w"], w0["w"] + 1.75 * r, rtol=1e-6)
+        np.testing.assert_allclose(agg["b"], w0["b"] + 1.75 * r, rtol=1e-6)
