@@ -111,6 +111,7 @@ class TcpCommManager(BaseCommunicationManager):
         # peer, never the membership lock or the whole hub
         self._lock = threading.Lock()
         self._send_locks = {}
+        self._lost_notified = set()  # see _notify_peer_lost
         self._loop_active = False  # client receive loop running?
         self._stopping = False  # our own teardown (quenches PEER_LOST)
         if self.rank == 0:
@@ -189,17 +190,31 @@ class TcpCommManager(BaseCommunicationManager):
                     f"peer rank {receiver} transport died mid-send "
                     "(MSG_TYPE_PEER_LOST dispatched)") from e
         else:
-            # clients have one pipe -- to the server; rank 0 routes
-            with self._lock:
-                _send_frame(self._sock, payload)
+            # clients have one pipe -- to the server; rank 0 routes.
+            # Mirror the server branch's failure semantics: a dead server
+            # mid-send must dispatch PEER_LOST (sends can fail before the
+            # receive loop has ever started) and raise a typed error.
+            try:
+                with self._lock:
+                    _send_frame(self._sock, payload)
+            except OSError as e:
+                self._notify_peer_lost(0)
+                raise ConnectionError(
+                    "server (rank 0) transport died mid-send "
+                    "(MSG_TYPE_PEER_LOST dispatched)") from e
 
     def handle_receive_message(self):
         """Blocking receive loop dispatching to observers until STOP."""
         self._running = True
         if self.rank == 0:
+            # snapshot under the lock: a concurrent _drop_peer (e.g. a
+            # failed send from the FSM's start() thread racing loop
+            # startup) must not mutate the dict mid-iteration
+            with self._lock:
+                peers = list(self._peers.items())
             threads = [threading.Thread(target=self._serve_peer,
                                         args=(conn, rank), daemon=True)
-                       for rank, conn in self._peers.items()]
+                       for rank, conn in peers]
             for t in threads:
                 t.start()
             for t in threads:
@@ -290,12 +305,14 @@ class TcpCommManager(BaseCommunicationManager):
                         msg.get_type(), peer_rank)
                     keep = True
                 if not keep:
-                    # client-initiated stop: wake the sibling serve
-                    # threads too (they are blocked in recv). Mark our own
-                    # teardown FIRST -- the EOFs we are about to cause on
-                    # healthy siblings must not dispatch PEER_LOST
-                    self._stopping = True
-                    self.close()
+                    # client-initiated stop: wave STOP at the remaining
+                    # peers BEFORE tearing sockets down -- a bare close()
+                    # would EOF healthy siblings without a STOP frame and
+                    # their managers would report a server crash on what
+                    # is a clean whole-job stop. stop_receive_message
+                    # sets _stopping first, so the EOFs it causes never
+                    # dispatch PEER_LOST locally either.
+                    self.stop_receive_message()
                     return
             else:  # route client->client via hub
                 with self._lock:
@@ -336,9 +353,18 @@ class TcpCommManager(BaseCommunicationManager):
         """Dispatch MSG_TYPE_PEER_LOST unless this is our own shutdown
         tearing the sockets down (then the silence is expected). Note the
         flag is ``_stopping``, not ``_running``: sends can fail (and must
-        still notify) before the receive loop has ever started."""
+        still notify) before the receive loop has ever started.
+
+        Dedups per peer: on the client, a dead server can be observed by
+        BOTH the receive loop's EOF and a concurrent send_message OSError
+        (on rank 0 _drop_peer's pop already dedups, but the set costs
+        nothing there) -- a re-cohort handler must run once per death."""
         if self._stopping:
             return
+        with self._lock:
+            if peer_rank in self._lost_notified:
+                return
+            self._lost_notified.add(peer_rank)
         lost = Message(MSG_TYPE_PEER_LOST, peer_rank, self.rank)
         for obs in list(self._observers):
             obs.receive_message(MSG_TYPE_PEER_LOST, lost)
@@ -359,12 +385,21 @@ class TcpCommManager(BaseCommunicationManager):
                 peers = list(self._peers.items())
                 slocks = dict(self._send_locks)
             for r, conn in peers:
+                # bounded acquire: a relay/send thread wedged in sendall
+                # (destination alive but not reading -- a full send
+                # buffer still ACKs keepalives, so the keepalive never
+                # fires) must not block shutdown forever. On timeout we
+                # skip the wave for that peer; close() below force-closes
+                # its pipe, which also wakes the wedged sendall.
+                if not slocks[r].acquire(timeout=2.0):
+                    continue
                 try:
-                    with slocks[r]:
-                        _send_frame(conn, Message("__stop__", 0, r)
-                                    .to_json().encode())
+                    _send_frame(conn, Message("__stop__", 0, r)
+                                .to_json().encode())
                 except OSError:
                     pass  # peer died as we were waving; close handles it
+                finally:
+                    slocks[r].release()
             self.close()
         else:
             # in-band goodbye: lets the server tell a clean hang-up from

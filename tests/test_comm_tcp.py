@@ -226,18 +226,23 @@ def test_clean_client_goodbye_is_not_a_crash():
 
 def test_client_initiated_stop_no_spurious_peer_lost():
     """An in-band __stop__ from one client tears the hub down WITHOUT
-    reporting healthy siblings as lost peers."""
+    reporting healthy siblings as lost peers -- at the server AND at the
+    siblings themselves: the hub must wave STOP frames before closing, or
+    the sibling's receive loop sees a bare EOF and reports the teardown
+    as a server crash (round-4 advisor finding)."""
     from fedml_tpu.core.comm.tcp import MSG_TYPE_PEER_LOST
 
     port = _free_port()
     world = 3
     rec = Recorder()
+    client_recs = {1: Recorder(), 2: Recorder()}
     managers = {}
 
     both_ready = threading.Event()
 
     def client(rank, stopper):
         m = TcpCommManager("localhost", port, rank, world, timeout=30.0)
+        m.add_observer(client_recs[rank])
         managers[rank] = m
         m.send_message(Message("client_ready", rank, 0))
         if stopper:
@@ -265,6 +270,12 @@ def test_client_initiated_stop_no_spurious_peer_lost():
     for t in threads:
         t.join(timeout=20)
     assert not server_thread.is_alive()
+    assert not any(t.is_alive() for t in threads)
     types = [m[0] for m in rec.messages]
     assert MSG_TYPE_PEER_LOST not in types, types
     assert types.count("client_ready") == 2
+    # the healthy sibling (rank 2) exited via an explicit STOP frame, not
+    # by interpreting the hub's socket teardown as a peer crash
+    for rank, crec in client_recs.items():
+        ctypes_ = [m[0] for m in crec.messages]
+        assert MSG_TYPE_PEER_LOST not in ctypes_, (rank, ctypes_)
