@@ -153,7 +153,16 @@ def build_api(args, epochs, client_chunk, wave_mode):
         seed=0, client_chunk=client_chunk, wave_mode=wave_mode,
         device_resident="auto", device_data_cap_gb=4.0,
         device_dtype=args.device_dtype)
-    api = FedAvgAPI(dataset, spec, run_args)
+    if args.algo == "fedopt":
+        # second bench line (non-FedAvg path): same engine/shapes, server
+        # Adam on the pseudo-gradient (reference ``fedopt`` algorithm) --
+        # shows the measured advantage is the engine's, not the recipe's
+        from fedml_tpu.algorithms.fedopt import FedOptAPI
+        run_args.server_optimizer = "adam"
+        run_args.server_lr = 0.001
+        api = FedOptAPI(dataset, spec, run_args)
+    else:
+        api = FedAvgAPI(dataset, spec, run_args)
     if api.device_data is None:
         raise RuntimeError("device-resident path required for the bench")
     return api
@@ -206,9 +215,11 @@ def main():
     p.add_argument("--batch_size", type=int, default=64)
     p.add_argument("--client_chunk", type=int, default=8,
                    help="clients per concurrent wave (HBM activation knob)")
-    p.add_argument("--mode", type=int, default=2, choices=(0, 1, 2),
-                   help="2 = packed lanes (one dispatch, LPT-balanced; "
-                        "default), 1 = size-sorted waves, 0 = flat")
+    p.add_argument("--mode", type=int, default=3, choices=(0, 1, 2, 3),
+                   help="3 = MXU-packed lanes (lane axis folded into "
+                        "channels, models/lane_packed.py; default), 2 = "
+                        "vmap packed lanes, 1 = size-sorted waves, "
+                        "0 = flat")
     p.add_argument("--flat", action="store_true",
                    help="shorthand for --mode 0")
     p.add_argument("--no_degrade", action="store_true",
@@ -220,6 +231,10 @@ def main():
                    help="halve the HBM residency of the data")
     p.add_argument("--profile_dir", type=str, default=None,
                    help="write a jax.profiler trace of the measured rounds")
+    p.add_argument("--algo", choices=("fedavg", "fedopt"), default="fedavg",
+                   help="fedopt = same engine/shapes with a server-Adam "
+                        "step on the pseudo-gradient (second bench line; "
+                        "vs_baseline stays tied to the FedAvg baseline)")
     args = p.parse_args()
 
     # the hang-probe only matters where the wedge exists: the axon relay
@@ -253,7 +268,10 @@ def main():
     ladder = [dict(epochs=args.epochs, client_chunk=args.client_chunk,
                    wave_mode=mode)]
     if not args.no_degrade:
-        if mode == 2:  # lanes failed -> try waves at the same shape
+        if mode == 3:  # MXU-packed failed -> vmap lanes at the same shape
+            ladder.append(dict(epochs=args.epochs,
+                               client_chunk=args.client_chunk, wave_mode=2))
+        if mode >= 2:  # lanes failed -> try waves at the same shape
             ladder.append(dict(epochs=args.epochs,
                                client_chunk=args.client_chunk, wave_mode=1))
         for chunk in (4, 2, 1):
@@ -302,7 +320,8 @@ def main():
     steps_round = meas["samples_per_round"] / args.batch_size
 
     result = {
-        "metric": ("FedAvg rounds/hour (CIFAR-10-scale ResNet-56, "
+        "metric": (f"{'FedOpt' if args.algo == 'fedopt' else 'FedAvg'} "
+                   "rounds/hour (CIFAR-10-scale ResNet-56, "
                    f"{args.clients} clients, bs{args.batch_size}, "
                    f"{epochs_run} local epochs)"
                    + (" [SMOKE -- not baseline-comparable]" if args.smoke
@@ -324,8 +343,8 @@ def main():
     # report ANY deviation from the requested first rung (including a
     # chunk-only degrade, which keeps the workload flagship-comparable but
     # must still be visible), and every failed rung along the way
-    result["exec_mode"] = {2: "lanes", 1: "waves", 0: "flat"}[
-        used["wave_mode"]]
+    result["exec_mode"] = {3: "mxu-lanes", 2: "lanes", 1: "waves",
+                           0: "flat"}[used["wave_mode"]]
     if used != ladder[0] and not args.smoke:
         result["degraded_config"] = {
             "epochs": used["epochs"], "client_chunk": used["client_chunk"],

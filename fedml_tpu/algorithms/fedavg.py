@@ -92,37 +92,49 @@ class FedAvgAPI:
         # stacking copies the whole dataset host-side: only do it for the
         # paths that will consume it (single-chip residency, or mesh lanes)
         wants_residency = (mesh is None
-                           or int(getattr(args, "wave_mode", 1)) == 2)
+                           or int(getattr(args, "wave_mode", 1)) in (2, 3))
         stacked = (self._stack_if_fits(args)
                    if device_resident and wants_residency else None)
+        self.packed_lane_runner = None
         if stacked is not None and mesh is None:
             import jax.numpy as jnp
             self.device_data = {"x": jnp.asarray(stacked["host"]["x"]),
                                 "y": jnp.asarray(stacked["host"]["y"])}
             self._client_ns = stacked["n"]
             # execution modes for device-resident rounds (--wave_mode):
-            # 2 = packed lanes (one dispatch, LPT-balanced, zero padded
-            # compute), 1 = size-sorted waves (default), 0 = flat single
-            # program (A/B / debugging)
+            # 3 = MXU-packed lanes (lane axis folded into channels,
+            # models/lane_packed.py; falls back to 2 for model families
+            # without a packed lowering), 2 = packed lanes (one dispatch,
+            # LPT-balanced), 1 = size-sorted waves (default), 0 = flat
+            # single program (A/B / debugging)
             self.wave_runner = WaveRunner(
                 spec, cfg, payload_fn, server_fn, client_chunk=chunk)
             self.lane_runner = LaneRunner(
                 spec, cfg, payload_fn, server_fn, n_lanes=chunk)
+            if (int(getattr(args, "wave_mode", 1)) == 3
+                    and spec.lane_loss_builder is not None):
+                self.packed_lane_runner = LaneRunner(
+                    spec, cfg, payload_fn, server_fn, n_lanes=chunk,
+                    packed=True)
             self.indexed_round_fn = make_indexed_sim_round(
                 spec, cfg, payload_fn, server_fn,
                 client_chunk=getattr(args, "client_chunk", None))
         elif (stacked is not None and mesh is not None
-                and int(getattr(args, "wave_mode", 1)) == 2):
+                and int(getattr(args, "wave_mode", 1)) in (2, 3)):
             # mesh + lanes: client rows live SHARDED over the mesh's
             # clients axis; each shard runs its residents as packed lanes
-            # and aggregation is one psum (ShardedLaneRunner)
+            # and aggregation is one psum (ShardedLaneRunner); wave_mode 3
+            # additionally folds each shard's lane axis into channels
+            # (MXU-shaped lowering) when the model family supports it
             from fedml_tpu.parallel.multihost import global_cohort
             host = stacked["host"]
             placed = global_cohort(mesh, {"x": host["x"], "y": host["y"]})
             self.device_data = {"x": placed["x"], "y": placed["y"]}
             self._client_ns = stacked["n"]
             self.sharded_lane_runner = ShardedLaneRunner(
-                spec, cfg, mesh, payload_fn, server_fn, n_lanes=chunk)
+                spec, cfg, mesh, payload_fn, server_fn, n_lanes=chunk,
+                packed=(int(getattr(args, "wave_mode", 1)) == 3
+                        and spec.lane_loss_builder is not None))
         self.server_state = server_state if server_state is not None else ()
 
         seed = getattr(args, "seed", 0)
@@ -199,9 +211,12 @@ class FedAvgAPI:
                  info) = self.sharded_lane_runner.run_round(
                     self.global_state, self.server_state, self.device_data,
                     client_indexes, sched, round_rng)
-            elif mode == 2:
+            elif mode in (2, 3):
+                runner = (self.packed_lane_runner
+                          if mode == 3 and self.packed_lane_runner is not None
+                          else self.lane_runner)
                 (self.global_state, self.server_state,
-                 info) = self.lane_runner.run_round(
+                 info) = runner.run_round(
                     self.global_state, self.server_state, self.device_data,
                     client_indexes, sched, round_rng)
             elif mode == 1:

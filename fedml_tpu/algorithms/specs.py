@@ -93,8 +93,15 @@ def make_classification_spec(model, example_x, num_classes=None,
         _, metrics = _loss_and_metrics(logits, batch["y"], batch["mask"])
         return metrics
 
+    # MXU-shaped packed-lane path (wave_mode=3): the lane_packed registry
+    # owns which model families have a packed lowering (None otherwise --
+    # runners fall back to the vmap lane path); this module stays
+    # model-agnostic
+    from fedml_tpu.models.lane_packed import builder_for
+
     return TrainSpec(init_fn=init_fn, loss_fn=loss_fn, metrics_fn=metrics_fn,
-                     name=name, augment_fn=augment_fn)
+                     name=name, augment_fn=augment_fn,
+                     lane_loss_builder=builder_for(model))
 
 
 def make_seq_classification_spec(model, example_x, ignore_index=0,

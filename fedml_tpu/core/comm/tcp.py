@@ -389,8 +389,8 @@ class TcpCommManager(BaseCommunicationManager):
                 # (destination alive but not reading -- a full send
                 # buffer still ACKs keepalives, so the keepalive never
                 # fires) must not block shutdown forever. On timeout we
-                # skip the wave for that peer; close() below force-closes
-                # its pipe, which also wakes the wedged sendall.
+                # skip the wave for that peer; the close below force-
+                # closes its pipe, which also wakes the wedged sendall.
                 if not slocks[r].acquire(timeout=2.0):
                     continue
                 try:
@@ -400,7 +400,22 @@ class TcpCommManager(BaseCommunicationManager):
                     pass  # peer died as we were waving; close handles it
                 finally:
                     slocks[r].release()
-            self.close()
+            # SHUT_WR, not an immediate close: closing with unread
+            # inbound (a peer mid-send at stop time) RSTs and can destroy
+            # the STOP frame still in flight -- the same hazard the
+            # client GOODBYE path documents. FIN delivers the STOP; each
+            # peer drains, stops, and closes, which lets the serve
+            # threads exit and the receive loop run close() itself. The
+            # timer bounds the wait if a peer never closes (or no
+            # receive loop is running to reap the sockets).
+            for r, conn in peers:
+                try:
+                    conn.shutdown(socket.SHUT_WR)
+                except OSError:
+                    pass
+            t = threading.Timer(5.0, self.close)
+            t.daemon = True
+            t.start()
         else:
             # in-band goodbye: lets the server tell a clean hang-up from
             # a crash (EOF alone now means MSG_TYPE_PEER_LOST there).

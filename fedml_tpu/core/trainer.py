@@ -73,3 +73,11 @@ class TrainSpec:
     metrics_fn: Optional[Callable[..., Any]] = None
     name: str = "model"
     augment_fn: Optional[Callable[..., Any]] = None
+    #: optional MXU-shaped whole-lane-block loss for the packed LaneRunner
+    #: (``wave_mode=3``): ``lane_loss_builder(n_lanes) -> lane_loss_fn``
+    #: where ``lane_loss_fn(stacked_state, batch, rng, train) ->
+    #: (loss_sum, (new_stacked_state, per_lane_metrics))`` computes ALL
+    #: lanes in one program with the lane axis folded into channels
+    #: (``models/lane_packed.py``). None = model family not supported;
+    #: runners fall back to the vmap lane path.
+    lane_loss_builder: Optional[Callable[..., Any]] = None

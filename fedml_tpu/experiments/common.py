@@ -57,11 +57,15 @@ def add_base_args(parser: argparse.ArgumentParser):
     p.add_argument("--mesh", type=int, default=0,
                    help="shard clients over an N-device mesh (0 = vmapped "
                         "single-device simulation)")
-    p.add_argument("--wave_mode", type=int, default=1, choices=(0, 1, 2),
-                   help="device-resident rounds: 2 = packed lanes (one "
-                        "dispatch, LPT-balanced), 1 = size-sorted waves "
-                        "with dynamic trip counts (default), 0 = flat "
-                        "single-program round (A/B / debugging)")
+    p.add_argument("--wave_mode", type=int, default=1, choices=(0, 1, 2, 3),
+                   help="device-resident rounds: 3 = MXU-packed lanes "
+                        "(lane axis folded into channels, "
+                        "models/lane_packed.py; falls back to 2 when the "
+                        "model family has no packed lowering), 2 = packed "
+                        "lanes (one dispatch, LPT-balanced), 1 = "
+                        "size-sorted waves with dynamic trip counts "
+                        "(default), 0 = flat single-program round "
+                        "(A/B / debugging)")
     p.add_argument("--client_chunk", type=int, default=8,
                    help="clients per concurrent wave on the device-"
                         "resident path (HBM activation knob)")

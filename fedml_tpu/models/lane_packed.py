@@ -1,0 +1,255 @@
+"""Lane-packed CIFAR ResNet: the MXU-shaped lowering of per-lane convs.
+
+Why this exists (docs/PERFORMANCE.md, round-4 analysis): the packed-lane
+engine (``parallel/engine.py`` LaneRunner) trains L independent per-lane
+model replicas by ``jax.vmap`` over lane-stacked params. XLA lowers the
+lane-batched convolutions as ``feature_group_count=L`` grouped convs with
+per-group input channels equal to the MODEL's channel count -- 16/32/64
+for ResNet-56/CIFAR -- against the MXU's K-granularity of 128, wasting
+8x/4x/2x of every systolic pass (measured 8.9% MFU, ~25-30% shape
+ceiling).
+
+This module re-expresses the same L-replica computation with the lane
+axis folded into channels *under our control*:
+
+- activations live as ``[B, H, W, L*C]`` (lane-major channels);
+- each conv merges ``g = 128 // C_in`` lanes per group into ONE grouped
+  conv whose per-group K is ``g*C_in = 128`` (a full MXU tile), with the
+  per-lane weights embedded block-diagonally inside each group. The
+  extra multiply-adds against the off-diagonal zero blocks are FLOPs the
+  MXU was already wasting on underfilled tiles in the grouped form --
+  now they ride full tiles with no group loop;
+- BatchNorm over merged channels IS per-lane BatchNorm (the reduction
+  set per (lane, channel) is identical); the head is a per-lane einsum.
+
+Numerics match ``jax.vmap(model.apply)`` over lane-stacked params to
+float reassociation (oracle: ``tests/test_lane_packed.py``); autodiff
+extracts per-lane weight grads through the block-diagonal embedding's
+transpose (a gather of the diagonal blocks of the dense dW).
+
+No reference analog: the reference trains one client per GPU process
+(``FedAVGAggregator.py:58-87``) and never faces batched-weight lowering.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.models.resnet import CifarResNet
+
+_BN_MOMENTUM = 0.9
+_BN_EPS = 1e-5
+#: MXU lane width: per-group input channels are padded up to this by
+#: merging lanes (K granularity of the systolic array).
+MXU_K = 128
+
+
+def lane_merge(x):
+    """``[L, B, H, W, C] -> [B, H, W, L*C]`` (lane-major channels)."""
+    L, B, H, W, C = x.shape
+    return jnp.transpose(x, (1, 2, 3, 0, 4)).reshape(B, H, W, L * C)
+
+
+def lane_unmerge(x, L):
+    """``[B, H, W, L*C] -> [L, B, H, W, C]``."""
+    B, H, W, LC = x.shape
+    return jnp.transpose(x.reshape(B, H, W, L, LC // L), (3, 0, 1, 2, 4))
+
+
+def _lanes_per_group(L, ci, min_k=MXU_K):
+    """Largest divisor of ``L`` with ``g*ci`` closest to (>= if possible)
+    ``min_k``: how many lanes merge into one conv group."""
+    g = max(1, min(L, min_k // max(ci, 1)))
+    while L % g:
+        g -= 1
+    return g
+
+
+def lane_conv(x, w, L, strides=(1, 1), padding=((1, 1), (1, 1)),
+              min_k=MXU_K):
+    """Per-lane conv over merged activations.
+
+    ``x``: ``[B, H, W, L*Ci]`` lane-major; ``w``: ``[L, kh, kw, Ci, Co]``
+    per-lane HWIO kernels. Returns ``[B, H', W', L*Co]``.
+
+    Lowering: ``g`` lanes merge per group (``g*Ci ~ 128``); the group's
+    weights are the g x g block-diagonal embedding of the lanes' kernels,
+    so the grouped conv computes exactly the per-lane convs -- on full
+    MXU K-tiles instead of ``Ci``-wide ones.
+    """
+    _, kh, kw, ci, co = w.shape
+    g = _lanes_per_group(L, ci, min_k)
+    G = L // g
+    wg = w.reshape(G, g, kh, kw, ci, co)
+    # wd[j, h, w, l*ci+i, m*co+o] = wg[j, m, h, w, i, o] * (l == m):
+    # inputs of lane l contribute only to outputs of lane m == l. The
+    # einsum has no contraction -- every output element is one product
+    # with 1.0 or 0.0, so the embedding is exact in any dtype.
+    eye = jnp.eye(g, dtype=w.dtype)
+    wd = jnp.einsum("gmhwio,lm->ghwlimo", wg, eye)
+    rhs = (wd.reshape(G, kh, kw, g * ci, g * co)
+           .transpose(1, 2, 3, 0, 4)
+           .reshape(kh, kw, g * ci, G * g * co))
+    return jax.lax.conv_general_dilated(
+        x, rhs, window_strides=strides, padding=padding,
+        feature_group_count=G,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def lane_bn(x, p, ra, L, train, dtype):
+    """Per-lane BatchNorm on merged activations; flax semantics
+    (fp32 stats, fast variance, clip-negative, momentum 0.9, eps 1e-5).
+
+    ``p``: ``{"scale","bias"} [L, C]``; ``ra``: ``{"mean","var"} [L, C]``
+    running stats. Returns ``(y, new_ra)``.
+    """
+    scale = p["scale"].reshape(-1)  # [L*C], lane-major like x's channels
+    bias = p["bias"].reshape(-1)
+    if train:
+        xf = x.astype(jnp.float32)
+        mu = jnp.mean(xf, axis=(0, 1, 2))
+        mu2 = jnp.mean(xf * xf, axis=(0, 1, 2))
+        var = jnp.maximum(0.0, mu2 - mu * mu)
+        new_ra = {
+            "mean": _BN_MOMENTUM * ra["mean"]
+            + (1 - _BN_MOMENTUM) * mu.reshape(ra["mean"].shape),
+            "var": _BN_MOMENTUM * ra["var"]
+            + (1 - _BN_MOMENTUM) * var.reshape(ra["var"].shape),
+        }
+    else:
+        mu, var = ra["mean"].reshape(-1), ra["var"].reshape(-1)
+        new_ra = ra
+    # flax _normalize: y = (x - mean) * (rsqrt(var+eps) * scale) + bias
+    # in fp32, then cast to the module dtype
+    y = (x.astype(jnp.float32) - mu) * (
+        jax.lax.rsqrt(var + _BN_EPS) * scale) + bias
+    return y.astype(dtype), new_ra
+
+
+def make_lane_packed_apply(model: CifarResNet, L: int):
+    """Build the packed apply for ``L`` lanes of a :class:`CifarResNet`.
+
+    Returns ``apply_fn(stacked_vars, x, train) -> (logits, new_stats)``
+    where ``stacked_vars`` is ``{"params", "batch_stats"}`` with every
+    leaf lane-stacked (leading ``L`` -- the exact layout the LaneRunner
+    carries), ``x`` is ``[L, B, H, W, 3]``, ``logits`` ``[L, B, classes]``
+    and ``new_stats`` is the lane-stacked batch_stats pytree.
+    """
+    if not isinstance(model, CifarResNet):
+        raise TypeError(f"lane-packed apply supports CifarResNet, got "
+                        f"{type(model).__name__}")
+    n = (model.depth - 2) // 6
+    dtype = model.dtype
+
+    def apply_fn(stacked_vars, x, train=False):
+        p, bs = stacked_vars["params"], stacked_vars["batch_stats"]
+        new_bs = {}
+        x = lane_merge(x.astype(dtype))
+
+        def conv(name, xin, w, strides=1, padding=1):
+            del name
+            s = (strides, strides)
+            pad = ((padding, padding), (padding, padding))
+            return lane_conv(xin, w.astype(dtype), L, strides=s, padding=pad)
+
+        def bn(name, xin):
+            y, ra = lane_bn(xin, p[name], bs[name], L, train, dtype)
+            new_bs[name] = ra
+            return y
+
+        def bn_in(block, name, xin):
+            y, ra = lane_bn(xin, p[block][name], bs[block][name], L, train,
+                            dtype)
+            new_bs.setdefault(block, {})[name] = ra
+            return y
+
+        x = conv("conv1", x, p["conv1"]["kernel"])
+        x = bn("bn1", x)
+        x = jax.nn.relu(x)
+        for stage, (_, strides) in enumerate([(16, 1), (32, 2), (64, 2)]):
+            for block in range(n):
+                name = f"layer{stage + 1}_block{block}"
+                blk = p[name]
+                s = strides if block == 0 else 1
+                residual = x
+                y = conv("conv1", x, blk["conv1"]["kernel"], strides=s)
+                y = bn_in(name, "bn1", y)
+                y = jax.nn.relu(y)
+                y = conv("conv2", y, blk["conv2"]["kernel"])
+                y = bn_in(name, "bn2", y)
+                if "downsample_conv" in blk:
+                    residual = conv("downsample", x,
+                                    blk["downsample_conv"]["kernel"],
+                                    strides=s, padding=0)
+                    residual = bn_in(name, "downsample_bn", residual)
+                x = jax.nn.relu(y + residual)
+        x = jnp.mean(x, axis=(1, 2))  # [B, L*64]
+        B = x.shape[0]
+        feat = x.reshape(B, L, -1).astype(jnp.float32)
+        # per-lane head: fc kernel [L, 64, classes], bias [L, classes]
+        logits = (jnp.einsum("blc,lco->lbo", feat,
+                             p["fc"]["kernel"].astype(jnp.float32))
+                  + p["fc"]["bias"][:, None, :].astype(jnp.float32))
+        return logits, new_bs
+
+    return apply_fn
+
+
+def make_lane_loss_builder(model, augment_fn=None):
+    """TrainSpec ``lane_loss_builder`` for classification over a
+    :class:`CifarResNet` (see ``core/trainer.py``): called with the lane
+    count, returns ``lane_loss_fn(stacked_state, batch, step_keys, train)
+    -> (loss_sum, (new_stacked_state, per_lane_metrics))`` -- the whole-
+    lane-block loss the packed LaneRunner differentiates in one program.
+
+    Per-lane loss/metrics reproduce ``make_classification_spec`` exactly
+    (masked mean CE, argmax-correct, count), just batched over the
+    leading lane axis; ``loss_sum`` is the sum of per-lane losses, whose
+    gradient w.r.t. the lane-stacked params is the per-lane gradients
+    (lanes are computationally independent).
+    """
+    del augment_fn  # augmentation stays in the engine body (per-lane vmap)
+
+    def builder(L):
+        packed_apply = make_lane_packed_apply(model, L)
+
+        def lane_loss_fn(stacked_state, batch, rng, train):
+            del rng  # CifarResNet takes no dropout rngs
+            logits, new_bs = packed_apply(stacked_state, batch["x"], train)
+            y, mask = batch["y"], batch["mask"]  # [L, B]
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            ll = jnp.take_along_axis(
+                logp, y[..., None].astype(jnp.int32), axis=-1)[..., 0]
+            per_sample = -ll
+            count = jnp.sum(mask, axis=1)  # [L]
+            loss_sum_l = jnp.sum(per_sample * mask, axis=1)
+            loss_l = loss_sum_l / jnp.maximum(count, 1.0)
+            correct = jnp.sum(
+                (jnp.argmax(logits, axis=-1) == y) * mask, axis=1)
+            metrics = {"loss_sum": loss_sum_l, "correct": correct,
+                       "count": count}
+            new_state = dict(stacked_state)
+            new_state["batch_stats"] = new_bs
+            return jnp.sum(loss_l), (new_state, metrics)
+
+        return lane_loss_fn
+
+    return builder
+
+
+def builder_for(model):
+    """Registry: the packed-lowering ``lane_loss_builder`` for a model
+    instance, or None when the family has no lane-packed apply. The one
+    place to extend when a new family gains a packed lowering (spec
+    builders call this instead of type-checking models themselves)."""
+    if isinstance(model, CifarResNet):
+        return make_lane_loss_builder(model)
+    return None
+
+
+__all__ = ["lane_merge", "lane_unmerge", "lane_conv", "lane_bn",
+           "make_lane_packed_apply", "make_lane_loss_builder",
+           "builder_for", "MXU_K"]

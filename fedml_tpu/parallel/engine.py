@@ -506,6 +506,146 @@ def make_lane_update(spec: TrainSpec, cfg: ClientUpdateConfig, payload_fn):
     return lane_update
 
 
+def make_packed_lane_update(spec: TrainSpec, cfg: ClientUpdateConfig,
+                            payload_fn, n_lanes: int):
+    """MXU-shaped variant of :func:`make_lane_update`: ALL lanes advance
+    in one program per step, with the model's lane axis folded into
+    channels by ``spec.lane_loss_builder`` (``models/lane_packed.py``)
+    instead of ``jax.vmap`` over lane-stacked weights.
+
+    Motivation (docs/PERFORMANCE.md): vmapped per-lane convs lower to
+    ``feature_group_count=L`` grouped convs whose per-group K (the
+    model's channel count, 16/32/64 for ResNet-56) underfills the MXU's
+    128-wide systolic passes by 8x/4x/2x. The packed lowering merges
+    lanes per group up to K=128. Everything outside the model forward --
+    optimizer, payload, augmentation -- runs under a cheap elementwise
+    ``jax.vmap`` over lanes, so per-lane semantics (valid-select, flush,
+    divergent optimizer state) are bitwise those of the vmap path.
+
+    Same signature/returns as the vmapped ``lane_update`` AFTER its
+    round-level vmap: lanes arrays are ``[L, trip, ...]``, ``step_keys``
+    ``[L, trip, 2]``, and the returns carry a leading lane axis.
+    """
+    optimizer = make_optimizer(cfg)
+    del n_lanes  # the REAL lane count comes from the traced arrays:
+    # pack_lanes may return fewer lanes than requested for small cohorts
+    if spec.lane_loss_builder is None:
+        raise ValueError(
+            f"spec '{spec.name}' has no lane_loss_builder: the packed "
+            "lane path (wave_mode=3) supports model families with a "
+            "lane-packed lowering (models/lane_packed.py); use "
+            "wave_mode=2 for the generic vmap lane path")
+
+    def packed_update(global_state, data_x, data_y, n_max, rows, lanes,
+                      step_keys, trip):
+        L = lanes["idx"].shape[0]  # static at trace time
+        lane_loss_fn = spec.lane_loss_builder(L)
+
+        def _select(pred, new, old):
+            """Per-lane select: ``pred [L]`` against leading-L leaves."""
+            return jax.tree.map(
+                lambda nw, od: jnp.where(
+                    pred.reshape((L,) + (1,) * (nw.ndim - 1)), nw, od),
+                new, old)
+
+        g_params, g_rest = _split_state(global_state)
+        stack = lambda t: jax.tree.map(
+            lambda a: jnp.broadcast_to(a[None], (L,) + a.shape), t)
+        sg_params, sg_rest = stack(g_params), stack(g_rest)
+        # per-lane init (NOT init-of-stacked): leaves like Adam's count
+        # must carry a lane axis so divergent lanes can be selected
+        sg_opt = jax.vmap(optimizer.init)(sg_params)
+
+        def batch_at(i):
+            idx_b = jax.lax.dynamic_index_in_dim(
+                lanes["idx"], i, axis=1, keepdims=False)  # [L, B]
+            mask_b = jax.lax.dynamic_index_in_dim(
+                lanes["mask"], i, axis=1, keepdims=False)
+            slot = jax.lax.dynamic_index_in_dim(
+                lanes["slot"], i, axis=1, keepdims=False)  # [L]
+            row = jnp.take(rows, slot)
+            flat = row[:, None] * n_max + idx_b  # [L, B]
+            x = jnp.take(data_x, flat.reshape(-1), axis=0).reshape(
+                flat.shape + data_x.shape[1:])
+            y = jnp.take(data_y, flat.reshape(-1), axis=0).reshape(
+                flat.shape + data_y.shape[1:])
+            return {"x": x, "y": y, "mask": mask_b}
+
+        def grad_at(params, rest, batch, step_rngs):
+            if spec.augment_fn is not None:
+                batch = dict(batch)
+                batch["x"] = jax.vmap(
+                    lambda xx, k: spec.augment_fn(
+                        xx, jax.random.fold_in(k, 13)))(
+                    batch["x"], step_rngs)
+
+            def loss_wrapper(p):
+                state = dict(rest)
+                state["params"] = p
+                return lane_loss_fn(state, batch, step_rngs, True)
+
+            return jax.value_and_grad(loss_wrapper, has_aux=True)(params)
+
+        metrics0 = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype),
+            jax.eval_shape(lambda: grad_at(
+                sg_params, sg_rest, batch_at(0),
+                step_keys[:, 0]))[0][1][1])
+        aux0 = {"n": jnp.zeros((L,), jnp.float32),
+                "steps": jnp.zeros((L,), jnp.int32)}
+        vpayload = jax.vmap(payload_fn, in_axes=(0, None, 0))
+        pay0 = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, jnp.float32),
+            jax.eval_shape(vpayload, {**sg_rest, "params": sg_params},
+                           global_state, aux0))
+
+        def body(i, carry):
+            params, rest, opt_state, pay, w, msum = carry
+            batch = batch_at(i)
+            step_rngs = jax.lax.dynamic_index_in_dim(
+                step_keys, i, axis=1, keepdims=False)  # [L, 2]
+            (_, (new_state, metrics)), grads = grad_at(
+                params, rest, batch, step_rngs)
+            updates, new_opt = jax.vmap(optimizer.update)(
+                grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            new_rest = {k: new_state[k] for k in rest}
+            valid = jnp.sum(batch["mask"], axis=1) > 0  # [L]
+            params, rest, opt_state = _select(
+                valid, (new_params, new_rest, new_opt),
+                (params, rest, opt_state))
+            msum = jax.tree.map(jnp.add, msum, metrics)
+
+            f = jax.lax.dynamic_index_in_dim(
+                lanes["flush"], i, axis=1, keepdims=False)  # [L]
+            f_n = jax.lax.dynamic_index_in_dim(
+                lanes["flush_n"], i, axis=1, keepdims=False)
+            f_steps = jax.lax.dynamic_index_in_dim(
+                lanes["flush_steps"], i, axis=1, keepdims=False)
+            local_state = dict(rest)
+            local_state["params"] = params
+            payload = vpayload(local_state, global_state,
+                               {"n": f_n, "steps": f_steps.astype(jnp.int32)})
+            scale = f * f_n  # [L]
+            pay = jax.tree.map(
+                lambda a, p: a + scale.reshape(
+                    (L,) + (1,) * (p.ndim - 1)) * p.astype(jnp.float32),
+                pay, payload)
+            w = w + scale
+            params, rest, opt_state = _select(
+                f > 0, (sg_params, sg_rest, sg_opt),
+                (params, rest, opt_state))
+            return (params, rest, opt_state, pay, w, msum)
+
+        carry = (sg_params, sg_rest, sg_opt, pay0, jnp.zeros((L,),
+                                                             jnp.float32),
+                 metrics0)
+        _, _, _, pay, w, msum = jax.lax.fori_loop(0, trip, body, carry)
+        return pay, w, msum
+
+    return packed_update
+
+
 class LaneRunner:
     """Packed-lane execution: the WHOLE round as ONE jitted dispatch.
 
@@ -527,11 +667,19 @@ class LaneRunner:
     """
 
     def __init__(self, spec: TrainSpec, cfg: ClientUpdateConfig,
-                 payload_fn=None, server_fn=None, n_lanes=8):
+                 payload_fn=None, server_fn=None, n_lanes=8, packed=False):
         self.payload_fn = payload_fn or _default_payload
         self.server_fn = server_fn or _default_server
         self.n_lanes = int(n_lanes or 8)
-        lane_update = make_lane_update(spec, cfg, self.payload_fn)
+        self.packed = bool(packed)
+        if self.packed:
+            # MXU-shaped lowering: lane axis folded into channels by the
+            # spec's lane_loss_builder (raises if the model family has
+            # none) instead of vmap over lane-stacked weights
+            packed_update = make_packed_lane_update(
+                spec, cfg, self.payload_fn, self.n_lanes)
+        else:
+            lane_update = make_lane_update(spec, cfg, self.payload_fn)
         server_fn_ = self.server_fn
 
         @jax.jit
@@ -540,10 +688,16 @@ class LaneRunner:
             R, n_max = device_x.shape[0], device_x.shape[1]
             dx = device_x.reshape((R * n_max,) + device_x.shape[2:])
             dy = device_y.reshape((R * n_max,) + device_y.shape[2:])
-            pay, w, msum = jax.vmap(
-                lane_update, in_axes=(None, None, None, None, None, 0, 0,
-                                      None))(
-                global_state, dx, dy, n_max, rows, lanes, step_keys, trip)
+            if self.packed:
+                pay, w, msum = packed_update(
+                    global_state, dx, dy, n_max, rows, lanes, step_keys,
+                    trip)
+            else:
+                pay, w, msum = jax.vmap(
+                    lane_update, in_axes=(None, None, None, None, None, 0,
+                                          0, None))(
+                    global_state, dx, dy, n_max, rows, lanes, step_keys,
+                    trip)
             pay_sum = jax.tree.map(lambda x: jnp.sum(x, axis=0), pay)
             w_sum = jnp.sum(w)
             metrics_sum = jax.tree.map(lambda m: jnp.sum(m, axis=0), msum)
@@ -617,12 +771,19 @@ class ShardedLaneRunner:
     """
 
     def __init__(self, spec: TrainSpec, cfg: ClientUpdateConfig, mesh,
-                 payload_fn=None, server_fn=None, n_lanes=8):
+                 payload_fn=None, server_fn=None, n_lanes=8, packed=False):
         self.payload_fn = payload_fn or _default_payload
         self.server_fn = server_fn or _default_server
         self.n_lanes = int(n_lanes or 8)
         self.mesh = mesh
-        lane_update = make_lane_update(spec, cfg, self.payload_fn)
+        self.packed = bool(packed)
+        if self.packed:
+            # each shard runs ITS lanes through the MXU-shaped lowering
+            # (models/lane_packed.py); the cross-chip psum is unchanged
+            packed_update = make_packed_lane_update(
+                spec, cfg, self.payload_fn, self.n_lanes)
+        else:
+            lane_update = make_lane_update(spec, cfg, self.payload_fn)
         server_fn_ = self.server_fn
 
         def shard_fn(global_state, server_state, dx, dy, rows, lanes,
@@ -634,11 +795,16 @@ class ShardedLaneRunner:
             R_local, n_max = dx.shape[0], dx.shape[1]
             dxf = dx.reshape((R_local * n_max,) + dx.shape[2:])
             dyf = dy.reshape((R_local * n_max,) + dy.shape[2:])
-            pay, w, msum = jax.vmap(
-                lane_update,
-                in_axes=(None, None, None, None, None, 0, 0, None))(
-                global_state, dxf, dyf, n_max, rows_l, lanes_l, keys_l,
-                trip)
+            if self.packed:
+                pay, w, msum = packed_update(
+                    global_state, dxf, dyf, n_max, rows_l, lanes_l,
+                    keys_l, trip)
+            else:
+                pay, w, msum = jax.vmap(
+                    lane_update,
+                    in_axes=(None, None, None, None, None, 0, 0, None))(
+                    global_state, dxf, dyf, n_max, rows_l, lanes_l, keys_l,
+                    trip)
             pay_sum = jax.tree.map(
                 lambda x: jax.lax.psum(jnp.sum(x, axis=0), CLIENT_AXIS),
                 pay)

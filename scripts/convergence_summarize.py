@@ -1,0 +1,83 @@
+"""Build (or rebuild) convergence summary.json from committed curves.
+
+``scripts/convergence.py`` writes ``summary.json`` only when every config
+in one invocation finishes; a killed run leaves curves but no summary.
+This tool derives the summary from whatever ``*.jsonl`` curves exist in
+an outdir -- plateau (mean train acc over the last ``--tail`` rounds per
+curve), spread across configs, and the agreement verdict -- so partial
+completion still yields the committed artifact, honestly labeled with
+each curve's actual round count.
+
+Usage: python scripts/convergence_summarize.py [--outdir DIR]
+       [--tail 10] [--tol 0.03] [--min_rounds 100]
+Exit 0 = all present configs agree AND each has >= --min_rounds rounds;
+exit 1 otherwise (summary.json is written either way).
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def summarize(outdir, tail, tol, min_rounds):
+    results = []
+    for path in sorted(glob.glob(os.path.join(outdir, "*.jsonl"))):
+        name = os.path.splitext(os.path.basename(path))[0]
+        with open(path) as f:
+            curve = [json.loads(ln) for ln in f if ln.strip()]
+        if not curve:
+            continue
+        accs = [c["train_acc"] for c in curve[-tail:]]
+        results.append({
+            "name": name,
+            "dtype": "bf16" if name.startswith("bf16") else "fp32",
+            "mode": "lanes" if name.endswith("lanes") else (
+                "flat" if name.endswith("flat") else "?"),
+            "rounds": len(curve),
+            "complete": len(curve) >= min_rounds,
+            "plateau_acc": sum(accs) / len(accs),
+            "final_loss": curve[-1]["train_loss"],
+        })
+    if not results:
+        raise SystemExit(f"no curves in {outdir}")
+    accs = [r["plateau_acc"] for r in results]
+    spread = max(accs) - min(accs)
+    summary = {
+        "results": results,
+        "plateau_spread": round(spread, 4),
+        "tol": tol,
+        "tail": tail,
+        "min_rounds": min_rounds,
+        "agree": spread <= tol,
+        "all_complete": all(r["complete"] for r in results),
+        "note": ("derived by convergence_summarize.py from the committed "
+                 "curves; 'complete' is per-curve >= min_rounds"),
+    }
+    with open(os.path.join(outdir, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+    return summary
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--outdir", default="bench_results/convergence_cpu")
+    p.add_argument("--tail", type=int, default=10)
+    p.add_argument("--tol", type=float, default=0.03)
+    p.add_argument("--min_rounds", type=int, default=100)
+    args = p.parse_args()
+    s = summarize(args.outdir, args.tail, args.tol, args.min_rounds)
+    for r in s["results"]:
+        print(f"{r['name']:>11}: rounds={r['rounds']:<4} "
+              f"plateau_acc={r['plateau_acc']:.4f} "
+              f"final_loss={r['final_loss']:.4f} "
+              f"{'' if r['complete'] else '(INCOMPLETE)'}")
+    print(f"plateau spread {s['plateau_spread']:.4f} (tol {s['tol']}): "
+          f"{'AGREE' if s['agree'] else 'DIVERGED'}; "
+          f"all_complete={s['all_complete']}")
+    sys.exit(0 if (s["agree"] and s["all_complete"]) else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -37,17 +37,29 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 RESNET56_TRAIN_FLOPS = 3 * 2 * 125.75e6  # per sample (bench.py derivation)
 
 
-def timed(fn, args_, repeats, warmup=2):
-    """Median seconds per call; each call is forced by a host scalar fetch."""
-    for _ in range(warmup):
-        float(fn(*args_))
-    ts = []
+def timed_interleaved(cases, repeats, warmup=2):
+    """Median seconds per call for every case, with the repeats of ALL
+    cases interleaved round-robin: the derived breakdown is a chain of
+    subtractions (B-A, C-B, D-C), so slow drift (thermal state, host
+    load) must bias every ablation equally rather than whichever
+    happened to run last -- back-to-back blocks made the subtraction
+    occasionally NEGATIVE on noisy hosts. Each call is forced by a host
+    scalar fetch (``block_until_ready`` is unreliable on the axon
+    platform; see module docstring)."""
+    for fn, args_ in cases.values():  # compile + warm everything first
+        for _ in range(warmup):
+            float(fn(*args_))
+    ts = {name: [] for name in cases}
     for _ in range(repeats):
-        t0 = time.perf_counter()
-        float(fn(*args_))
-        ts.append(time.perf_counter() - t0)
-    ts.sort()
-    return ts[len(ts) // 2]
+        for name, (fn, args_) in cases.items():
+            t0 = time.perf_counter()
+            float(fn(*args_))
+            ts[name].append(time.perf_counter() - t0)
+    out = {}
+    for name, v in ts.items():
+        v.sort()
+        out[name] = v[len(v) // 2]
+    return out
 
 
 def main():
@@ -108,7 +120,7 @@ def main():
     lane_stats = jax.tree.map(
         lambda a: jnp.broadcast_to(a[None], (L,) + a.shape), batch_stats)
 
-    results = {}
+    cases = {}
     flops_step = L * B * RESNET56_TRAIN_FLOPS * (image / 32) ** 2
 
     # --- A: one model, batch L*B (the conv ceiling) ---------------------
@@ -118,9 +130,7 @@ def main():
         return l + 1e-30 * sum(jnp.sum(t.astype(jnp.float32))
                            for t in jax.tree.leaves(g))
 
-    results["A_one_model_bs512"] = timed(step_A,
-                                         (params, batch_stats, x_big, y_big),
-                                         args.repeats)
+    cases["A_one_model_bs512"] = (step_A, (params, batch_stats, x_big, y_big))
 
     # --- B: L vmapped models, per-lane weights (the lane penalty) -------
     @jax.jit
@@ -132,9 +142,32 @@ def main():
                            for t in jax.tree.leaves(g))
         return jnp.sum(jax.vmap(one)(ps, bss, x, y))
 
-    results["B_vmap_lanes"] = timed(step_B,
-                                    (lane_params, lane_stats, x_lane, y_lane),
-                                    args.repeats)
+    cases["B_vmap_lanes"] = (step_B, (lane_params, lane_stats, x_lane, y_lane))
+
+    # --- B2: MXU-packed lanes (lane axis folded into channels) ----------
+    # the round-5 lowering fix (models/lane_packed.py): same computation
+    # as B with per-group conv K raised to 128; B/B2 is the measured
+    # value of the relayout
+    from fedml_tpu.models.lane_packed import make_lane_packed_apply
+    packed_apply = make_lane_packed_apply(model, L)
+
+    def loss_packed(ps, bss, x, y):
+        logits, new_bs = packed_apply({"params": ps, "batch_stats": bss},
+                                      x, train=True)
+        l = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32).reshape(L * B, -1),
+            y.reshape(-1)).mean()
+        return l, new_bs
+
+    @jax.jit
+    def step_B2(ps, bss, x, y):
+        (l, _), g = jax.value_and_grad(loss_packed, has_aux=True)(
+            ps, bss, x, y)
+        return l + 1e-30 * sum(jnp.sum(t.astype(jnp.float32))
+                               for t in jax.tree.leaves(g))
+
+    cases["B2_packed_lanes"] = (step_B2,
+                                (lane_params, lane_stats, x_lane, y_lane))
 
     # --- C: B + the recipe's augmentation -------------------------------
     augment = make_cifar_augment(pad=4 if image >= 32 else 2,
@@ -151,9 +184,8 @@ def main():
         return jnp.sum(jax.vmap(one)(ps, bss, x, y,
                                      jax.random.split(key, L)))
 
-    results["C_plus_augment"] = timed(
-        step_C, (lane_params, lane_stats, x_lane, y_lane, kx[2]),
-        args.repeats)
+    cases["C_plus_augment"] = (
+        step_C, (lane_params, lane_stats, x_lane, y_lane, kx[2]))
 
     # --- D: the full engine lane-body semantics -------------------------
     # optimizer update + valid-select over (params, stats, opt) + payload
@@ -189,9 +221,9 @@ def main():
                    for t in jax.tree.leaves(state))
         return jnp.sum(ls) + 1e-30 * keep
 
-    results["D_full_lane_body"] = timed(
+    cases["D_full_lane_body"] = (
         step_D, (lane_params, lane_stats, opt_state0, pay0, x_lane, y_lane,
-                 kx[3]), args.repeats)
+                 kx[3]))
 
     # --- E: A with BN on running stats (no batch reductions) ------------
     # isolates the batch-statistics part of BatchNorm: convs identical,
@@ -208,8 +240,9 @@ def main():
         return l + 1e-30 * sum(jnp.sum(t.astype(jnp.float32))
                            for t in jax.tree.leaves(g))
 
-    results["E_one_model_frozen_bn"] = timed(
-        step_E, (params, x_big, y_big), args.repeats)
+    cases["E_one_model_frozen_bn"] = (step_E, (params, x_big, y_big))
+
+    results = timed_interleaved(cases, args.repeats)
 
     from bench import peak_flops  # device-aware peak, single source
     peak = peak_flops(dev)
@@ -222,14 +255,29 @@ def main():
 
     a, b = results["A_one_model_bs512"], results["B_vmap_lanes"]
     c, d = results["C_plus_augment"], results["D_full_lane_body"]
-    print(json.dumps({
-        "breakdown": {
-            "conv_ceiling_ms": round(a * 1e3, 3),
-            "lane_penalty_ms": round((b - a) * 1e3, 3),
-            "augment_ms": round((c - b) * 1e3, 3),
-            "opt_flush_ms": round((d - c) * 1e3, 3),
-            "lane_penalty_x": round(b / a, 2),
-        }}), flush=True)
+    b2 = results["B2_packed_lanes"]
+    breakdown = {
+        "conv_ceiling_ms": round(a * 1e3, 3),
+        "lane_penalty_ms": round((b - a) * 1e3, 3),
+        "augment_ms": round((c - b) * 1e3, 3),
+        "opt_flush_ms": round((d - c) * 1e3, 3),
+        "lane_penalty_x": round(b / a, 2),
+        "packed_lanes_ms": round(b2 * 1e3, 3),
+        "packed_speedup_x": round(b / b2, 2),
+    }
+    # a negative component means the ablation chain INVERTED (a later,
+    # strictly-more-work step timed faster than its predecessor) -- that
+    # is measurement noise, not a negative cost, and must not read as a
+    # breakdown row. Flag it instead of printing nonsense silently.
+    inversions = [k for k in ("lane_penalty_ms", "augment_ms",
+                              "opt_flush_ms") if breakdown[k] < 0]
+    if inversions:
+        breakdown["inversions"] = inversions
+        print(f"# WARNING: breakdown inversion on {inversions} -- medians "
+              "within noise despite interleaved repeats; treat those "
+              "components as ~0, or rerun with a larger --repeats",
+              file=sys.stderr)
+    print(json.dumps({"breakdown": breakdown}), flush=True)
 
 
 if __name__ == "__main__":

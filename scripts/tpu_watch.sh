@@ -38,9 +38,13 @@ run_step() {  # run_step <name> <timeout_s> <cmd...>
 
 # Priority order per VERDICT r4 next #1.
 # 1. Official bench -> the BENCH_r05 number. bench.py has its own probe +
-#    watchdog and always prints one JSON line.
+#    watchdog and always prints one JSON line. Default mode 3 = the
+#    MXU-packed lane lowering (round-5 fix), ladder falls back to 2.
 run_step bench 5400 python bench.py
-# 2. A-E ablation breakdown (the 8.9%-MFU attribution).
+# 1b. A/B: the vmap-lane lowering at the same shapes (the r3 frontier).
+run_step bench_vmap 5400 python bench.py --mode 2
+# 2. A-E ablation breakdown (the 8.9%-MFU attribution), incl. B2 =
+#    packed lanes -- B/B2 is the measured value of the relayout.
 run_step profile 5400 python scripts/profile_lane_step.py
 # 3. TransformerLM MFU (the "engine isn't the ceiling" proof).
 run_step bench_lm 5400 python scripts/bench_lm.py

@@ -117,10 +117,33 @@ def main():
     tp_checksum = float(sum(np.float64(x).sum()
                             for x in jax.tree.leaves(tp_out)))
 
+    # fourth program: one PIPELINE-PARALLEL LM step with the 8-stage ring
+    # spanning BOTH processes -- pp is the one mode whose ppermute ring
+    # actually crosses DCN in a real deployment (stage s=3 -> s=4 is a
+    # process boundary here), so its hops must work over the
+    # cross-process transport, not just intra-process ICI
+    from fedml_tpu.parallel.pipeline_parallel import (
+        init_pp_params, make_pp_lm_step, make_pp_mesh)
+
+    pp_mesh = make_pp_mesh(len(devices), devices=devices)
+    pp_idx = jax.random.randint(jax.random.PRNGKey(31), (4, 32), 0, 50)
+    pp_tgt = shift_targets(pp_idx)
+    pp_params, pp_model = init_pp_params(
+        pp_mesh, jax.random.PRNGKey(32), pp_idx, vocab_size=50,
+        n_heads=2, d_model=32, max_len=32)
+    pp_tx = optax.sgd(0.1)
+    prep_fn, pp_step = make_pp_lm_step(pp_model, pp_mesh, pp_tx, n_micro=2)
+    pp_new, _, pp_loss = pp_step(pp_params, pp_tx.init(pp_params),
+                                 *prep_fn(pp_idx, pp_tgt))
+    pp_out = gather_metrics(pp_new)
+    pp_checksum = float(sum(np.float64(x).sum()
+                            for x in jax.tree.leaves(pp_out)))
+
     print(f"RESULT process={idx} count={float(m['count'].sum()):.0f} "
           f"checksum={checksum:.10e} sp_loss={float(sp_loss):.8e} "
           f"sp_checksum={sp_checksum:.10e} tp_loss={float(tp_loss):.8e} "
-          f"tp_checksum={tp_checksum:.10e}", flush=True)
+          f"tp_checksum={tp_checksum:.10e} pp_loss={float(pp_loss):.8e} "
+          f"pp_checksum={pp_checksum:.10e}", flush=True)
 
 
 if __name__ == "__main__":

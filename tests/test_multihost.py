@@ -74,7 +74,9 @@ def test_two_process_round_matches_single_process():
                                           float(parts["sp_loss"]),
                                           float(parts["sp_checksum"]),
                                           float(parts["tp_loss"]),
-                                          float(parts["tp_checksum"]))
+                                          float(parts["tp_checksum"]),
+                                          float(parts["pp_loss"]),
+                                          float(parts["pp_checksum"]))
     assert set(results) == {0, 1}
     # both processes computed the identical replicated result
     assert results[0] == results[1]
@@ -90,6 +92,11 @@ def test_two_process_round_matches_single_process():
     tp_ref_loss, tp_ref_checksum = _single_process_tp_reference()
     np.testing.assert_allclose(results[0][4], tp_ref_loss, rtol=1e-5)
     np.testing.assert_allclose(results[0][5], tp_ref_checksum, rtol=1e-6)
+    # pp step: the 8-stage ppermute ring crosses the process boundary
+    # (VERDICT r4 next #6) -- compare to this process's 8-device run
+    pp_ref_loss, pp_ref_checksum = _single_process_pp_reference()
+    np.testing.assert_allclose(results[0][6], pp_ref_loss, rtol=1e-5)
+    np.testing.assert_allclose(results[0][7], pp_ref_checksum, rtol=1e-6)
 
 
 def _single_process_sp_reference():
@@ -136,6 +143,29 @@ def _single_process_tp_reference():
     init_fn, step_fn = make_tp_lm_step(model, mesh, optax.sgd(0.1))
     params, opt = init_fn(jax.random.PRNGKey(22), idx)
     new, _, loss = step_fn(params, opt, idx, tgt)
+    checksum = float(sum(np.float64(np.asarray(x)).sum()
+                         for x in jax.tree.leaves(new)))
+    return float(loss), checksum
+
+
+def _single_process_pp_reference():
+    """The worker's pp step (8-stage ring = all 8 devices) on this
+    process's 8-device CPU mesh, same seeds."""
+    import optax
+
+    from fedml_tpu.parallel.pipeline_parallel import (
+        init_pp_params, make_pp_lm_step, make_pp_mesh)
+    from fedml_tpu.parallel.seq_parallel import shift_targets
+
+    mesh = make_pp_mesh(8)
+    idx = jax.random.randint(jax.random.PRNGKey(31), (4, 32), 0, 50)
+    tgt = shift_targets(idx)
+    params, model = init_pp_params(mesh, jax.random.PRNGKey(32), idx,
+                                   vocab_size=50, n_heads=2, d_model=32,
+                                   max_len=32)
+    tx = optax.sgd(0.1)
+    prep_fn, step_fn = make_pp_lm_step(model, mesh, tx, n_micro=2)
+    new, _, loss = step_fn(params, tx.init(params), *prep_fn(idx, tgt))
     checksum = float(sum(np.float64(np.asarray(x)).sum()
                          for x in jax.tree.leaves(new)))
     return float(loss), checksum
