@@ -1,4 +1,5 @@
-"""Lane-packed CIFAR ResNet: the MXU-shaped lowering of per-lane convs.
+"""Lane-packed conv models: the MXU-shaped lowering of per-lane convs
+(:data:`PACKED_FAMILIES`: the CIFAR ResNets and the FedAvg-paper CNN).
 
 Why this exists (docs/PERFORMANCE.md, round-4 analysis): the packed-lane
 engine (``parallel/engine.py`` LaneRunner) trains L independent per-lane
@@ -35,9 +36,11 @@ from __future__ import annotations
 
 from typing import Any
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from fedml_tpu.models.cnn import CNNOriginalFedAvg
 from fedml_tpu.models.resnet import CifarResNet
 
 _BN_MOMENTUM = 0.9
@@ -129,18 +132,28 @@ def lane_bn(x, p, ra, L, train, dtype):
     return y.astype(dtype), new_ra
 
 
-def make_lane_packed_apply(model: CifarResNet, L: int):
-    """Build the packed apply for ``L`` lanes of a :class:`CifarResNet`.
+def make_lane_packed_apply(model, L: int):
+    """Build the packed apply for ``L`` lanes of a supported model.
 
     Returns ``apply_fn(stacked_vars, x, train) -> (logits, new_stats)``
-    where ``stacked_vars`` is ``{"params", "batch_stats"}`` with every
+    where ``stacked_vars`` is ``{"params"[, "batch_stats"]}`` with every
     leaf lane-stacked (leading ``L`` -- the exact layout the LaneRunner
-    carries), ``x`` is ``[L, B, H, W, 3]``, ``logits`` ``[L, B, classes]``
-    and ``new_stats`` is the lane-stacked batch_stats pytree.
+    carries), ``x`` is ``[L, B, ...]``, ``logits`` ``[L, B, classes]``
+    and ``new_stats`` is the lane-stacked batch_stats pytree (``{}`` for
+    stat-free families).
+
+    Supported families: :class:`CifarResNet` (the ResNet-56 flagship)
+    and :class:`CNNOriginalFedAvg` (the FedAvg-paper FEMNIST CNN, whose
+    1-channel stem underfills the MXU's K dim 128x in the vmap lowering
+    -- the merge is worth the most there).
     """
+    if isinstance(model, CNNOriginalFedAvg):
+        return _make_cnn_apply(model, L)
     if not isinstance(model, CifarResNet):
-        raise TypeError(f"lane-packed apply supports CifarResNet, got "
-                        f"{type(model).__name__}")
+        raise TypeError(
+            f"lane-packed apply supports "
+            f"{', '.join(c.__name__ for c in PACKED_FAMILIES)}, "
+            f"got {type(model).__name__}")
     n = (model.depth - 2) // 6
     dtype = model.dtype
 
@@ -198,12 +211,51 @@ def make_lane_packed_apply(model: CifarResNet, L: int):
     return apply_fn
 
 
+def _make_cnn_apply(model: CNNOriginalFedAvg, L: int):
+    """Packed apply for :class:`CNNOriginalFedAvg` (``models/cnn.py``):
+    conv5x5(32) + pool + conv5x5(64) + pool + dense512 + head, biased
+    convs, no norm layers. The 1-input-channel stem merges ALL lanes
+    into one dense conv (per-group K: 25 -> 25L); conv2 merges
+    ``128//32 = 4`` lanes (K: 800 -> 3200, whole 128-wide tiles)."""
+    dtype = model.dtype
+
+    def apply_fn(stacked_vars, x, train=False):
+        del train  # no dropout / batch stats in this family
+        p = stacked_vars["params"]
+        if x.ndim == 4:  # [L, B, 28, 28] -> add channel dim
+            x = x[..., None]
+        x = lane_merge(x.astype(dtype))  # [B, 28, 28, L*1]
+
+        def biased_conv(name, xin, padding):
+            w = p[name]["kernel"].astype(dtype)
+            y = lane_conv(xin, w, L, strides=(1, 1), padding=padding)
+            return y + p[name]["bias"].astype(dtype).reshape(-1)
+
+        x = biased_conv("conv1", x, ((2, 2), (2, 2)))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))  # per merged channel
+        x = biased_conv("conv2", x, ((2, 2), (2, 2)))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        # per-lane flatten in the reference's (H, W, C) order
+        x = lane_unmerge(x, L)  # [L, B, H, W, C]
+        x = x.reshape(x.shape[0], x.shape[1], -1)  # [L, B, HWC]
+        h = jnp.einsum("lbi,lio->lbo", x,
+                       p["fc1"]["kernel"].astype(dtype))
+        h = nn.relu(h + p["fc1"]["bias"][:, None, :].astype(dtype))
+        logits = (jnp.einsum("lbi,lio->lbo", h.astype(jnp.float32),
+                             p["fc2"]["kernel"].astype(jnp.float32))
+                  + p["fc2"]["bias"][:, None, :].astype(jnp.float32))
+        return logits, {}
+
+    return apply_fn
+
+
 def make_lane_loss_builder(model, augment_fn=None):
-    """TrainSpec ``lane_loss_builder`` for classification over a
-    :class:`CifarResNet` (see ``core/trainer.py``): called with the lane
-    count, returns ``lane_loss_fn(stacked_state, batch, step_keys, train)
-    -> (loss_sum, (new_stacked_state, per_lane_metrics))`` -- the whole-
-    lane-block loss the packed LaneRunner differentiates in one program.
+    """TrainSpec ``lane_loss_builder`` for classification over any
+    :data:`PACKED_FAMILIES` model (see ``core/trainer.py``): called with
+    the lane count, returns ``lane_loss_fn(stacked_state, batch,
+    step_keys, train) -> (loss_sum, (new_stacked_state,
+    per_lane_metrics))`` -- the whole-lane-block loss the packed
+    LaneRunner differentiates in one program.
 
     Per-lane loss/metrics reproduce ``make_classification_spec`` exactly
     (masked mean CE, argmax-correct, count), just batched over the
@@ -217,7 +269,7 @@ def make_lane_loss_builder(model, augment_fn=None):
         packed_apply = make_lane_packed_apply(model, L)
 
         def lane_loss_fn(stacked_state, batch, rng, train):
-            del rng  # CifarResNet takes no dropout rngs
+            del rng  # no PACKED_FAMILIES model uses dropout rngs
             logits, new_bs = packed_apply(stacked_state, batch["x"], train)
             y, mask = batch["y"], batch["mask"]  # [L, B]
             logp = jax.nn.log_softmax(logits.astype(jnp.float32))
@@ -232,7 +284,8 @@ def make_lane_loss_builder(model, augment_fn=None):
             metrics = {"loss_sum": loss_sum_l, "correct": correct,
                        "count": count}
             new_state = dict(stacked_state)
-            new_state["batch_stats"] = new_bs
+            if new_bs:  # stat-free families (the CNN) return {}
+                new_state["batch_stats"] = new_bs
             return jnp.sum(loss_l), (new_state, metrics)
 
         return lane_loss_fn
@@ -240,12 +293,16 @@ def make_lane_loss_builder(model, augment_fn=None):
     return builder
 
 
+#: model families with a lane-packed lowering -- the ONE list to extend
+#: (both the apply dispatch and the spec-facing registry derive from it)
+PACKED_FAMILIES = (CifarResNet, CNNOriginalFedAvg)
+
+
 def builder_for(model):
     """Registry: the packed-lowering ``lane_loss_builder`` for a model
-    instance, or None when the family has no lane-packed apply. The one
-    place to extend when a new family gains a packed lowering (spec
-    builders call this instead of type-checking models themselves)."""
-    if isinstance(model, CifarResNet):
+    instance, or None when the family has no lane-packed apply. Spec
+    builders call this instead of type-checking models themselves."""
+    if isinstance(model, PACKED_FAMILIES):
         return make_lane_loss_builder(model)
     return None
 
