@@ -33,8 +33,9 @@ def summarize(outdir, tail, tol, min_rounds):
         results.append({
             "name": name,
             "dtype": "bf16" if name.startswith("bf16") else "fp32",
-            "mode": "lanes" if name.endswith("lanes") else (
-                "flat" if name.endswith("flat") else "?"),
+            "mode": ("lanes3" if name.endswith("lanes3")
+                     else "lanes" if name.endswith("lanes")
+                     else "flat" if name.endswith("flat") else "?"),
             "rounds": len(curve),
             "complete": len(curve) >= min_rounds,
             "plateau_acc": sum(accs) / len(accs),

@@ -55,8 +55,19 @@ run_step hw_flash 3600 python scripts/hw_smoke_flash.py
 run_step bench_fedopt 5400 python bench.py --algo fedopt
 # 6. Flagship long-horizon convergence (VERDICT r4 next #7) -- the most
 #    wall-clock-hungry item, so last; partial curves flush per round.
+# lanes3 arms first: the MXU-packed lowering is the headline path and
+# its horizon evidence can ONLY come from hardware with an MXU (on CPU
+# it measures ~8x the vmap-lane cost -- docs/PERFORMANCE.md); both
+# precisions of lanes3 run here because the CPU matrix has no lanes3
+# arm, so bf16-x-packed-lowering interaction is otherwise uncovered.
 run_step convergence_flagship 28800 python scripts/convergence.py \
   --flagship --platform default --rounds 100 \
+  --configs bf16_lanes3,fp32_lanes3,bf16_lanes,bf16_flat \
+  --outdir "$OUT/convergence_flagship"
+# convergence.py only writes summary.json when ALL configs finish; on a
+# timeout kill the JSONL curves survive -- rebuild the plateau verdict
+# from whatever completed (the tool exists exactly for killed runs).
+run_step convergence_summarize 120 python scripts/convergence_summarize.py \
   --outdir "$OUT/convergence_flagship"
 
 log "measurement plan complete"
