@@ -95,7 +95,10 @@ def run_config(name, dtype, wave_mode, args):
                       f"({time.time() - t0:.0f}s)", flush=True)
     tail = [c["train_acc"] for c in curve[-args.tail:]]
     return {"name": name, "dtype": dtype,
-            "mode": {3: "lanes3", 2: "lanes", 0: "flat"}[wave_mode],
+            # derive from the config name (same rule as
+            # convergence_summarize.py) rather than a second
+            # wave_mode->label map that must stay in sync
+            "mode": name.split("_", 1)[1],
             "plateau_acc": sum(tail) / len(tail),
             "final_loss": curve[-1]["train_loss"],
             "rounds": args.rounds, "wall_s": round(time.time() - t0, 1)}
@@ -150,12 +153,17 @@ def main():
                # (models/lane_packed.py): its trajectory must be compared
                # against flat too, not just the vmap lane path
                "bf16_lanes3": ("bf16", 3), "fp32_lanes3": ("fp32", 3)}
+    names = [n.strip() for n in args.configs.split(",")]
+    unknown = [n for n in names if n not in all_cfg]
+    if unknown:  # fail BEFORE hours of training, not on the last config
+        p.error(f"unknown config(s) {unknown}; choose from "
+                f"{sorted(all_cfg)}")
     results = []
-    for name in args.configs.split(","):
-        dtype, mode = all_cfg[name.strip()]
+    for name in names:
+        dtype, mode = all_cfg[name]
         print(f"== {name}: dtype={dtype} mode={mode} "
               f"rounds={args.rounds} ==", flush=True)
-        results.append(run_config(name.strip(), dtype, mode, args))
+        results.append(run_config(name, dtype, mode, args))
 
     accs = [r["plateau_acc"] for r in results]
     spread = max(accs) - min(accs)

@@ -25,8 +25,19 @@ def summarize(outdir, tail, tol, min_rounds):
     results = []
     for path in sorted(glob.glob(os.path.join(outdir, "*.jsonl"))):
         name = os.path.splitext(os.path.basename(path))[0]
+        curve = []
         with open(path) as f:
-            curve = [json.loads(ln) for ln in f if ln.strip()]
+            for ln in f:
+                if not ln.strip():
+                    continue
+                try:
+                    curve.append(json.loads(ln))
+                except json.JSONDecodeError:
+                    # a SIGTERM'd run can leave a truncated final line;
+                    # recovering killed runs is this tool's whole job
+                    print(f"# dropping unparseable line in {path}",
+                          file=sys.stderr)
+                    break
         if not curve:
             continue
         accs = [c["train_acc"] for c in curve[-tail:]]

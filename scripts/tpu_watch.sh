@@ -67,8 +67,12 @@ run_step convergence_flagship 28800 python scripts/convergence.py \
 # convergence.py only writes summary.json when ALL configs finish; on a
 # timeout kill the JSONL curves survive -- rebuild the plateau verdict
 # from whatever completed (the tool exists exactly for killed runs).
-run_step convergence_summarize 120 python scripts/convergence_summarize.py \
-  --outdir "$OUT/convergence_flagship"
+# Skip when the run finished: its own summary.json carries wall_s and
+# the full scale record, which the derived variant would drop.
+if [ ! -f "$OUT/convergence_flagship/summary.json" ]; then
+  run_step convergence_summarize 120 python scripts/convergence_summarize.py \
+    --outdir "$OUT/convergence_flagship"
+fi
 
 log "measurement plan complete"
 touch "$OUT/DONE"
