@@ -122,3 +122,20 @@ def test_convergence_summarize_complete_agreeing(tmp_path):
     assert r.returncode == 0, (r.stdout, r.stderr)
     summary = json.loads((tmp_path / "summary.json").read_text())
     assert summary["agree"] is True and summary["all_complete"] is True
+
+
+@pytest.mark.slow
+def test_bench_cpu_smoke():
+    # bench.py is the watcher's top-priority step in a live-tunnel window
+    # (tpu_watch.sh steps 1/1b/5); this proves the whole path -- platform
+    # forcing, the mode-3 MXU-packed rung, the FedOpt server step, and
+    # the one-JSON-line contract -- without the accelerator.
+    r = _run(["bench.py", "--smoke", "--platform", "cpu", "--clients", "4",
+              "--client_chunk", "2", "--batch_size", "16",
+              "--algo", "fedopt", "--mode", "3"], timeout=900)
+    line = [ln for ln in r.stdout.splitlines() if ln.startswith("{")][-1]
+    out = json.loads(line)
+    assert out["value"] > 0, out
+    assert out["vs_baseline"] == 0.0  # CPU numbers are not comparable
+    assert "FedOpt" in out["metric"] and "SMOKE" in out["metric"]
+    assert out["exec_mode"] == "mxu-lanes", out.get("exec_mode")
