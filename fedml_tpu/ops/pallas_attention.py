@@ -285,11 +285,20 @@ def _pad_t(x, pad):
     return jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0))) if pad else x
 
 
+# [B, T, H, D] <-> [B, H, T, D]: self-inverse, used at every kernel boundary
+def _swap_th(x):
+    return jnp.transpose(x, (0, 2, 1, 3))
+
+
 def _double_vmap(fn):
-    """[B, T, H, ...] operands -> per-(batch, head) kernel calls: outer
-    vmap strips batch, inner maps the head axis (axis 1 of the remaining
-    [T, H, ...]) so the kernel sees [T, ...]."""
-    return jax.vmap(jax.vmap(fn, in_axes=1, out_axes=1))
+    """[B, H, T, ...] operands -> per-(batch, head) kernel calls. Both
+    mapped axes are LEADING: on hardware Mosaic turns each vmapped axis
+    into a squeezed block dim, and squeezed dims are only legal outside
+    the trailing two block dims -- vmapping the middle head axis of a
+    [B, T, H, D] array makes the block's last-two dims (Squeezed(H), D),
+    which the TPU lowering rejects (r5 hardware run). Callers transpose
+    to [B, H, T, D] at the boundary instead."""
+    return jax.vmap(jax.vmap(fn))
 
 
 def _require_hw_head_dim(D, interpret):
@@ -312,14 +321,15 @@ def _fa_fwd(q, k, v, causal, scale, block_q, block_k):
     interpret = _use_interpret()
     _require_hw_head_dim(D, interpret)
     bq, bk = min(block_q, Tq), min(block_k, Tk)
-    qp = _pad_t(q, (-Tq) % bq)
-    kp = _pad_t(k, (-Tk) % bk)
-    vp = _pad_t(v, (-Tk) % bk)
+    qp = _swap_th(_pad_t(q, (-Tq) % bq))
+    kp = _swap_th(_pad_t(k, (-Tk) % bk))
+    vp = _swap_th(_pad_t(v, (-Tk) % bk))
     fn = functools.partial(_fwd_one_head, scale=scale_, causal=causal,
                            block_q=bq, block_k=bk, k_len=Tk,
                            interpret=interpret)
     out, lse = _double_vmap(fn)(qp, kp, vp)
-    out, lse = out[:, :Tq], lse[:, :Tq, :, 0]  # drop q padding + lanes
+    out = _swap_th(out)[:, :Tq]                       # back to [B,T,H,D]
+    lse = jnp.transpose(lse[..., 0], (0, 2, 1))[:, :Tq]      # [B,T,H]
     return out, (q, k, v, out, lse)
 
 
@@ -335,17 +345,19 @@ def _fa_bwd(causal, scale, block_q, block_k, res, g):
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     rep = lambda x: jnp.broadcast_to(  # [B, T, H] -> lane-replicated
         x[..., None], x.shape + (_LANES,))
-    qp, dop = _pad_t(q, pad_q), _pad_t(g.astype(q.dtype), pad_q)
-    kp, vp = _pad_t(k, pad_k), _pad_t(v, pad_k)
+    qp = _swap_th(_pad_t(q, pad_q))
+    dop = _swap_th(_pad_t(g.astype(q.dtype), pad_q))
+    kp, vp = _swap_th(_pad_t(k, pad_k)), _swap_th(_pad_t(v, pad_k))
     # padded q rows: dO rows are zero => ds rows are zero => no dk/dv
     # contribution; their dq rows are sliced off below
-    lse_p = _pad_t(rep(lse), pad_q)
-    dl_p = _pad_t(rep(delta), pad_q)
+    lse_p = _swap_th(_pad_t(rep(lse), pad_q))
+    dl_p = _swap_th(_pad_t(rep(delta), pad_q))
     fn = functools.partial(_bwd_one_head, scale=scale_, causal=causal,
                            block_q=bq, block_k=bk, k_len=Tk,
                            interpret=interpret)
     dq, dk, dv = _double_vmap(fn)(qp, kp, vp, dop, lse_p, dl_p)
-    return dq[:, :Tq], dk[:, :Tk], dv[:, :Tk]
+    return (_swap_th(dq)[:, :Tq], _swap_th(dk)[:, :Tk],
+            _swap_th(dv)[:, :Tk])
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)

@@ -8,7 +8,10 @@ tunnel is alive:
     python scripts/hw_smoke_flash.py
 """
 
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp

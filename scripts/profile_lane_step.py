@@ -21,7 +21,18 @@ Timing: value-fetch (jnp.sum -> float) per the axon platform note in
 docs/PERFORMANCE.md -- ``block_until_ready`` does not reliably block
 there; every timed call materializes a scalar on host.
 
-Usage: python scripts/profile_lane_step.py [--repeats 20] [--cpu --tiny]
+Over the axon tunnel a single dispatch+fetch costs tens of ms of RPC
+round-trip -- far more than one device step -- so single-step calls
+measure the tunnel, not the chip (the r5 hardware run timed ablation A
+at 77 ms/call while the engine's fori_loop path measured 2 ms per
+step-batch). ``--inner N`` chains N steps inside ONE jitted call via
+``lax.fori_loop`` (the carry perturbs the params tree by acc*1e-30 so
+XLA cannot hoist the loop-invariant body) and divides by N; the
+``R_dispatch_floor`` row reports the raw per-call RPC cost so the
+residual bias (floor/N per row) is visible.
+
+Usage: python scripts/profile_lane_step.py [--repeats 20] [--inner 50]
+       [--cpu --tiny]
 Prints one json line per ablation + a derived breakdown table.
 """
 
@@ -65,6 +76,10 @@ def timed_interleaved(cases, repeats, warmup=2):
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--repeats", type=int, default=20)
+    p.add_argument("--inner", type=int, default=1,
+                   help="steps chained inside one jitted call (amortizes "
+                        "the per-dispatch RPC floor; reported times are "
+                        "divided by this)")
     p.add_argument("--lanes", type=int, default=8)
     p.add_argument("--batch", type=int, default=64)
     p.add_argument("--cpu", action="store_true",
@@ -73,6 +88,8 @@ def main():
                    help="8x8 images, 2 lanes (CPU sanity shapes)")
     p.add_argument("--fp32", action="store_true")
     args = p.parse_args()
+    if args.inner < 1:
+        p.error("--inner must be >= 1")
     if args.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
@@ -124,7 +141,6 @@ def main():
     flops_step = L * B * RESNET56_TRAIN_FLOPS * (image / 32) ** 2
 
     # --- A: one model, batch L*B (the conv ceiling) ---------------------
-    @jax.jit
     def step_A(p, bs, x, y):
         (l, _), g = jax.value_and_grad(loss_one, has_aux=True)(p, bs, x, y)
         return l + 1e-30 * sum(jnp.sum(t.astype(jnp.float32))
@@ -133,7 +149,6 @@ def main():
     cases["A_one_model_bs512"] = (step_A, (params, batch_stats, x_big, y_big))
 
     # --- B: L vmapped models, per-lane weights (the lane penalty) -------
-    @jax.jit
     def step_B(ps, bss, x, y):
         def one(p, bs, xx, yy):
             (l, _), g = jax.value_and_grad(loss_one, has_aux=True)(
@@ -159,7 +174,6 @@ def main():
             y.reshape(-1)).mean()
         return l, new_bs
 
-    @jax.jit
     def step_B2(ps, bss, x, y):
         (l, _), g = jax.value_and_grad(loss_packed, has_aux=True)(
             ps, bss, x, y)
@@ -173,7 +187,6 @@ def main():
     augment = make_cifar_augment(pad=4 if image >= 32 else 2,
                                  cutout_length=16 if image >= 32 else 4)
 
-    @jax.jit
     def step_C(ps, bss, x, y, key):
         def one(p, bs, xx, yy, k):
             xx = augment(xx, k)
@@ -195,7 +208,6 @@ def main():
     pay0 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32),
                         lane_params)
 
-    @jax.jit
     def step_D(ps, bss, opt_states, pay, x, y, key):
         def one(p, bs, os_, pa, xx, yy, k):
             xx = augment(xx, k)
@@ -234,7 +246,6 @@ def main():
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, y).mean()
 
-    @jax.jit
     def step_E(p, x, y):
         l, g = jax.value_and_grad(loss_eval_bn)(p, x, y)
         return l + 1e-30 * sum(jnp.sum(t.astype(jnp.float32))
@@ -242,7 +253,38 @@ def main():
 
     cases["E_one_model_frozen_bn"] = (step_E, (params, x_big, y_big))
 
+    def finalize(fn):
+        """jit the case; with --inner N, chain N steps in one call via
+        fori_loop. The carry (accumulated loss scalar) perturbs the
+        params tree by acc*1e-30 each iteration, making the body
+        carry-dependent so XLA's LICM cannot hoist it out of the loop;
+        the perturbation itself is numerically irrelevant and costs one
+        elementwise add per leaf."""
+        if args.inner == 1:
+            return jax.jit(fn)
+
+        def run(p0, *rest):
+            def body(_, acc):
+                p = jax.tree.map(
+                    lambda t: t + jnp.asarray(acc, t.dtype) *
+                    jnp.asarray(1e-30, t.dtype), p0)
+                return acc + fn(p, *rest).astype(jnp.float32)
+            return jax.lax.fori_loop(0, args.inner, body, jnp.float32(0.0))
+        return jax.jit(run)
+
+    cases = {name: (finalize(fn), args_)
+             for name, (fn, args_) in cases.items()}
+
+    # R: what one dispatch+fetch costs with ~zero device work -- over the
+    # axon tunnel this RPC floor dwarfs a device step, which is why every
+    # row above amortizes over --inner steps. Always a SINGLE call (never
+    # looped); its raw per-call time is the bias bound floor/N per row.
+    r_x = jnp.ones((8,), jnp.float32)
+    cases["R_dispatch_floor"] = (jax.jit(lambda v: jnp.sum(v)), (r_x,))
+
     results = timed_interleaved(cases, args.repeats)
+    rtt = results.pop("R_dispatch_floor")
+    results = {k: v / args.inner for k, v in results.items()}
 
     from bench import peak_flops  # device-aware peak, single source
     peak = peak_flops(dev)
@@ -252,6 +294,9 @@ def main():
                      "tflops": round(flops_step / sec / 1e12, 2),
                      "mfu": round(flops_step / sec / peak, 4)}
         print(json.dumps({name: out[name]}), flush=True)
+    print(json.dumps({"R_dispatch_floor": {
+        "s_per_call": round(rtt, 5), "inner": args.inner,
+        "per_row_bias_ms": round(rtt / args.inner * 1e3, 3)}}), flush=True)
 
     a, b = results["A_one_model_bs512"], results["B_vmap_lanes"]
     c, d = results["C_plus_augment"], results["D_full_lane_body"]
