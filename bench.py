@@ -150,7 +150,8 @@ def build_api(args, epochs, client_chunk, wave_mode):
             pad=4 if image >= 32 else 2,
             cutout_length=16 if image >= 32 else 4)
     spec = make_classification_spec(model, jnp.zeros((1, image, image, 3)),
-                                    augment_fn=augment_fn)
+                                    augment_fn=augment_fn,
+                                    lane_lowering=args.lane_lowering)
     run_args = types.SimpleNamespace(
         client_num_in_total=args.clients, client_num_per_round=args.clients,
         comm_round=10 ** 9, epochs=epochs, batch_size=args.batch_size,
@@ -231,6 +232,14 @@ def main():
                    help="fail hard instead of walking the degrade ladder")
     p.add_argument("--no_augment", action="store_true",
                    help="drop the recipe's crop/flip/Cutout augmentation")
+    p.add_argument("--lane_lowering", default=None,
+                   choices=("auto", "blockdiag", "bgc"),
+                   help="mode-3 per-lane conv strategy "
+                        "(models/lane_packed.py): blockdiag (default, "
+                        "behind the committed 114.5 rph number); "
+                        "bgc = zero-redundancy batch-group convs "
+                        "everywhere; auto = bgc for Ci<=32 stages, "
+                        "block-diagonal for Ci=64")
     p.add_argument("--device_dtype", type=str, default=None,
                    choices=("bf16", "bfloat16"),
                    help="halve the HBM residency of the data")

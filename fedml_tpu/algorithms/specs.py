@@ -55,7 +55,7 @@ def _init_state(model, example_x, rng):
 
 def make_classification_spec(model, example_x, num_classes=None,
                              name="classification", augment_fn=None,
-                             aux_loss_weight=0.01):
+                             aux_loss_weight=0.01, lane_lowering=None):
     """Softmax cross-entropy classification over ``[B, C]`` logits.
 
     Applying log_softmax to whatever the model emits reproduces the reference
@@ -99,9 +99,14 @@ def make_classification_spec(model, example_x, num_classes=None,
     # model-agnostic
     from fedml_tpu.models.lane_packed import builder_for
 
+    if lane_lowering not in (None, "blockdiag", "bgc", "auto"):
+        # fail at the API boundary, not hours later at lane setup
+        raise ValueError(f"unknown lane_lowering {lane_lowering!r}; "
+                         "choose blockdiag, bgc or auto")
     return TrainSpec(init_fn=init_fn, loss_fn=loss_fn, metrics_fn=metrics_fn,
                      name=name, augment_fn=augment_fn,
-                     lane_loss_builder=builder_for(model))
+                     lane_loss_builder=builder_for(
+                         model, lowering=lane_lowering))
 
 
 def make_seq_classification_spec(model, example_x, ignore_index=0,

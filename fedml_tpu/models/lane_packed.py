@@ -71,19 +71,58 @@ def _lanes_per_group(L, ci, min_k=MXU_K):
     return g
 
 
+#: per-conv strategy threshold for ``lowering="auto"``: the r5 TPU
+#: shoot-out (``scripts/bench_lane_conv.py``, lane_conv_shootout2)
+#: measured the batch-group conv ~2-3x faster than the block-diagonal
+#: embedding at Ci<=32 (where block-diag burns 8x/4x redundant FLOPs)
+#: and slower at Ci=64 (2x redundancy, where full-tile block-diag wins).
+BGC_MAX_CI = 32
+
+
+def merged_to_stacked(x, L):
+    """``[B, H, W, L*C] -> [L*B, H, W, C]`` (batch-stacked lanes)."""
+    B, H, W, LC = x.shape
+    return lane_unmerge(x, L).reshape(L * B, H, W, LC // L)
+
+
+def lane_conv_bgc(x, w, L, strides=(1, 1), padding=((1, 1), (1, 1))):
+    """Per-lane conv via ``batch_group_count=L``: ZERO FLOP redundancy.
+
+    ``x``: ``[L*B, H, W, Ci]`` batch-stacked (lane-major batch);
+    ``w``: ``[L, kh, kw, Ci, Co]``. Returns **merged** ``[B, H', W',
+    L*Co]`` -- XLA's batch-group conv writes feature group ``l`` from
+    batch group ``l``, which IS the lane-major merged channel layout the
+    rest of the packed pipeline (BN/relu/residual/head) runs on.
+    """
+    _, kh, kw, ci, co = w.shape
+    rhs = jnp.transpose(w, (1, 2, 3, 0, 4)).reshape(kh, kw, ci, L * co)
+    return jax.lax.conv_general_dilated(
+        x, rhs, window_strides=strides, padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"), batch_group_count=L)
+
+
 def lane_conv(x, w, L, strides=(1, 1), padding=((1, 1), (1, 1)),
-              min_k=MXU_K):
+              min_k=MXU_K, strategy="blockdiag"):
     """Per-lane conv over merged activations.
 
     ``x``: ``[B, H, W, L*Ci]`` lane-major; ``w``: ``[L, kh, kw, Ci, Co]``
     per-lane HWIO kernels. Returns ``[B, H', W', L*Co]``.
 
-    Lowering: ``g`` lanes merge per group (``g*Ci ~ 128``); the group's
-    weights are the g x g block-diagonal embedding of the lanes' kernels,
-    so the grouped conv computes exactly the per-lane convs -- on full
-    MXU K-tiles instead of ``Ci``-wide ones.
+    ``strategy="blockdiag"``: ``g`` lanes merge per group (``g*Ci ~
+    128``); the group's weights are the g x g block-diagonal embedding
+    of the lanes' kernels, so the grouped conv computes exactly the
+    per-lane convs -- on full MXU K-tiles instead of ``Ci``-wide ones
+    (g x redundant FLOPs riding otherwise-idle tiles).
+
+    ``strategy="bgc"``: re-stack lanes into the batch (one transpose)
+    and run the zero-redundancy ``batch_group_count`` conv
+    (:func:`lane_conv_bgc`) -- measured faster at Ci<=32 where
+    block-diag redundancy is 8x/4x (r5 shoot-out).
     """
     _, kh, kw, ci, co = w.shape
+    if strategy == "bgc":
+        return lane_conv_bgc(merged_to_stacked(x, L), w, L,
+                             strides=strides, padding=padding)
     g = _lanes_per_group(L, ci, min_k)
     G = L // g
     wg = w.reshape(G, g, kh, kw, ci, co)
@@ -132,7 +171,7 @@ def lane_bn(x, p, ra, L, train, dtype):
     return y.astype(dtype), new_ra
 
 
-def make_lane_packed_apply(model, L: int):
+def make_lane_packed_apply(model, L: int, lowering: str = "blockdiag"):
     """Build the packed apply for ``L`` lanes of a supported model.
 
     Returns ``apply_fn(stacked_vars, x, train) -> (logits, new_stats)``
@@ -141,6 +180,12 @@ def make_lane_packed_apply(model, L: int):
     carries), ``x`` is ``[L, B, ...]``, ``logits`` ``[L, B, classes]``
     and ``new_stats`` is the lane-stacked batch_stats pytree (``{}`` for
     stat-free families).
+
+    ``lowering`` selects the per-lane conv strategy (CifarResNet only):
+    ``"blockdiag"`` everywhere, ``"bgc"`` everywhere, or ``"auto"`` --
+    per conv by input channel count (:data:`BGC_MAX_CI`): the measured
+    optimum is batch-group convs for the narrow stages (Ci<=32) and the
+    block-diagonal embedding for the wide one (Ci=64).
 
     Supported families: :class:`CifarResNet` (the ResNet-56 flagship)
     and :class:`CNNOriginalFedAvg` (the FedAvg-paper FEMNIST CNN, whose
@@ -154,6 +199,8 @@ def make_lane_packed_apply(model, L: int):
             f"lane-packed apply supports "
             f"{', '.join(c.__name__ for c in PACKED_FAMILIES)}, "
             f"got {type(model).__name__}")
+    if lowering not in ("blockdiag", "bgc", "auto"):
+        raise ValueError(f"unknown lane lowering {lowering!r}")
     n = (model.depth - 2) // 6
     dtype = model.dtype
 
@@ -166,7 +213,12 @@ def make_lane_packed_apply(model, L: int):
             del name
             s = (strides, strides)
             pad = ((padding, padding), (padding, padding))
-            return lane_conv(xin, w.astype(dtype), L, strides=s, padding=pad)
+            ci = w.shape[-2]
+            strat = ("bgc" if lowering == "bgc"
+                     or (lowering == "auto" and ci <= BGC_MAX_CI)
+                     else "blockdiag")
+            return lane_conv(xin, w.astype(dtype), L, strides=s, padding=pad,
+                             strategy=strat)
 
         def bn(name, xin):
             y, ra = lane_bn(xin, p[name], bs[name], L, train, dtype)
@@ -249,7 +301,7 @@ def _make_cnn_apply(model: CNNOriginalFedAvg, L: int):
     return apply_fn
 
 
-def make_lane_loss_builder(model, augment_fn=None):
+def make_lane_loss_builder(model, augment_fn=None, lowering="blockdiag"):
     """TrainSpec ``lane_loss_builder`` for classification over any
     :data:`PACKED_FAMILIES` model (see ``core/trainer.py``): called with
     the lane count, returns ``lane_loss_fn(stacked_state, batch,
@@ -266,7 +318,9 @@ def make_lane_loss_builder(model, augment_fn=None):
     del augment_fn  # augmentation stays in the engine body (per-lane vmap)
 
     def builder(L):
-        packed_apply = make_lane_packed_apply(model, L)
+        packed_apply = (make_lane_packed_apply(model, L, lowering)
+                        if isinstance(model, CifarResNet)
+                        else make_lane_packed_apply(model, L))
 
         def lane_loss_fn(stacked_state, batch, rng, train):
             del rng  # no PACKED_FAMILIES model uses dropout rngs
@@ -298,15 +352,20 @@ def make_lane_loss_builder(model, augment_fn=None):
 PACKED_FAMILIES = (CifarResNet, CNNOriginalFedAvg)
 
 
-def builder_for(model):
+def builder_for(model, lowering=None):
     """Registry: the packed-lowering ``lane_loss_builder`` for a model
     instance, or None when the family has no lane-packed apply. Spec
-    builders call this instead of type-checking models themselves."""
+    builders call this instead of type-checking models themselves.
+    ``lowering`` overrides the conv strategy (default ``"blockdiag"``,
+    the lowering behind the measured 114.5 rph flagship number; the r5
+    per-layer shoot-out puts ``bgc`` within noise of it, so the default
+    only moves on a full-model A/B win)."""
     if isinstance(model, PACKED_FAMILIES):
-        return make_lane_loss_builder(model)
+        return make_lane_loss_builder(
+            model, lowering=lowering or "blockdiag")
     return None
 
 
-__all__ = ["lane_merge", "lane_unmerge", "lane_conv", "lane_bn",
-           "make_lane_packed_apply", "make_lane_loss_builder",
-           "builder_for", "MXU_K"]
+__all__ = ["lane_merge", "lane_unmerge", "merged_to_stacked", "lane_conv",
+           "lane_conv_bgc", "lane_bn", "make_lane_packed_apply",
+           "make_lane_loss_builder", "builder_for", "MXU_K", "BGC_MAX_CI"]

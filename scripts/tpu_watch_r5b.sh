@@ -1,0 +1,72 @@
+#!/bin/bash
+# Round-5 second-window watcher. The first r5 window (2026-07-31) ran
+# the full VERDICT plan (bench 114.5 rph, A-E breakdown, bench_lm,
+# hw_smoke_flash, fedopt 114.1 rph) and half of the lane-conv lowering
+# shoot-out before the tunnel wedged mid-run. This watcher grabs the
+# NEXT window for what remains, in value order:
+#   1. finish the per-layer lowering shoot-out (s2/s3 + shared floor)
+#   2. full-model A/B of the mode-3 conv lowerings (the bench default
+#      only moves on a full-model win, models/lane_packed.py builder_for)
+#   3. flagship long-horizon convergence (VERDICT r4 next #7) on the
+#      packed lowering, both precisions
+# The CPU convergence matrix (no TPU needed) keeps running throughout,
+# EXCEPT during the timing-sensitive steps 1-2, where it is SIGSTOPped
+# so the 1-core host doesn't inflate measured round times.
+set -u
+cd "$(dirname "$0")/.."
+OUT=bench_results/r05_measured
+mkdir -p "$OUT"
+log() { echo "$(date -u +%FT%TZ) $*" >> "$OUT/watch_r5b.log"; }
+
+log "watcher started (pid $$)"
+# never overlap the TPU with a prior stuck measurement process
+while pgrep -f "scripts/bench_lane_conv.py" > /dev/null; do
+  log "prior shoot-out process still holds the device; sleeping 120s"
+  sleep 120
+done
+while true; do
+  if timeout 300 python -c "import jax; print(jax.devices()[0])" \
+      > "$OUT/probe_r5b.log" 2>&1; then
+    log "tunnel ALIVE: $(tail -1 "$OUT/probe_r5b.log")"
+    break
+  fi
+  log "probe dead/timeout; sleeping 120s"
+  sleep 120
+done
+
+cpu_matrix_stop() { pkill -STOP -f "convergence.py --outdir bench_results/convergence_cpu" && log "CPU matrix paused" || true; }
+cpu_matrix_cont() { pkill -CONT -f "convergence.py --outdir bench_results/convergence_cpu" && log "CPU matrix resumed" || true; }
+
+run_step() {  # run_step <name> <timeout_s> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  log "START $name: $*"
+  timeout "$tmo" "$@" > "$OUT/$name.out" 2> "$OUT/$name.err"
+  local rc=$?
+  log "DONE $name rc=$rc"
+  return $rc
+}
+
+cpu_matrix_stop
+# 1. finish the per-layer shoot-out (compile cache makes the redone s1
+#    rows cheap; medians of 8, floor-subtracted)
+run_step lane_conv_shootout3 5400 python scripts/bench_lane_conv.py \
+  --inner 200 --repeats 8
+# 2. full-model A/B at the flagship shapes: the two candidate lowerings
+#    vs the committed blockdiag 114.49 rph (fedavg_mode3_bf16.json)
+run_step bench_bgc 5400 python bench.py --lane_lowering bgc
+run_step bench_auto 5400 python bench.py --lane_lowering auto
+cpu_matrix_cont
+
+# 3. flagship long-horizon curves through the packed engine (the only
+#    place lanes3 horizon evidence can come from -- docs/PERFORMANCE.md)
+run_step convergence_flagship 28800 python scripts/convergence.py \
+  --flagship --platform default --rounds 100 \
+  --configs bf16_lanes3,fp32_lanes3 \
+  --outdir "$OUT/convergence_flagship"
+if [ ! -f "$OUT/convergence_flagship/summary.json" ]; then
+  run_step convergence_summarize 120 python scripts/convergence_summarize.py \
+    --outdir "$OUT/convergence_flagship"
+fi
+
+log "second-window plan complete"
+touch "$OUT/DONE_r5b"

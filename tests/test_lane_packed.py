@@ -57,8 +57,9 @@ def test_merge_unmerge_roundtrip():
         np.asarray(lane_unmerge(lane_merge(x), 3)), np.asarray(x))
 
 
+@pytest.mark.parametrize("lowering", ["blockdiag", "bgc", "auto"])
 @pytest.mark.parametrize("train", [False, True])
-def test_packed_apply_matches_vmap(train):
+def test_packed_apply_matches_vmap(train, lowering):
     L, B, H = 4, 8, 16
     model = CifarResNet(depth=8, num_classes=10)  # has downsample blocks
     stacked = _stacked_params(model, L, H)
@@ -72,7 +73,7 @@ def test_packed_apply_matches_vmap(train):
         return model.apply(v, xx, train=False), v["batch_stats"]
 
     ref_logits, ref_bs = jax.vmap(one)(stacked, x)
-    packed = make_lane_packed_apply(model, L)
+    packed = make_lane_packed_apply(model, L, lowering)
     got_logits, got_bs = packed(stacked, x, train=train)
     np.testing.assert_allclose(np.asarray(got_logits),
                                np.asarray(ref_logits), atol=1e-5)
@@ -80,7 +81,8 @@ def test_packed_apply_matches_vmap(train):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
-def test_packed_grads_match_vmap():
+@pytest.mark.parametrize("lowering", ["blockdiag", "bgc", "auto"])
+def test_packed_grads_match_vmap(lowering):
     import optax
 
     L, B, H = 4, 4, 8
@@ -88,7 +90,7 @@ def test_packed_grads_match_vmap():
     stacked = _stacked_params(model, L, H, seed=3)
     x = jax.random.normal(jax.random.PRNGKey(4), (L, B, H, H, 3))
     y = jax.random.randint(jax.random.PRNGKey(5), (L, B), 0, 10)
-    packed = make_lane_packed_apply(model, L)
+    packed = make_lane_packed_apply(model, L, lowering)
 
     def ref_loss(p):
         def per_lane(v, xx, yy):
@@ -230,7 +232,14 @@ def test_sharded_packed_lanes_equal_flat():
                 "y": rnd.integers(0, 10, n).astype(np.int64)}
                for n in sizes]
     model = CifarResNet(depth=8, num_classes=10)
-    spec = make_classification_spec(model, jnp.zeros((1, 8, 8, 3)))
+    # blockdiag pinned: this oracle checks the SHARDING machinery
+    # (shard_map + psum vs flat), so the conv lowering is held to the
+    # one whose contraction order matches the flat reference exactly;
+    # bgc/auto lowering equivalence is covered at 1e-5 by the
+    # apply/grads oracles above (BN amplifies their ~1e-6 conv
+    # reassociation into run-varying 1e-4-scale param diffs here).
+    spec = make_classification_spec(model, jnp.zeros((1, 8, 8, 3)),
+                                    lane_lowering="blockdiag")
     state = spec.init_fn(jax.random.PRNGKey(0))
     cfg = ClientUpdateConfig(optimizer="sgd", lr=0.1)
     stacked = stack_clients(clients)
