@@ -139,3 +139,36 @@ def test_bench_cpu_smoke():
     assert out["vs_baseline"] == 0.0  # CPU numbers are not comparable
     assert "FedOpt" in out["metric"] and "SMOKE" in out["metric"]
     assert out["exec_mode"] == "mxu-lanes", out.get("exec_mode")
+
+
+@pytest.mark.slow
+def test_bench_gkt_smoke():
+    # VERDICT r4 weak #8: the split/distill path's perf harness must not
+    # rot before its tunnel window
+    r = _run(["scripts/bench_gkt.py", "--cpu", "--tiny", "--rounds", "1"])
+    lines = [json.loads(ln) for ln in r.stdout.splitlines()
+             if ln.startswith("{")]
+    assert lines, r.stdout[-2000:]
+    rec = lines[-1]
+    for k in ("metric", "value", "unit", "rounds_per_hour"):
+        assert k in rec, rec
+    assert rec["value"] > 0
+
+
+@pytest.mark.slow
+def test_bench_lane_conv_smoke():
+    # the lowering shoot-out harness (scripts/bench_lane_conv.py): tiny
+    # single-stage matrix incl. the numerics gate over every candidate
+    r = _run(["scripts/bench_lane_conv.py", "--cpu", "--tiny"])
+    lines = [json.loads(ln) for ln in r.stdout.splitlines()
+             if ln.startswith("{")]
+    errors = [ln for ln in lines if "ERROR" in ln or "SKIP" in ln]
+    assert not errors, errors  # a rotted candidate hides behind fwd-only
+    done = {(ln["cand"], ln["pass"]) for ln in lines
+            if "cand" in ln and "ms" in ln}
+    # every candidate must survive the numerics gate and time BOTH
+    # passes -- the gradient path is the one the shoot-out exists for
+    for cand in ("vmap", "packed", "packed_all", "bgc", "im2col",
+                 "shared"):
+        assert (cand, "fwd") in done and (cand, "fwd+bwd") in done, (
+            cand, done)
