@@ -32,6 +32,17 @@ the measured config is not the flagship recipe.
 
 Usage: python bench.py [--smoke] [--rounds N] [--epochs E] [--flat]
 Prints one JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+Compression tools (CPU-only, no accelerator needed; see
+docs/COMPRESSION.md):
+  python bench.py --compression_sweep [--sweep_model resnet56|cnn]
+      one JSON line per compressor spec: encoded bytes, ratio vs the raw
+      binary codec AND vs the legacy JSON-list path, encode/decode
+      latency.
+  python bench.py --check
+      size-regression gate: binary framing of an UNCOMPRESSED
+      ResNet-sized pytree must stay >= 5x smaller than the JSON-list
+      path (exit 1 on regression).
 """
 
 import argparse
@@ -209,6 +220,88 @@ def measure(args, epochs, client_chunk, wave_mode):
     }
 
 
+def _sweep_params(model_name):
+    """Model-shaped ``params`` pytree on CPU (shapes are what matter)."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu import models
+    from fedml_tpu.algorithms.specs import make_classification_spec
+
+    if model_name == "cnn":
+        model = models.CNNOriginalFedAvg(only_digits=True)
+        example = jnp.zeros((1, 28, 28, 1))
+    else:
+        model = models.resnet56(class_num=10)
+        example = jnp.zeros((1, 32, 32, 3))
+    spec = make_classification_spec(model, example)
+    state = spec.init_fn(jax.random.PRNGKey(0))
+    return state["params"]
+
+
+def _json_list_nbytes(params):
+    """Byte cost of the legacy JSON nested-list codec for this pytree."""
+    import jax
+    from fedml_tpu.core.message import params_to_lists
+    return len(json.dumps(params_to_lists(
+        jax.tree.map(np.asarray, params))).encode())
+
+
+def run_compression_tools(args):
+    """``--compression_sweep`` / ``--check``: host-side codec measurements
+    (one JSON line each; returns a process exit code)."""
+    import jax
+
+    from fedml_tpu.compression import (encode_tree, decode_tree,
+                                       get_compressor, tree_wire_nbytes)
+
+    params = _sweep_params(args.sweep_model)
+    n_params = sum(int(np.prod(x.shape))
+                   for x in jax.tree.leaves(params))
+    raw_binary = tree_wire_nbytes(jax.tree.map(np.asarray, params))
+    json_bytes = _json_list_nbytes(params)
+
+    if args.check:
+        ratio = json_bytes / raw_binary
+        ok = ratio >= 5.0
+        print(json.dumps({
+            "metric": "codec size regression (none codec vs JSON lists, "
+                      f"{args.sweep_model}-sized pytree)",
+            "n_params": n_params, "json_list_bytes": json_bytes,
+            "binary_bytes": raw_binary, "ratio": round(ratio, 2),
+            "threshold": 5.0, "pass": ok}))
+        return 0 if ok else 1
+
+    rng = jax.random.PRNGKey(0)
+    for spec_str in args.compressors.split(","):
+        spec_str = spec_str.strip()
+        comp = get_compressor(spec_str)
+        compress = jax.jit(lambda t, r, c=comp: c.compress(t, r))
+        decompress = jax.jit(lambda e, c=comp: c.decompress(e, params))
+        enc = jax.block_until_ready(compress(params, rng))  # compile
+        jax.block_until_ready(decompress(enc))
+        enc_t, dec_t = [], []
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            enc = jax.block_until_ready(compress(params, rng))
+            enc_t.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            jax.block_until_ready(decompress(enc))
+            dec_t.append(time.perf_counter() - t0)
+        wire = encode_tree(jax.tree.map(np.asarray, enc))
+        decode_tree(wire)  # the host decode path stays exercised
+        print(json.dumps({
+            "compressor": spec_str, "model": args.sweep_model,
+            "n_params": n_params, "encoded_bytes": len(wire),
+            "raw_binary_bytes": raw_binary, "json_list_bytes": json_bytes,
+            "ratio_vs_binary": round(raw_binary / len(wire), 2),
+            "ratio_vs_json": round(json_bytes / len(wire), 2),
+            "encode_ms": round(1e3 * float(np.median(enc_t)), 2),
+            "decode_ms": round(1e3 * float(np.median(dec_t)), 2)}),
+            flush=True)
+    return 0
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--smoke", action="store_true",
@@ -249,6 +342,22 @@ def main():
                    help="fedopt = same engine/shapes with a server-Adam "
                         "step on the pseudo-gradient (second bench line; "
                         "vs_baseline stays tied to the FedAvg baseline)")
+    p.add_argument("--compression_sweep", action="store_true",
+                   help="measure each --compressors spec on a "
+                        "--sweep_model pytree (encoded bytes + "
+                        "encode/decode latency; CPU, no accelerator)")
+    p.add_argument("--check", action="store_true",
+                   help="size-regression gate: binary none-codec framing "
+                        "must be >=5x smaller than the JSON-list path for "
+                        "a ResNet-sized pytree (exit 1 on regression)")
+    p.add_argument("--sweep_model", choices=("resnet56", "cnn"),
+                   default="resnet56")
+    p.add_argument("--compressors", type=str,
+                   default="none,topk:0.01,topk:0.1,randk:0.1,qsgd:8,"
+                           "signsgd",
+                   help="comma-separated specs for --compression_sweep")
+    p.add_argument("--repeats", type=int, default=5,
+                   help="timing repeats per spec in --compression_sweep")
     p.add_argument("--platform", choices=("default", "cpu"),
                    default="default",
                    help="cpu forces the host platform via jax.config (the "
@@ -257,6 +366,13 @@ def main():
                         "tunnel dead; numbers from it are not "
                         "baseline-comparable")
     args = p.parse_args()
+
+    if args.compression_sweep or args.check:
+        # host-side codec measurements: never touch the accelerator (the
+        # tunnel can be dead and these must still run in CI)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        sys.exit(run_compression_tools(args))
 
     if args.algo == "fedopt":
         global _FAILURE_METRIC

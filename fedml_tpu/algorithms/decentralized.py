@@ -42,7 +42,7 @@ class DecentralizedFedAPI:
     on directed graphs."""
 
     def __init__(self, dataset, spec, args, topology=None, algorithm="dsgd",
-                 metrics_logger=None):
+                 metrics_logger=None, compressor=None):
         (self.train_data_num, _, self.train_data_global, self.test_data_global,
          _, self.train_data_local_dict, self.test_data_local_dict,
          self.class_num) = dataset
@@ -72,11 +72,41 @@ class DecentralizedFedAPI:
             momentum=getattr(args, "momentum", 0.0))
         client_update = make_client_update(spec, cfg)
 
-        def round_fn(stacked_states, pushsum_w, cohort_data, W, rng):
+        from fedml_tpu.compression import get_compressor
+        self.compressor = get_compressor(
+            compressor if compressor is not None
+            else getattr(args, "compressor", None))
+        compressor_ = self.compressor
+
+        def round_fn(stacked_states, pushsum_w, residuals, cohort_data, W,
+                     rng):
             N = cohort_data["mask"].shape[0]
             rngs = jax.random.split(rng, N)
             local_states, aux, metrics = jax.vmap(client_update)(
                 stacked_states, cohort_data, rngs)
+            if compressor_ is not None:
+                # each node gossips its COMPRESSED params update (delta
+                # from its pre-round state) with per-node error feedback --
+                # what a bandwidth-limited peer link would deliver; mixing
+                # then runs on the reconstructed states. Only ``params``
+                # is compressed: batch_stats/other state is small and
+                # bias-sensitive (a sign-compressed variance delta can go
+                # negative), same split as the FedAvg compressed round.
+                from fedml_tpu.compression.compressors import ErrorFeedback
+                from fedml_tpu.core import pytree as ptu
+                ef = ErrorFeedback(compressor_)
+                crngs = jax.random.split(jax.random.fold_in(rng, 1), N)
+
+                def compress_one(prev, local, res, crng):
+                    delta = ptu.tree_sub(local["params"], prev["params"])
+                    _, dec, new_res = ef.step(delta, res, prev["params"],
+                                              crng)
+                    recon = dict(local)
+                    recon["params"] = ptu.tree_add(prev["params"], dec)
+                    return recon, new_res
+
+                local_states, residuals = jax.vmap(compress_one)(
+                    stacked_states, local_states, residuals, crngs)
             if self.algorithm == "pushsum":
                 # gossip (w_j * x_j, w_j) along columns, then de-bias
                 weighted = jax.tree.map(
@@ -87,9 +117,9 @@ class DecentralizedFedAPI:
                 new_states = jax.tree.map(
                     lambda x: x / new_w.reshape((-1,) + (1,) * (x.ndim - 1)),
                     mixed)
-                return new_states, new_w, metrics
+                return new_states, new_w, residuals, metrics
             mixed = mix_states(local_states, W)
-            return mixed, pushsum_w, metrics
+            return mixed, pushsum_w, residuals, metrics
 
         self._round_fn = jax.jit(round_fn)
 
@@ -98,6 +128,12 @@ class DecentralizedFedAPI:
         # all nodes start from the same init (reference broadcasts rank 0 init)
         self.states = jax.tree.map(
             lambda x: jnp.broadcast_to(x, (self.n_nodes,) + x.shape), init)
+        # per-node error-feedback residuals over params only (what gets
+        # compressed); uncompressed runs thread an empty pytree instead of
+        # a second copy of node state (the compressor is fixed at trace
+        # time, so the branch is static)
+        self.residuals = (jax.tree.map(jnp.zeros_like, self.states["params"])
+                          if self.compressor is not None else {})
         self.pushsum_w = jnp.ones((self.n_nodes,), jnp.float32)
         self._data_rng = np.random.default_rng(getattr(args, "seed", 0))
         self.round_idx = 0
@@ -108,12 +144,29 @@ class DecentralizedFedAPI:
             [self.train_data_local_dict[i] for i in range(self.n_nodes)],
             self.args.batch_size, self.args.epochs, rng=self._data_rng)
         self.rng, rng = jax.random.split(self.rng)
-        self.states, self.pushsum_w, metrics = self._round_fn(
-            self.states, self.pushsum_w, packed, self.W, rng)
+        self.states, self.pushsum_w, self.residuals, metrics = self._round_fn(
+            self.states, self.pushsum_w, self.residuals, packed, self.W, rng)
         m = jax.tree.map(np.asarray, metrics)
         out = {"round": self.round_idx,
                "Train/Loss": float(m["loss_sum"].sum() / max(m["count"].sum(), 1)),
                "Train/Acc": float(m["correct"].sum() / max(m["count"].sum(), 1))}
+        if self.compressor is not None:
+            from fedml_tpu.compression import (compressed_payload_nbytes,
+                                               raw_payload_nbytes)
+            if not hasattr(self, "_payload_bytes"):
+                node0 = jax.tree.map(lambda x: x[0], self.states)
+                rest = {k: v for k, v in node0.items() if k != "params"}
+                # compressed params + any uncompressed non-params state
+                # (batch_stats etc. gossip at full fidelity)
+                self._payload_bytes = compressed_payload_nbytes(
+                    self.compressor, node0["params"]) + (
+                        raw_payload_nbytes(rest) if rest else 0)
+                self._raw_payload_bytes = raw_payload_nbytes(node0)
+            # each node ships one compressed update to its out-neighbors;
+            # count one send per node (broadcast links dedupe per edge)
+            out["bytes_on_wire"] = self._payload_bytes * self.n_nodes
+            out["compression_ratio"] = round(
+                self._raw_payload_bytes / self._payload_bytes, 3)
         self.round_idx += 1
         self.history.append(out)
         self.metrics_logger(out)

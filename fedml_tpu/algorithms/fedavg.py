@@ -52,11 +52,18 @@ class FedAvgAPI:
       mesh: optional jax Mesh -- enables the sharded round path.
       payload_fn / server_fn / server_state: aggregator hooks for algorithm
         variants (FedOpt, FedNova, robust FedAvg) built on this same loop.
+      compressor: client-update compression spec (``"topk:0.01"``,
+        ``"qsgd:8"``, ``"signsgd"``, ... -- ``fedml_tpu.compression``) or a
+        Compressor instance; defaults to ``args.compressor``. Runs the
+        compressed round with per-client error-feedback residuals and logs
+        ``bytes_on_wire`` / ``compression_ratio`` per round. Simulation
+        path only: on a mesh, aggregation is ICI collectives where the
+        wire bottleneck this models does not exist.
     """
 
     def __init__(self, dataset, spec: TrainSpec, args, mesh=None,
                  payload_fn=None, server_fn=None, server_state=None,
-                 metrics_logger=None):
+                 metrics_logger=None, compressor=None):
         (self.train_data_num, self.test_data_num, self.train_data_global,
          self.test_data_global, self.train_data_local_num_dict,
          self.train_data_local_dict, self.test_data_local_dict,
@@ -73,8 +80,23 @@ class FedAvgAPI:
             momentum=getattr(args, "momentum", 0.0),
             grad_clip=getattr(args, "grad_clip", None))
         self.cfg = cfg
+        from fedml_tpu.compression import get_compressor
+        self.compressor = get_compressor(
+            compressor if compressor is not None
+            else getattr(args, "compressor", None))
+        if self.compressor is not None and mesh is not None:
+            raise ValueError(
+                "compressor= applies to the single-chip simulation and the "
+                "distributed control-plane paths; mesh rounds aggregate "
+                "over ICI collectives, where the wire bottleneck being "
+                "compressed does not exist")
+        self.compressed_round_fn = None
         if mesh is None:
             self.round_fn = make_sim_round(spec, cfg, payload_fn, server_fn)
+            if self.compressor is not None:
+                from fedml_tpu.compression import make_compressed_sim_round
+                self.compressed_round_fn = make_compressed_sim_round(
+                    spec, cfg, self.compressor, payload_fn, server_fn)
         else:
             self.round_fn = make_sharded_round(spec, cfg, mesh, payload_fn,
                                                server_fn)
@@ -90,11 +112,14 @@ class FedAvgAPI:
             device_resident = False
         chunk = getattr(args, "client_chunk", 8) or 8
         # stacking copies the whole dataset host-side: only do it for the
-        # paths that will consume it (single-chip residency, or mesh lanes)
+        # paths that will consume it (single-chip residency, or mesh lanes);
+        # compressed rounds thread EF residuals, which only the packed-
+        # cohort round function does -- residency is bypassed there
         wants_residency = (mesh is None
                            or int(getattr(args, "wave_mode", 1)) in (2, 3))
         stacked = (self._stack_if_fits(args)
-                   if device_resident and wants_residency else None)
+                   if device_resident and wants_residency
+                   and self.compressor is None else None)
         self.packed_lane_runner = None
         if stacked is not None and mesh is None:
             import jax.numpy as jnp
@@ -143,6 +168,24 @@ class FedAvgAPI:
         self._data_rng = np.random.default_rng(seed)
         self.round_idx = 0
         self.history = []
+
+        if self.compressed_round_fn is not None:
+            import jax.numpy as jnp
+            from fedml_tpu.compression import (compressed_payload_nbytes,
+                                               raw_payload_nbytes)
+            # error-feedback residual per client IN TOTAL, carried across
+            # rounds (clients keep their own accumulator between the rounds
+            # they are sampled into -- DGC/EF-SignSGD semantics)
+            C_total = len(self.train_data_local_dict)
+            self._ef_residuals = jax.tree.map(
+                lambda x: jnp.zeros((C_total,) + x.shape, x.dtype),
+                self.global_state["params"])
+            # on-wire cost per client update: static given the template, so
+            # computed once from abstract shapes (nothing runs on device)
+            self._payload_bytes = compressed_payload_nbytes(
+                self.compressor, self.global_state["params"])
+            self._raw_payload_bytes = raw_payload_nbytes(
+                self.global_state["params"])
 
     def _stack_if_fits(self, args):
         """Stack every client's padded shard for HBM residency when the
@@ -232,6 +275,19 @@ class FedAvgAPI:
                 (self.global_state, self.server_state,
                  info) = self.indexed_round_fn(
                     self.global_state, self.server_state, dd, sched, round_rng)
+        elif self.compressed_round_fn is not None:
+            import jax.numpy as jnp
+            client_indexes, packed = self._cohort(self.round_idx)
+            sel = jnp.asarray(np.asarray(client_indexes, np.int32))
+            cohort_res = jax.tree.map(lambda x: x[sel], self._ef_residuals)
+            (self.global_state, self.server_state, new_res,
+             info) = self.compressed_round_fn(
+                self.global_state, self.server_state, packed, cohort_res,
+                round_rng)
+            self._ef_residuals = jax.tree.map(
+                lambda full, upd: full.at[sel].set(upd),
+                self._ef_residuals, new_res)
+            self._last_cohort_size = len(client_indexes)
         else:
             _, packed = self._cohort(self.round_idx)
             self.global_state, self.server_state, info = self.round_fn(
@@ -247,6 +303,17 @@ class FedAvgAPI:
             "Train/Acc": float(m["correct"].sum() / max(m["count"].sum(), 1)),
             "round_time_s": dt,
         }
+        if self.compressed_round_fn is not None:
+            # client->server update traffic this round (uplink; the
+            # downlink model broadcast is uncompressed and identical in
+            # both regimes, so the ratio isolates what compression buys)
+            cohort = self._last_cohort_size
+            wire = self._payload_bytes * cohort
+            raw = self._raw_payload_bytes * cohort
+            # set directly on the record (callers read the returned dict);
+            # count_wire is the transports' path and would double-report
+            train_metrics["bytes_on_wire"] = wire
+            train_metrics["compression_ratio"] = round(raw / wire, 3)
         self.round_idx += 1
         return train_metrics
 

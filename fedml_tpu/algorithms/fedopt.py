@@ -57,13 +57,17 @@ class FedOptAPI(FedAvgAPI):
     Extra args: ``server_optimizer`` (default ``sgd``), ``server_lr``
     (default 1.0), ``server_momentum``."""
 
-    def __init__(self, dataset, spec, args, mesh=None, metrics_logger=None):
+    def __init__(self, dataset, spec, args, mesh=None, metrics_logger=None,
+                 compressor=None):
         server_tx = get_server_optimizer(
             getattr(args, "server_optimizer", "sgd"),
             getattr(args, "server_lr", 1.0),
             momentum=getattr(args, "server_momentum", 0.9))
         payload_fn, server_fn = make_fedopt_hooks(server_tx)
+        # compressor= composes transparently: the compressed round feeds
+        # RECONSTRUCTED client states through payload_fn, so the server
+        # optimizer steps on the pseudo-gradient that survived compression
         super().__init__(dataset, spec, args, mesh=mesh,
                          payload_fn=payload_fn, server_fn=server_fn,
-                         metrics_logger=metrics_logger)
+                         metrics_logger=metrics_logger, compressor=compressor)
         self.server_state = server_tx.init(self.global_state["params"])

@@ -1,0 +1,53 @@
+"""``fedml_tpu.compression``: client-update compression + binary wire codec.
+
+Two layers, composable and separately usable:
+
+- :mod:`~fedml_tpu.compression.codec` -- binary framing for ndarray
+  payloads on the control-plane transports (header + dtype + shape + raw
+  bytes; JSON stays for scalar control fields; version byte for
+  back-compat). Numpy-only: importable without jax.
+- :mod:`~fedml_tpu.compression.compressors` -- jit-compatible pytree
+  compressors (``none``/``topk``/``randk``/``qsgd``/``signsgd``) with
+  :class:`ErrorFeedback` residual accumulation, selected by spec string
+  via :func:`get_compressor` (``--compressor qsgd:8``).
+- :mod:`~fedml_tpu.compression.integration` -- the compressed FedAvg-family
+  round (error feedback carried per client across rounds) and on-wire byte
+  accounting behind the per-round ``bytes_on_wire`` /
+  ``compression_ratio`` metrics fields.
+
+Exports resolve lazily so that importing :mod:`.codec` (directly or from
+the transports) never drags in jax via this package ``__init__`` --
+compressors/integration load on first attribute access.
+
+See ``docs/COMPRESSION.md`` for the wire format and measured sizes.
+"""
+
+_EXPORTS = {
+    "fedml_tpu.compression.codec": (
+        "encode_array", "decode_array", "encode_tree", "decode_tree",
+        "message_to_wire", "message_from_wire", "tree_wire_nbytes"),
+    "fedml_tpu.compression.compressors": (
+        "Compressor", "NoneCompressor", "TopKCompressor", "RandKCompressor",
+        "QSGDCompressor", "SignSGDCompressor", "ErrorFeedback",
+        "get_compressor"),
+    "fedml_tpu.compression.integration": (
+        "make_compressed_sim_round", "compressed_payload_nbytes",
+        "raw_payload_nbytes"),
+}
+
+__all__ = [name for names in _EXPORTS.values() for name in names]
+
+_BY_NAME = {name: mod for mod, names in _EXPORTS.items() for name in names}
+
+
+def __getattr__(name):
+    mod = _BY_NAME.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    return getattr(importlib.import_module(mod), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

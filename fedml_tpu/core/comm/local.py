@@ -22,10 +22,19 @@ from fedml_tpu.core.message import Message
 
 
 class LocalCommNetwork:
-    """A set of connected ranks sharing in-process mailboxes."""
+    """A set of connected ranks sharing in-process mailboxes.
 
-    def __init__(self, world_size):
+    ``serialize=True`` round-trips every message through the binary wire
+    codec (``Message.to_bytes``/``from_bytes``) instead of passing the
+    object by reference -- the same bytes a TCP/MQTT hop would move, so
+    simulation runs can measure ``bytes_on_wire`` (and catch
+    non-serializable payloads) without opening sockets. Default ``False``
+    keeps the zero-copy in-process behavior.
+    """
+
+    def __init__(self, world_size, serialize=False):
         self.world_size = world_size
+        self.serialize = bool(serialize)
         self.mailboxes = [queue.Queue() for _ in range(world_size)]
 
     def manager(self, rank):
@@ -39,6 +48,8 @@ class LocalCommManager(BaseCommunicationManager):
     def __init__(self, network: LocalCommNetwork, rank: int):
         self.network = network
         self.rank = rank
+        self.bytes_sent = 0  # wire-codec bytes (serialize=True networks)
+        self.bytes_received = 0
         self._observers = []
         self._running = False
 
@@ -50,7 +61,12 @@ class LocalCommManager(BaseCommunicationManager):
 
     def send_message(self, msg: Message):
         receiver = msg.get_receiver_id()
-        self.network.mailboxes[receiver].put(msg)
+        if self.network.serialize:
+            payload = msg.to_bytes()
+            self.bytes_sent += len(payload)
+            self.network.mailboxes[receiver].put(payload)
+        else:
+            self.network.mailboxes[receiver].put(msg)
 
     def handle_receive_message(self):
         """Blocking receive loop dispatching to observers until stopped."""
@@ -60,6 +76,9 @@ class LocalCommManager(BaseCommunicationManager):
             msg = box.get()
             if msg is _STOP:
                 break
+            if isinstance(msg, (bytes, bytearray)):
+                self.bytes_received += len(msg)
+                msg = Message.from_bytes(msg)
             for obs in self._observers:
                 obs.receive_message(msg.get_type(), msg)
 

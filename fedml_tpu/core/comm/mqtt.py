@@ -3,7 +3,13 @@
 Topic scheme parity with reference ``fedml_core/distributed/communication/
 mqtt/mqtt_comm_manager.py:47-120``: the server (client_id 0) publishes to
 ``<prefix>0_<clientID>`` and subscribes to ``<prefix><clientID>``; clients
-mirror-image. Payload is ``Message.to_json()`` with ndarray->list codec.
+mirror-image. Payload defaults to the binary envelope ``Message.to_bytes()``
+(``fedml_tpu.compression.codec``: JSON control header + raw-byte array
+frames -- MQTT payloads are bytes, brokers don't care). Back-compat is
+*inbound*: frames are sniffed, so legacy ``Message.to_json()`` senders keep
+working against this manager -- but legacy-only RECEIVERS cannot parse the
+binary envelope, so a fleet with un-upgraded subscribers must pass
+``binary=False`` to publish the legacy JSON (ndarray->list) codec.
 
 ``paho-mqtt`` is not part of the baked environment; the class raises a clear
 error at construction when unavailable. No broker address is hardcoded
@@ -39,7 +45,10 @@ def _paho_factory(client_id: str):  # pragma: no cover - needs paho
 
 class MqttCommManager(BaseCommunicationManager):
     def __init__(self, host, port, topic_prefix="fedml", client_id=0,
-                 client_num=0, client_factory=None):
+                 client_num=0, client_factory=None, binary=True):
+        self._binary = bool(binary)
+        self.bytes_sent = 0
+        self.bytes_received = 0
         if client_factory is None:
             if not _HAS_PAHO:
                 raise RuntimeError(
@@ -63,8 +72,11 @@ class MqttCommManager(BaseCommunicationManager):
             client.subscribe(self._topic + "0_" + str(self.client_id))
 
     def _on_message(self, client, userdata, msg):
-        m = Message()
-        m.init_from_json_string(msg.payload.decode("utf-8"))
+        payload = msg.payload
+        if isinstance(payload, str):  # permissive fakes publish str
+            payload = payload.encode("utf-8")
+        self.bytes_received += len(payload)
+        m = Message.from_bytes(payload)  # binary or legacy-JSON sniff
         for obs in self._observers:
             obs.receive_message(m.get_type(), m)
 
@@ -74,7 +86,9 @@ class MqttCommManager(BaseCommunicationManager):
             topic = self._topic + "0_" + str(receiver)
         else:
             topic = self._topic + str(self.client_id)
-        self._client.publish(topic, payload=msg.to_json())
+        payload = msg.to_bytes() if self._binary else msg.to_json()
+        self.bytes_sent += len(payload)
+        self._client.publish(topic, payload=payload)
 
     def add_observer(self, observer):
         self._observers.append(observer)

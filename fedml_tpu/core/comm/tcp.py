@@ -11,9 +11,14 @@ polling and ctypes thread kills:
   reference's FedAvg communication pattern, where all traffic is
   server<->client anyway; peer-to-peer algorithms use the SPMD collectives
   data plane, not this layer).
-- frames are length-prefixed ``Message.to_json()`` bytes (the reference
-  pickles python objects over MPI -- a code-execution hazard across trust
-  boundaries; JSON is not).
+- frames are length-prefixed ``Message.to_bytes()`` payloads: a binary
+  envelope (``fedml_tpu.compression.codec``) whose control fields stay
+  JSON while ndarray params ride as raw dtype+shape+buffer frames -- ~10x
+  smaller than the previous JSON-nested-list codec for array payloads.
+  (The reference pickles python objects over MPI -- a code-execution
+  hazard across trust boundaries; this envelope is data-only, and legacy
+  all-JSON frames still decode via the first-byte sniff.) Pass
+  ``binary=False`` to emit the legacy JSON frames instead.
 - the receive loop is a blocking ``recv`` dispatching to observers; STOP
   is an in-band frame, so shutdown needs no thread assassination.
 
@@ -30,6 +35,7 @@ import socket
 import struct
 import threading
 
+from fedml_tpu.compression.codec import message_from_wire
 from fedml_tpu.core.comm.base import (BaseCommunicationManager,
                                       MSG_TYPE_PEER_LOST)
 from fedml_tpu.core.message import Message
@@ -100,9 +106,16 @@ class TcpCommManager(BaseCommunicationManager):
       world_size: total ranks (server waits for world_size-1 HELLOs).
     """
 
-    def __init__(self, host, port, rank, world_size, timeout=60.0):
+    def __init__(self, host, port, rank, world_size, timeout=60.0,
+                 binary=True):
         self.rank = int(rank)
         self.world_size = int(world_size)
+        self._binary = bool(binary)
+        #: payload bytes through this manager (sends + relays / receives),
+        #: excluding the 4-byte length prefix; callers can poll these and
+        #: forward to MetricsLogger.count_wire for bytes_on_wire accounting
+        self.bytes_sent = 0
+        self.bytes_received = 0
         self._observers = []
         self._running = False
         # _lock guards peer membership (and the client's single pipe);
@@ -165,11 +178,14 @@ class TcpCommManager(BaseCommunicationManager):
 
     def send_message(self, msg: Message):
         receiver = int(msg.get_receiver_id())
-        payload = msg.to_json().encode()
+        if self.rank == 0 and receiver == 0:
+            # self-addressed: dispatch locally -- no serialization, and no
+            # bytes_sent (nothing touches the wire)
+            self._dispatch(msg)
+            return
+        payload = msg.to_bytes() if self._binary else msg.to_json().encode()
+        self.bytes_sent += len(payload)
         if self.rank == 0:
-            if receiver == 0:  # self-addressed: dispatch locally
-                self._dispatch(msg)
-                return
             with self._lock:
                 dest = self._peers.get(receiver)
                 slock = self._send_locks.get(receiver)
@@ -240,8 +256,8 @@ class TcpCommManager(BaseCommunicationManager):
                         # closing with unread inbound would RST and could
                         # destroy the GOODBYE still queued at the server
                         continue
-                    msg = Message()
-                    msg.init_from_json_string(frame.decode())
+                    self.bytes_received += len(frame)
+                    msg = message_from_wire(frame)
                     if msg.get_type() == MSG_TYPE_PEER_LOST:
                         logging.warning("tcp client: dropping in-band "
                                         "reserved %s frame",
@@ -270,9 +286,9 @@ class TcpCommManager(BaseCommunicationManager):
                                   "%s", peer_rank)
                 self._drop_peer(peer_rank, lost=True)
                 return
-            msg = Message()
+            self.bytes_received += len(frame)
             try:
-                msg.init_from_json_string(frame.decode())
+                msg = message_from_wire(frame)
             except Exception:
                 # malformed payload (corrupt bytes, version skew): same
                 # story -- treat the peer as lost, loudly
@@ -326,6 +342,7 @@ class TcpCommManager(BaseCommunicationManager):
                     try:
                         with slock:
                             _send_frame(dest, frame)
+                        self.bytes_sent += len(frame)
                     except OSError:
                         # DESTINATION died mid-relay; its own serve thread
                         # may race to report it -- _drop_peer dedups. The

@@ -70,10 +70,25 @@ class Message:
         return self.msg_params
 
     def to_json(self):
-        """JSON codec for broker transports; ndarray payloads become nested
-        lists (the reference's ``is_mobile`` tensor<->list codec,
-        ``fedml_api/distributed/fedavg/utils.py:5-14``)."""
+        """Legacy JSON codec; ndarray payloads become nested lists (the
+        reference's ``is_mobile`` tensor<->list codec,
+        ``fedml_api/distributed/fedavg/utils.py:5-14``). The transports now
+        default to :meth:`to_bytes` -- ~10x smaller for array payloads --
+        and keep decoding this format for back-compat."""
         return json.dumps(self.msg_params, default=_jsonify)
+
+    def to_bytes(self):
+        """Binary wire codec (``fedml_tpu.compression.codec``): JSON control
+        header + raw-byte array frames, version byte up front. Array-valued
+        params ship as dtype+shape+buffer instead of nested lists."""
+        from fedml_tpu.compression.codec import message_to_wire
+        return message_to_wire(self)
+
+    @classmethod
+    def from_bytes(cls, data):
+        """Decode a binary OR legacy-JSON frame (first-byte sniff)."""
+        from fedml_tpu.compression.codec import message_from_wire
+        return message_from_wire(data)
 
     def __str__(self):
         return f"Message(type={self.type}, sender={self.sender_id}, receiver={self.receiver_id})"

@@ -78,6 +78,12 @@ def add_base_args(parser: argparse.ArgumentParser):
                    help="keep device-resident floating image data in "
                         "bfloat16 (half the HBM footprint; default keeps "
                         "source dtype; integer data is never cast)")
+    p.add_argument("--compressor", type=str, default=None,
+                   help="client-update compression spec "
+                        "(fedml_tpu.compression): none | topk:R | randk:R "
+                        "| qsgd:BITS | signsgd. Runs the error-feedback "
+                        "compressed round and logs bytes_on_wire / "
+                        "compression_ratio per round; default off")
     p.add_argument("--moe_experts", type=int, default=8,
                    help="expert count for --model moe_transformer")
     p.add_argument("--model_dtype", type=str, default=None,

@@ -71,11 +71,14 @@ def _lanes_per_group(L, ci, min_k=MXU_K):
     return g
 
 
-#: per-conv strategy threshold for ``lowering="auto"``: the r5 TPU
-#: shoot-out (``scripts/bench_lane_conv.py``, lane_conv_shootout2)
-#: measured the batch-group conv ~2-3x faster than the block-diagonal
-#: embedding at Ci<=32 (where block-diag burns 8x/4x redundant FLOPs)
-#: and slower at Ci=64 (2x redundancy, where full-tile block-diag wins).
+#: PROVISIONAL per-conv strategy threshold for ``lowering="auto"``. The
+#: corrected r5 shoot-out (``scripts/bench_lane_conv.py``, --inner 200,
+#: docs/PERFORMANCE.md) only measured s1 (Ci=16) before the tunnel
+#: wedged: bgc wins FORWARD-only there, and fwd+bwd is a tie (bgc
+#: 0.259 ms vs blockdiag 0.244 ms). The Ci=32/64 crossover comes from
+#: the floor-biased first run PERFORMANCE.md calls misleading; treat
+#: this threshold as unverified until the s2/s3 rows land
+#: (``scripts/tpu_watch_r5b.sh`` holds the next-window plan).
 BGC_MAX_CI = 32
 
 
@@ -317,6 +320,16 @@ def make_lane_loss_builder(model, augment_fn=None, lowering="blockdiag"):
     """
     del augment_fn  # augmentation stays in the engine body (per-lane vmap)
 
+    if not isinstance(model, CifarResNet) and lowering != "blockdiag":
+        # only the ResNet family dispatches on the conv strategy; letting a
+        # non-default request pass silently would label an A/B run "bgc"
+        # while measuring blockdiag
+        import logging
+        logging.warning(
+            "lane_lowering=%r is ignored for %s (only CifarResNet "
+            "dispatches per-conv strategies); running the default lowering",
+            lowering, type(model).__name__)
+
     def builder(L):
         packed_apply = (make_lane_packed_apply(model, L, lowering)
                         if isinstance(model, CifarResNet)
@@ -359,7 +372,10 @@ def builder_for(model, lowering=None):
     ``lowering`` overrides the conv strategy (default ``"blockdiag"``,
     the lowering behind the measured 114.5 rph flagship number; the r5
     per-layer shoot-out puts ``bgc`` within noise of it, so the default
-    only moves on a full-model A/B win)."""
+    only moves on a full-model A/B win). An explicit ``lowering`` for a
+    family that does not dispatch on it logs a warning (see
+    ``make_lane_loss_builder``) rather than silently mislabeling A/B
+    runs."""
     if isinstance(model, PACKED_FAMILIES):
         return make_lane_loss_builder(
             model, lowering=lowering or "blockdiag")

@@ -21,13 +21,24 @@ import time
 
 
 class MetricsLogger:
-    """Callable metrics sink: ``logger(dict)`` or ``logger.log(dict)``."""
+    """Callable metrics sink: ``logger(dict)`` or ``logger.log(dict)``.
+
+    Wire accounting: the compressed simulation rounds set
+    ``bytes_on_wire`` / ``compression_ratio`` directly on their records;
+    for distributed runs, callers forward the transports' ``bytes_sent`` /
+    ``bytes_received`` counters via :meth:`count_wire` and the accumulated
+    totals attach to the next ``log()`` record that does not already carry
+    a ``bytes_on_wire`` field (then reset -- i.e. per-round counters when
+    the round loop logs once per round).
+    """
 
     def __init__(self, run_dir=None, enable_wandb=False, project="fedml_tpu",
                  run_name=None, config=None):
         self.run_dir = run_dir
         self._jsonl = None
         self._summary = {}
+        self._wire_bytes = 0
+        self._wire_raw_bytes = 0
         if run_dir is not None:
             os.makedirs(run_dir, exist_ok=True)
             self._jsonl = open(os.path.join(run_dir, "metrics.jsonl"), "a")
@@ -47,8 +58,24 @@ class MetricsLogger:
             except ImportError:
                 logging.info("wandb not installed; metrics go to JSONL only")
 
+    def count_wire(self, encoded_bytes, raw_bytes=0):
+        """Accumulate on-wire payload bytes (and, optionally, what the same
+        payload would cost uncompressed) toward the next logged record."""
+        self._wire_bytes += int(encoded_bytes)
+        self._wire_raw_bytes += int(raw_bytes)
+
     def log(self, metrics: dict):
         record = _jsonable(metrics)
+        if self._wire_bytes and "bytes_on_wire" not in record:
+            record["bytes_on_wire"] = self._wire_bytes
+            if self._wire_raw_bytes:
+                record["compression_ratio"] = round(
+                    self._wire_raw_bytes / self._wire_bytes, 3)
+            # reset only when consumed: a record that carries its own
+            # bytes_on_wire must not silently discard transport-fed counts
+            # -- they attach to the next record without the field
+            self._wire_bytes = 0
+            self._wire_raw_bytes = 0
         logging.info("%s", record)
         if self._jsonl is not None:
             self._jsonl.write(json.dumps({"_ts": time.time(), **record}) + "\n")

@@ -12,6 +12,10 @@ echo "== fast test tier (engine / core / utils / native / data-extra / online;"
 echo "   includes the federated==centralized + wave/lane==flat equivalence asserts) =="
 python -m pytest tests/ -q -m "not slow" -p no:cacheprovider
 
+echo "== codec size-regression gate (binary framing >= 5x smaller than"
+echo "   JSON lists for a ResNet-sized pytree; bench.py --check) =="
+python bench.py --check
+
 echo "== CLI smoke: --ci equivalence run (reference CI-script-fedavg.sh) =="
 python - <<'EOF'
 import jax

@@ -36,6 +36,15 @@ done
 
 cpu_matrix_stop() { pkill -STOP -f "convergence.py --outdir bench_results/convergence_cpu" && log "CPU matrix paused" || true; }
 cpu_matrix_cont() { pkill -CONT -f "convergence.py --outdir bench_results/convergence_cpu" && log "CPU matrix resumed" || true; }
+# if the watcher dies (signal, crash) between stop and cont, the CPU
+# matrix must never stay SIGSTOPped; CONT on an already-running matrix
+# is a no-op, so resuming unconditionally on exit is safe. Fatal signals
+# must route through `exit` -- bash skips the EXIT trap when killed by
+# an untrapped signal (tmux kill -> HUP, operator ^C -> INT, kill -> TERM)
+trap cpu_matrix_cont EXIT
+trap 'exit 129' HUP
+trap 'exit 130' INT
+trap 'exit 143' TERM
 
 run_step() {  # run_step <name> <timeout_s> <cmd...>
   local name=$1 tmo=$2; shift 2

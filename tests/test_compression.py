@@ -1,0 +1,422 @@
+"""fedml_tpu.compression: binary wire codec + client-update compressors.
+
+Tier-1 (fast, CPU): codec roundtrips for every wire dtype including
+bfloat16 and bit-packed bools; compressor exactness/bounds (exact for
+``none``/``topk`` kept entries, bounded error for ``qsgd``); the
+error-feedback residual identity; a compressed-FedAvg convergence smoke
+against uncompressed; and transport roundtrips (local serialize + a real
+TCP FedAvg protocol round) asserting binary frames beat the legacy
+JSON-list codec by the acceptance margin (>=8x for qsgd on a CNN-sized
+pytree) with the traffic logged through ``MetricsLogger``.
+"""
+
+import json
+import socket
+import threading
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.compression import (ErrorFeedback, decode_array, decode_tree,
+                                   encode_array, encode_tree, get_compressor,
+                                   message_from_wire, message_to_wire,
+                                   tree_wire_nbytes)
+from fedml_tpu.compression.compressors import (NoneCompressor,
+                                               QSGDCompressor,
+                                               SignSGDCompressor,
+                                               TopKCompressor)
+from fedml_tpu.core.message import Message, params_to_lists
+
+
+def _cnn_sized_params(rng_seed=0):
+    """CNNOriginalFedAvg-shaped conv/fc kernels (~430k params): big enough
+    that codec ratios are dominated by payload, small enough for tier-1."""
+    rng = np.random.default_rng(rng_seed)
+    shapes = {"conv1": {"kernel": (5, 5, 1, 32), "bias": (32,)},
+              "conv2": {"kernel": (5, 5, 32, 64), "bias": (64,)},
+              "fc1": {"kernel": (1024, 384), "bias": (384,)},
+              "fc2": {"kernel": (384, 10), "bias": (10,)}}
+    return jax.tree.map(
+        lambda s: rng.normal(0, 0.1, s).astype(np.float32), shapes,
+        is_leaf=lambda x: isinstance(x, tuple))
+
+
+class TestCodec:
+    @pytest.mark.parametrize("dtype", [
+        "float32", "float64", "float16", "bfloat16", "int8", "uint8",
+        "int32", "int64", "bool"])
+    def test_array_roundtrip_all_dtypes(self, dtype):
+        rng = np.random.default_rng(0)
+        if dtype == "bool":
+            arr = rng.random((3, 7, 5)) > 0.5
+        elif dtype == "bfloat16":
+            import ml_dtypes
+            arr = rng.normal(size=(4, 9)).astype(ml_dtypes.bfloat16)
+        elif np.issubdtype(np.dtype(dtype), np.floating):
+            arr = rng.normal(size=(4, 9)).astype(dtype)
+        else:
+            arr = rng.integers(0, 100, (4, 9)).astype(dtype)
+        out, off = decode_array(encode_array(arr))
+        assert off == len(encode_array(arr))
+        assert out.dtype == arr.dtype
+        np.testing.assert_array_equal(out, arr)
+
+    def test_zero_dim_and_empty(self):
+        for arr in (np.float32(3.5).reshape(()), np.zeros((0,), np.int32),
+                    np.zeros((2, 0, 3), np.float32)):
+            out, _ = decode_array(encode_array(arr))
+            assert out.shape == arr.shape and out.dtype == arr.dtype
+            np.testing.assert_array_equal(out, arr)
+
+    def test_bool_bitpacking_on_wire(self):
+        # 1 bit/element: 8000 bools must frame in ~1000 payload bytes
+        arr = np.ones(8000, np.bool_)
+        assert len(encode_array(arr)) < 1100
+        out, _ = decode_array(encode_array(arr))
+        np.testing.assert_array_equal(out, arr)
+
+    def test_tree_roundtrip_mixed(self):
+        import ml_dtypes
+        tree = {"params": {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+                           "b": np.ones(3, ml_dtypes.bfloat16)},
+                "mask": np.array([True, False, True]),
+                "round": 7, "name": "cohort", "lst": [1, 2.5, "x"]}
+        out = decode_tree(encode_tree(tree))
+        np.testing.assert_array_equal(out["params"]["w"],
+                                      tree["params"]["w"])
+        assert out["params"]["b"].dtype == np.dtype(ml_dtypes.bfloat16)
+        np.testing.assert_array_equal(out["mask"], tree["mask"])
+        assert out["round"] == 7 and out["name"] == "cohort"
+        assert out["lst"] == [1, 2.5, "x"]
+
+    def test_tree_wire_nbytes_exact(self):
+        tree = {"a": np.zeros((17, 3), np.float32),
+                "b": {"c": np.ones(100, np.bool_)}}
+        assert tree_wire_nbytes(tree) == len(encode_tree(tree))
+        # and from abstract shapes (eval_shape structs have shape/dtype)
+        shapes = jax.eval_shape(lambda t: t, tree)
+        assert tree_wire_nbytes(shapes) == len(encode_tree(tree))
+
+    def test_version_byte_and_legacy_json_sniff(self):
+        m = Message("sync", 0, 1)
+        m.add("w", np.arange(4, dtype=np.float32))
+        wire = message_to_wire(m)
+        assert wire[0] == 0x9E and wire[1] == 1  # magic + version
+        back = message_from_wire(wire)
+        assert back.get_type() == "sync"
+        np.testing.assert_array_equal(back.get("w"),
+                                      np.arange(4, dtype=np.float32))
+        # legacy all-JSON frames still decode through the same entry point
+        legacy = message_from_wire(Message("stop", 2, 0).to_json().encode())
+        assert legacy.get_type() == "stop" and legacy.get_sender_id() == 2
+        # and a frame claiming an unknown version is rejected, not misread
+        with pytest.raises(ValueError):
+            decode_tree(bytes([0x9E, 99]) + wire[2:])
+
+    def test_reserved_marker_key_rejected(self):
+        m = Message("x", 0, 1)
+        m.add("payload", {"__nd__": 3})
+        with pytest.raises(ValueError):
+            message_to_wire(m)
+
+    def test_binary_beats_json_lists(self):
+        params = _cnn_sized_params()
+        m = Message("model", 1, 0)
+        m.add("params", params)
+        json_bytes = len(Message("model", 1, 0).to_json()) + len(
+            json.dumps(params_to_lists(params)))
+        assert json_bytes >= 5 * len(message_to_wire(m))
+
+
+class TestCompressors:
+    def _params(self):
+        rng = np.random.default_rng(1)
+        return {"w": jnp.asarray(rng.normal(size=(40, 25)).astype(np.float32)),
+                "b": jnp.asarray(rng.normal(size=(25,)).astype(np.float32)),
+                "step": jnp.asarray(3, jnp.int32)}
+
+    def test_spec_parsing(self):
+        assert get_compressor(None) is None
+        assert get_compressor("") is None
+        assert isinstance(get_compressor("none"), NoneCompressor)
+        assert get_compressor("topk:0.05").ratio == 0.05
+        assert get_compressor("qsgd:4").bits == 4
+        assert isinstance(get_compressor("signsgd"), SignSGDCompressor)
+        c = get_compressor("topk:0.1")
+        assert get_compressor(c) is c  # instances pass through
+        with pytest.raises(ValueError):
+            get_compressor("gzip")
+        with pytest.raises(ValueError):
+            get_compressor("topk:1.5")
+        with pytest.raises(ValueError):
+            get_compressor("signsgd:2")
+
+    def test_none_exact(self):
+        p = self._params()
+        c = NoneCompressor()
+        dec = c.decompress(c.compress(p, jax.random.PRNGKey(0)), p)
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), dec, p)
+
+    def test_topk_keeps_largest_exactly(self):
+        p = self._params()
+        c = TopKCompressor(ratio=0.1)
+        dec = c.decompress(c.compress(p, jax.random.PRNGKey(0)), p)
+        for name in ("w", "b"):
+            x = np.asarray(p[name]).reshape(-1)
+            y = np.asarray(dec[name]).reshape(-1)
+            k = max(1, int(np.ceil(0.1 * x.size)))
+            top = np.argsort(np.abs(x))[-k:]
+            np.testing.assert_array_equal(y[top], x[top])  # kept: exact
+            rest = np.setdiff1d(np.arange(x.size), top)
+            np.testing.assert_array_equal(y[rest], 0)  # dropped: zero
+        # integer leaves pass through every compressor untouched
+        assert int(dec["step"]) == 3
+
+    def test_qsgd_bounded_error_and_int8_storage(self):
+        p = self._params()
+        c = QSGDCompressor(bits=8)
+        enc = c.compress(p, jax.random.PRNGKey(0))
+        assert enc["w"]["q"].dtype == jnp.int8
+        dec = c.decompress(enc, p)
+        for name in ("w", "b"):
+            x = np.asarray(p[name])
+            scale = float(np.max(np.abs(x)))
+            err = np.max(np.abs(np.asarray(dec[name]) - x))
+            assert err <= scale / c.levels + 1e-6  # one quantization step
+
+    def test_signsgd_one_bit(self):
+        p = self._params()
+        c = SignSGDCompressor()
+        enc = c.compress(p, jax.random.PRNGKey(0))
+        assert enc["w"]["sign"].dtype == jnp.bool_
+        dec = c.decompress(enc, p)
+        x, y = np.asarray(p["w"]), np.asarray(dec["w"])
+        np.testing.assert_array_equal(np.sign(y), np.where(x >= 0, 1, -1))
+        assert np.allclose(np.abs(y), np.mean(np.abs(x)))
+
+    def test_randk_unbiased_scaling(self):
+        p = {"w": jnp.ones((100,), jnp.float32)}
+        c = get_compressor("randk:0.25")
+        enc = c.compress(p, jax.random.PRNGKey(0))
+        # kept entries carry 1/ratio scaling so E[decode] == input
+        np.testing.assert_allclose(np.asarray(enc["w"]["values"]), 4.0)
+        assert np.asarray(enc["w"]["indices"]).size == 25
+
+    def test_compress_is_jittable(self):
+        p = self._params()
+        for spec in ("topk:0.2", "randk:0.2", "qsgd:8", "signsgd"):
+            c = get_compressor(spec)
+            enc = jax.jit(lambda t, r: c.compress(t, r))(
+                p, jax.random.PRNGKey(0))
+            dec = jax.jit(lambda e: c.decompress(e, p))(enc)
+            assert np.asarray(dec["w"]).shape == (40, 25)
+
+    def test_encoded_tree_survives_wire(self):
+        # the full client->server hop: compress -> binary frame -> decode
+        # -> decompress reproduces the device-side reconstruction exactly
+        p = self._params()
+        c = get_compressor("qsgd:8")
+        enc = c.compress(p, jax.random.PRNGKey(7))
+        direct = c.decompress(enc, p)
+        host_enc = jax.tree.map(np.asarray, enc)
+        over_wire = c.decompress(decode_tree(encode_tree(host_enc)), p)
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), direct, over_wire)
+
+    def test_error_feedback_residual_identity(self):
+        p = self._params()
+        ef = ErrorFeedback(get_compressor("topk:0.1"))
+        res = ef.init(p)
+        _, dec, new_res = ef.step(p, res, p, jax.random.PRNGKey(0))
+        jax.tree.map(
+            lambda x, d, r: np.testing.assert_allclose(
+                np.asarray(x) - np.asarray(d), np.asarray(r), atol=1e-6),
+            p, dec, new_res)
+
+
+def _fed_args(**kw):
+    base = dict(client_num_per_round=6, comm_round=3, epochs=1,
+                batch_size=16, lr=0.3, client_optimizer="sgd", wd=0.0,
+                frequency_of_the_test=100, ci=0, seed=0)
+    base.update(kw)
+    return types.SimpleNamespace(**base)
+
+
+class TestCompressedFedAvg:
+    def _setup(self):
+        from fedml_tpu import models
+        from fedml_tpu.algorithms.specs import make_classification_spec
+        from fedml_tpu.data import load_synthetic_federated
+        spec = make_classification_spec(
+            models.LogisticRegression(num_classes=10, apply_sigmoid=False),
+            jnp.zeros((1, 60)))
+        ds = load_synthetic_federated(client_num=6, n_train=600, n_test=150,
+                                      alpha=0.0, beta=0.0, seed=0)
+        return ds, spec
+
+    def test_none_compressor_matches_uncompressed(self):
+        from fedml_tpu.algorithms.fedavg import FedAvgAPI
+        ds, spec = self._setup()
+        a = FedAvgAPI(ds, spec, _fed_args(compressor="none"))
+        b = FedAvgAPI(ds, spec, _fed_args())
+        a.train_one_round()
+        b.train_one_round()
+        for x, y in zip(jax.tree.leaves(a.global_state["params"]),
+                        jax.tree.leaves(b.global_state["params"])):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       atol=1e-6)
+
+    def test_error_feedback_convergence_smoke(self):
+        """Compressed FedAvg (with EF) reaches a loss within tolerance of
+        uncompressed after the same number of rounds."""
+        from fedml_tpu.algorithms.fedavg import FedAvgAPI
+        ds, spec = self._setup()
+        rounds = 10
+        base = FedAvgAPI(ds, spec, _fed_args())
+        for _ in range(rounds):
+            ref = base.train_one_round()
+        comp = FedAvgAPI(ds, spec, _fed_args(compressor="qsgd:8"))
+        for _ in range(rounds):
+            got = comp.train_one_round()
+        assert got["Train/Loss"] <= ref["Train/Loss"] * 1.25 + 0.05
+        assert got["compression_ratio"] > 2.5
+        assert got["bytes_on_wire"] > 0
+        # residuals are live state, not zeros: EF is actually engaged
+        assert any(float(jnp.max(jnp.abs(r))) > 0
+                   for r in jax.tree.leaves(comp._ef_residuals))
+
+    def test_mesh_plus_compressor_rejected(self):
+        from fedml_tpu.algorithms.fedavg import FedAvgAPI
+        ds, spec = self._setup()
+        mesh = object()  # only reachability of the guard is under test
+        with pytest.raises(ValueError, match="compressor"):
+            FedAvgAPI(ds, spec, _fed_args(compressor="qsgd:8"), mesh=mesh)
+
+    def test_decentralized_compressed_round(self):
+        from fedml_tpu.algorithms.decentralized import DecentralizedFedAPI
+        ds, spec = self._setup()
+        api = DecentralizedFedAPI(ds, spec,
+                                  _fed_args(compressor="topk:0.25"))
+        m1 = api.train_one_round()
+        m2 = api.train_one_round()
+        assert m1["bytes_on_wire"] > 0 and m1["compression_ratio"] > 1.5
+        assert np.isfinite(m2["Train/Loss"])
+
+
+class _Recorder:
+    def __init__(self):
+        self.received = []
+
+    def receive_message(self, msg_type, msg):
+        self.received.append((msg_type, msg))
+
+
+class TestTransportRoundtrip:
+    def test_local_serialize_binary_beats_json(self):
+        from fedml_tpu.core.comm.local import LocalCommNetwork
+        net = LocalCommNetwork(2, serialize=True)
+        m0, m1 = net.manager(0), net.manager(1)
+        rec = _Recorder()
+        m1.add_observer(rec)
+        params = _cnn_sized_params()
+        msg = Message("model", 0, 1)
+        msg.add("params", params)
+        m0.send_message(msg)
+        m1.stop_receive_message()  # queue: payload then STOP
+        m1.handle_receive_message()
+        got = rec.received[0][1].get("params")
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(a, b),
+                     got, params)
+        json_cost = len(json.dumps(params_to_lists(params)))
+        assert m0.bytes_sent == m1.bytes_received > 0
+        assert json_cost >= 5 * m0.bytes_sent
+
+    def test_tcp_compressed_round_8x_fewer_bytes(self, tmp_path):
+        """Acceptance: a distributed round over real TCP sockets with qsgd
+        payloads moves >=8x fewer bytes than the JSON-list codec would for
+        the same update, measured from transport counters and logged via
+        MetricsLogger."""
+        from fedml_tpu.core.comm.tcp import TcpCommManager
+        from fedml_tpu.utils.metrics import MetricsLogger
+
+        s = socket.socket()
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+        s.close()
+
+        params = _cnn_sized_params()
+        comp = get_compressor("qsgd:8")
+        server_rec = _Recorder()
+
+        def client():
+            comm = TcpCommManager("localhost", port, 1, 2, timeout=30.0)
+            enc = jax.tree.map(np.asarray,
+                               comp.compress(params, jax.random.PRNGKey(0)))
+            out = Message("send_model_to_server", 1, 0)
+            out.add("encoded", enc)
+            out.add("num_samples", 100)
+            comm.send_message(out)
+            comm.handle_receive_message()  # until the server's STOP
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        server = TcpCommManager("localhost", port, 0, 2, timeout=30.0)
+        server.add_observer(server_rec)
+        stop_after = {"n": 0}
+
+        class _Stopper:
+            def receive_message(self, msg_type, msg):
+                stop_after["n"] += 1
+                server.stop_receive_message()
+
+        server.add_observer(_Stopper())
+        server.handle_receive_message()
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert server_rec.received[0][0] == "send_model_to_server"
+
+        # server-side reconstruction from what actually crossed the socket
+        enc = server_rec.received[0][1].get("encoded")
+        dec = comp.decompress(enc, params)
+        scale = max(float(np.max(np.abs(np.asarray(v))))
+                    for v in jax.tree.leaves(params))
+        err = max(float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+                  for a, b in zip(jax.tree.leaves(dec),
+                                  jax.tree.leaves(params)))
+        assert err <= scale / comp.levels + 1e-6
+
+        json_cost = len(json.dumps(params_to_lists(params)))
+        wire_cost = server.bytes_received
+        assert wire_cost > 0
+        assert json_cost >= 8 * wire_cost, (json_cost, wire_cost)
+
+        logger = MetricsLogger(run_dir=str(tmp_path))
+        logger.count_wire(wire_cost, json_cost)
+        logger.log({"round": 0})
+        assert logger.summary["bytes_on_wire"] == wire_cost
+        assert logger.summary["compression_ratio"] >= 8
+        logger.close()
+
+
+class TestMetricsLoggerWire:
+    def test_counters_attach_once_and_reset(self, tmp_path):
+        from fedml_tpu.utils.metrics import MetricsLogger
+        logger = MetricsLogger(run_dir=str(tmp_path))
+        logger.count_wire(1000, 4000)
+        logger.log({"round": 0})
+        assert logger.summary["bytes_on_wire"] == 1000
+        assert logger.summary["compression_ratio"] == 4.0
+        logger.log({"round": 1, "Train/Loss": 1.0})
+        # no new traffic counted: round-1 record carries no wire keys
+        with open(tmp_path / "metrics.jsonl") as f:
+            records = [json.loads(line) for line in f]
+        assert "bytes_on_wire" not in records[1]
+        # explicit keys in the record win over the counters
+        logger.count_wire(7, 7)
+        logger.log({"round": 2, "bytes_on_wire": 123})
+        assert logger.summary["bytes_on_wire"] == 123
+        logger.close()
