@@ -20,6 +20,7 @@ from fedml_tpu.core.trainer import TrainSpec
 from fedml_tpu.parallel.engine import (
     ClientUpdateConfig, make_client_update, make_eval_fn)
 from fedml_tpu.parallel.packing import pack_cohort, pack_eval
+from fedml_tpu.utils.profiling import end_of_round_sync
 
 
 class CentralizedTrainer:
@@ -60,7 +61,7 @@ class CentralizedTrainer:
         one = jax.tree.map(lambda a: a[0], packed)
         self.rng, rng = jax.random.split(self.rng)
         new_state, _, metrics = self._update(self.global_state, one, rng)
-        jax.block_until_ready(new_state)
+        end_of_round_sync(new_state)
         self.global_state = new_state
         m = jax.tree.map(np.asarray, metrics)
         out = {"round": self.round_idx,
@@ -77,12 +78,16 @@ class CentralizedTrainer:
                 "Test/Acc": float(m["correct"] / max(m["count"], 1))}
 
     def train(self, on_round=None):
+        from fedml_tpu.utils.profiling import off_round_work
+
         freq = getattr(self.args, "frequency_of_the_test", 5)
         while self.round_idx < self.args.comm_round:
             metrics = self.train_one_round()
             last = self.round_idx == self.args.comm_round
             if self.round_idx % freq == 0 or last:
-                metrics.update(self.evaluate_global())
+                # see FedAvgAPI.train: eval compiles are off-round work
+                with off_round_work():
+                    metrics.update(self.evaluate_global())
             self.metrics_logger(metrics)
             self.history.append(metrics)
             if on_round is not None:

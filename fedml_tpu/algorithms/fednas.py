@@ -28,6 +28,7 @@ import numpy as np
 import optax
 
 from fedml_tpu.algorithms.fedavg import client_sampling
+from fedml_tpu.utils.profiling import end_of_round_sync
 from fedml_tpu.algorithms.specs import make_classification_spec
 from fedml_tpu.core import pytree
 from fedml_tpu.models.darts import DARTSNetwork, derive_genotype
@@ -221,7 +222,7 @@ class FedNASAPI:
         self.rng, round_rng = jax.random.split(self.rng)
         self.global_state, info = self.round_fn(self.global_state, packed,
                                                 round_rng)
-        jax.block_until_ready(self.global_state)
+        end_of_round_sync(self.global_state)
         m = jax.tree.map(np.asarray, info["metrics"])
         out = {"round": self.round_idx,
                "Train/Loss": float(m["loss_sum"].sum() / max(m["count"].sum(), 1)),

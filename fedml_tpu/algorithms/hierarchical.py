@@ -24,6 +24,7 @@ from fedml_tpu.algorithms.fedavg import FedAvgAPI, client_sampling
 from fedml_tpu.core import pytree
 from fedml_tpu.parallel.engine import ClientUpdateConfig, make_client_update
 from fedml_tpu.parallel.packing import pack_cohort
+from fedml_tpu.utils.profiling import end_of_round_sync
 
 
 class HierarchicalFedAvgAPI(FedAvgAPI):
@@ -108,7 +109,7 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
         self.rng, round_rng = jax.random.split(self.rng)
         self.global_state, metrics = self._global_round(
             self.global_state, cohort, round_rng)
-        jax.block_until_ready(self.global_state)
+        end_of_round_sync(self.global_state)
         m = jax.tree.map(np.asarray, metrics)
         out = {
             "round": self.round_idx,

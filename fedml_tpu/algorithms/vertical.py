@@ -77,7 +77,9 @@ class VerticalFLAPI:
             (loss, correct), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params_list, xs, y)
             new_params, new_opts = [], []
-            for p, o, g in zip(params_list, opt_list, grads):
+            # static-length Python lists (one entry per party), not traced
+            # arrays: unrolling K parties is the intent here
+            for p, o, g in zip(params_list, opt_list, grads):  # fedlint: disable=FL102
                 up, o2 = tx.update(g, o, p)
                 new_params.append(optax.apply_updates(p, up))
                 new_opts.append(o2)

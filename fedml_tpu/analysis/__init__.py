@@ -1,0 +1,26 @@
+"""fedlint: static + runtime guardrails for the TPU-native rebuild.
+
+The reference FedML ships no correctness tooling beyond its CI convergence
+asserts (``CI-script-fedavg.sh``); this package is the analog for the
+failure modes that matter *here*: silent retraces, accidental host syncs in
+jitted hot paths, missing buffer donation on aggregation jits, and
+transport code that swallows errors. Two halves:
+
+- :mod:`fedml_tpu.analysis.linter` -- "fedlint", an AST pass over the
+  package with per-rule codes (FL1xx), ``# fedlint: disable=CODE``
+  suppressions, and a checked-in baseline so the gate only fails on *new*
+  findings. CLI: ``python -m fedml_tpu.analysis`` (or the ``fedlint``
+  entry point).
+- :mod:`fedml_tpu.analysis.runtime` -- ``audit()``, a context manager that
+  counts jit (re)traces per federated round via ``jax.monitoring`` and
+  arms ``jax.transfer_guard`` around the end-of-round sync, reporting
+  ``retraces_per_round`` / guarded-transfer violations through the
+  metrics logger. Wired to ``--audit`` on the experiment mains.
+"""
+
+from fedml_tpu.analysis.linter import (Finding, RULES, lint_paths,
+                                       lint_source)
+from fedml_tpu.analysis.runtime import RuntimeAuditor, audit, current_auditor
+
+__all__ = ["Finding", "RULES", "lint_paths", "lint_source",
+           "RuntimeAuditor", "audit", "current_auditor"]

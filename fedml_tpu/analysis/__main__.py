@@ -1,0 +1,3 @@
+from fedml_tpu.analysis.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,85 @@
+"""fedlint CLI: ``python -m fedml_tpu.analysis`` / the ``fedlint`` entry.
+
+Exit codes: 0 = clean (or all findings baselined), 1 = new findings,
+2 = usage error. ``--write-baseline`` regenerates the checked-in baseline
+from the current findings (run it after deliberately accepting debt; the
+diff review of the baseline file IS the acceptance step).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from fedml_tpu.analysis.linter import (RULES, apply_baseline, lint_paths,
+                                       load_baseline, render_json,
+                                       render_text, write_baseline)
+
+# anchored to the installed package, not the cwd: the `fedlint` console
+# script must find the shipped baseline from any directory
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "fedlint_baseline.json")
+
+
+def _split_codes(value):
+    return {c.strip().upper() for c in value.split(",") if c.strip()}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="fedlint",
+        description="JAX/FL-aware static analysis for fedml_tpu "
+                    "(rule catalog: docs/ANALYSIS.md)")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files or directories to lint "
+                             "(default: fedml_tpu/)")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="baseline JSON tolerating pre-existing "
+                             "findings (default: %(default)s; pass '' to "
+                             "disable)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="rewrite --baseline from the current findings "
+                             "and exit 0")
+    parser.add_argument("--select", type=_split_codes, default=None,
+                        metavar="CODES", help="only these codes (comma-sep)")
+    parser.add_argument("--ignore", type=_split_codes, default=None,
+                        metavar="CODES", help="drop these codes (comma-sep)")
+    parser.add_argument("--show-baselined", action="store_true",
+                        help="text reporter: also print baselined findings")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for code, (title, rationale) in sorted(RULES.items()):
+            print(f"{code}: {title}\n    {rationale}")
+        return 0
+
+    paths = args.paths or ["fedml_tpu"]
+    try:
+        findings = lint_paths(paths, select=args.select, ignore=args.ignore)
+    except OSError as e:
+        print(f"fedlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        if not args.baseline:
+            print("fedlint: --write-baseline needs --baseline",
+                  file=sys.stderr)
+            return 2
+        write_baseline(findings, args.baseline)
+        print(f"fedlint: wrote {len(findings)} finding(s) to "
+              f"{args.baseline}")
+        return 0
+
+    new = apply_baseline(findings, load_baseline(args.baseline))
+    if args.format == "json":
+        print(render_json(findings))
+    else:
+        print(render_text(findings, show_baselined=args.show_baselined))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,668 @@
+"""fedlint core: AST rules for JAX/FL antipatterns.
+
+Pure stdlib (``ast`` + ``tokenize``): linting must run on hosts with no
+accelerator and must never import the code under analysis. Each rule has a
+stable ``FL1xx`` code; findings can be suppressed per line
+(``# fedlint: disable=FL101``) or per file
+(``# fedlint: disable-file=FL104`` in the module header), and a JSON
+baseline makes the CI gate incremental -- pre-existing findings are
+tolerated, new ones fail the build (see ``docs/ANALYSIS.md``).
+
+The jit-detection pass is deliberately syntactic: a function counts as
+"device code" when it is decorated with ``jax.jit``/``jax.pmap`` (directly
+or through ``functools.partial``) or wrapped by a module-level
+``name = jax.jit(fn, ...)`` call. That misses dynamically-constructed jits
+(a closure returned from a builder and jitted by the caller) -- acceptable:
+the repo's builders jit inside the builder, which this sees.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from collections import Counter
+from dataclasses import dataclass, field
+from fnmatch import fnmatch
+
+#: Rule catalog: code -> (title, rationale). docs/ANALYSIS.md mirrors this;
+#: ``fedlint --list-rules`` prints it.
+RULES = {
+    "FL101": (
+        "host-device sync inside a jitted function",
+        "`.item()`, `float()/int()/bool()`, `np.asarray`/`np.array`, or "
+        "`jax.device_get` on a traced value forces a blocking device->host "
+        "transfer at trace time (or a ConcretizationTypeError); inside a "
+        "per-round hot path that is a silent serialization point."),
+    "FL102": (
+        "Python control flow on a traced value",
+        "`if`/`while`/`for` over a jitted function's array argument "
+        "concretizes the tracer (error) or bakes the branch into the "
+        "compiled program and retraces per value. Use `lax.cond`/"
+        "`lax.scan`/`jnp.where`, or mark the argument static."),
+    "FL103": (
+        "jit over Python-scalar params without static_argnums",
+        "a jitted function whose signature takes Python scalars (bool/int/"
+        "str defaults or annotations) without `static_argnums`/"
+        "`static_argnames` retraces on every distinct value -- or traces "
+        "the scalar and silently freezes semantics that look dynamic."),
+    "FL104": (
+        "aggregation-path jit without donate_argnums",
+        "round/aggregation jits thread the full model state in and out; "
+        "without `donate_argnums` XLA keeps both copies live, doubling "
+        "HBM for the update step. `fedml_tpu/parallel/*` shows the "
+        "intended idiom."),
+    "FL105": (
+        "NumPy interop inside a jitted function",
+        "`np.*` ops on traced values sync to host and compute in float64 "
+        "(silent double-precision promotion when the result re-enters "
+        "device code). Use the `jnp` equivalent; dtype literals belong to "
+        "`jnp`/`ml_dtypes`, not `np.float64`."),
+    "FL106": (
+        "unordered dict iteration feeding pytree construction",
+        "`.values()`/`.keys()`/`.items()` order is insertion order -- which "
+        "differs across processes when dicts come from JSON/argparse/"
+        "checkpoint restores; feeding it into `stack`/`concatenate`/"
+        "`tree_map`/`tree_unflatten` builds rank-dependent pytrees that "
+        "desync SPMD programs. Wrap in `sorted(...)`."),
+    "FL107": (
+        "broad exception handler in comm/transport code",
+        "`except:`/`except Exception:` in transport or codec paths turns "
+        "wire corruption, version skew, and peer death into silent round "
+        "corruption. Catch the specific decode/socket error types and log."),
+    "FL108": (
+        "debug output left in library code",
+        "`print(...)`, `breakpoint()`, and `jax.debug.print/breakpoint` in "
+        "library modules bypass the logging config (and `jax.debug.print` "
+        "inserts host callbacks into compiled programs -- a per-step "
+        "device->host sync)."),
+}
+
+#: FL107 only applies to transport/codec paths (broad handlers elsewhere
+#: are a judgement call; on the wire they corrupt rounds silently).
+#: Segment-anchored where needed: a bare "*comm*" would swallow
+#: experiments/common.py.
+_FL107_PATHS = ("*/comm/*", "*transport*", "*codec*", "*compression*",
+                "*mqtt*", "*tcp*")
+#: FL108 skips user-facing CLIs, where print IS the interface.
+_FL108_EXCLUDED = ("*/experiments/*", "*prepare.py", "*/scripts/*",
+                   "*cli.py")
+
+_NP_MODULE_NAMES = {"numpy"}
+_JAX_MODULE_NAMES = {"jax"}
+_JIT_NAMES = {"jit", "pmap"}
+_SYNC_BUILTINS = {"float", "int", "bool"}
+_NP_SYNC_ATTRS = {"asarray", "array"}
+_STRUCTURAL_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding"}
+_PYTREE_SINKS = {"stack", "concatenate", "vstack", "hstack", "tree_map",
+                 "map", "tree_unflatten", "unflatten"}
+_AGG_NAME_RE = re.compile(r"(?:^|_)(round|agg(?:regate)?\w*|server_update)"
+                          r"(?:_|$)|round_fn$")
+_LOG_CALL_NAMES = {"logging", "logger", "log", "warnings"}
+
+_DISABLE_RE = re.compile(
+    r"#\s*fedlint:\s*disable(?P<file>-file)?\s*(?:=\s*"
+    r"(?P<codes>[A-Za-z0-9_,\s]+))?")
+
+
+@dataclass
+class Finding:
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+    text: str = ""  # stripped source line, the baseline fingerprint
+    baselined: bool = False
+
+    def key(self):
+        """Baseline identity: line numbers shift on unrelated edits, so the
+        fingerprint is (path, code, source text)."""
+        return (self.path.replace(os.sep, "/"), self.code, self.text)
+
+    def as_dict(self):
+        return {"path": self.path.replace(os.sep, "/"), "line": self.line,
+                "col": self.col, "code": self.code, "message": self.message,
+                "text": self.text, "baselined": self.baselined}
+
+
+# -- suppression comments -------------------------------------------------
+
+def _parse_suppressions(src):
+    """-> (line -> set of codes or {"*"}, file-level set of codes/{"*"})."""
+    per_line, per_file = {}, set()
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(src).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _DISABLE_RE.search(tok.string)
+            if not m:
+                continue
+            codes = ({c.strip().upper() for c in m.group("codes").split(",")
+                      if c.strip()} if m.group("codes") else {"*"})
+            if m.group("file"):
+                per_file |= codes
+            else:
+                per_line.setdefault(tok.start[0], set()).update(codes)
+    except tokenize.TokenError:
+        pass  # syntax trouble surfaces via ast.parse, not here
+    return per_line, per_file
+
+
+def _suppressed(finding, per_line, per_file):
+    codes = per_line.get(finding.line, set()) | per_file
+    return "*" in codes or finding.code in codes
+
+
+# -- jit detection --------------------------------------------------------
+
+@dataclass
+class _JitSite:
+    func: ast.AST                      # FunctionDef / Lambda being traced
+    site: ast.AST                      # node to report jit-config rules at
+    kwargs: dict = field(default_factory=dict)   # jit-call keyword -> node
+
+
+class _Aliases:
+    """Import-alias resolution: which local names mean numpy / jax /
+    jax.numpy / functools.partial / jit."""
+
+    def __init__(self, tree):
+        self.np = set()
+        self.jax = set()
+        self.jnp = set()
+        self.partial = {"partial"}
+        self.jit_funcs = set()  # `from jax import jit, pmap` style
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = a.asname or a.name.split(".")[0]
+                    if a.name in _NP_MODULE_NAMES:
+                        self.np.add(local)
+                    elif a.name in _JAX_MODULE_NAMES:
+                        self.jax.add(local)
+                    elif a.name == "jax.numpy":
+                        self.jnp.add(a.asname or "jax")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module in _JAX_MODULE_NAMES:
+                    for a in node.names:
+                        if a.name in _JIT_NAMES:
+                            self.jit_funcs.add(a.asname or a.name)
+                if node.module == "jax.numpy":
+                    for a in node.names:
+                        self.jnp.add(a.asname or a.name)
+                if node.module == "functools":
+                    for a in node.names:
+                        if a.name == "partial":
+                            self.partial.add(a.asname or a.name)
+
+    def is_jit_ref(self, node):
+        """`jax.jit` / `jax.pmap` / bare `jit` (from-imported)."""
+        if isinstance(node, ast.Attribute) and node.attr in _JIT_NAMES:
+            v = node.value
+            return isinstance(v, ast.Name) and v.id in self.jax
+        return isinstance(node, ast.Name) and node.id in self.jit_funcs
+
+    def is_partial_ref(self, node):
+        if isinstance(node, ast.Name):
+            return node.id in self.partial
+        return (isinstance(node, ast.Attribute) and node.attr == "partial"
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "functools")
+
+    def is_np_attr(self, node, attrs=None):
+        """`np.<attr>` where np aliases real numpy (never jax.numpy)."""
+        return (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id in self.np
+                and node.value.id not in self.jnp
+                and (attrs is None or node.attr in attrs))
+
+
+def _jit_call_info(call, aliases):
+    """If ``call`` is a jit invocation (possibly through partial), return
+    its keyword dict, else None."""
+    if aliases.is_jit_ref(call.func):
+        return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+    if aliases.is_partial_ref(call.func) and call.args \
+            and aliases.is_jit_ref(call.args[0]):
+        return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+    return None
+
+
+def _collect_jit_sites(tree, aliases):
+    sites = []
+    defs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if aliases.is_jit_ref(dec):
+                    sites.append(_JitSite(node, node))
+                elif isinstance(dec, ast.Call):
+                    kwargs = _jit_call_info(dec, aliases)
+                    if kwargs is not None:
+                        sites.append(_JitSite(node, node, kwargs))
+        elif isinstance(node, ast.Call):
+            kwargs = _jit_call_info(node, aliases)
+            if kwargs is None or not node.args:
+                continue
+            target = node.args[0]
+            if isinstance(target, ast.Lambda):
+                sites.append(_JitSite(target, node, kwargs))
+            elif isinstance(target, ast.Name) and target.id in defs:
+                sites.append(_JitSite(defs[target.id], node, kwargs))
+    # dedup: `@partial(jax.jit, ...)` decorators are also Call nodes in the
+    # walk -- keyed by the traced function object, first site wins
+    seen, out = set(), []
+    for s in sites:
+        if id(s.func) not in seen:
+            seen.add(id(s.func))
+            out.append(s)
+    return out
+
+
+def _static_param_names(site):
+    names = set()
+    kw = site.kwargs.get("static_argnames")
+    if isinstance(kw, ast.Constant) and isinstance(kw.value, str):
+        names.add(kw.value)
+    elif isinstance(kw, (ast.Tuple, ast.List)):
+        names |= {e.value for e in kw.elts
+                  if isinstance(e, ast.Constant) and isinstance(e.value, str)}
+    nums = site.kwargs.get("static_argnums")
+    idxs = []
+    if isinstance(nums, ast.Constant) and isinstance(nums.value, int):
+        idxs = [nums.value]
+    elif isinstance(nums, (ast.Tuple, ast.List)):
+        idxs = [e.value for e in nums.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    params = _param_names(site.func)
+    for i in idxs:
+        if 0 <= i < len(params):
+            names.add(params[i])
+    return names
+
+
+def _param_names(func):
+    a = func.args
+    return ([p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+            + [p.arg for p in a.kwonlyargs])
+
+
+# -- per-rule checks ------------------------------------------------------
+
+def _tracer_name_uses(expr, params):
+    """Param Name nodes in ``expr`` used as *values* -- excluding static
+    accesses (`x.shape`, `x.ndim`, `len(x)`, `x is None`) that are legal
+    Python-control-flow inputs under trace."""
+    hits = []
+
+    def visit(node, parent):
+        if isinstance(node, ast.Name) and node.id in params:
+            if isinstance(parent, ast.Attribute) \
+                    and parent.attr in _STRUCTURAL_ATTRS:
+                return
+            if isinstance(parent, ast.Call) \
+                    and isinstance(parent.func, ast.Name) \
+                    and parent.func.id in ("len", "isinstance", "type") \
+                    and node in parent.args:
+                return
+            if isinstance(parent, ast.Compare) \
+                    and all(isinstance(op, (ast.Is, ast.IsNot))
+                            for op in parent.ops):
+                return
+            hits.append(node)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, node)
+
+    visit(expr, None)
+    return hits
+
+
+def _call_root_name(node):
+    """Dotted name of a call target, e.g. jnp.stack -> ('jnp', 'stack')."""
+    if isinstance(node, ast.Name):
+        return None, node.id
+    if isinstance(node, ast.Attribute):
+        base = node.value
+        root = base.id if isinstance(base, ast.Name) else (
+            _call_root_name(base)[1] if isinstance(base, ast.Attribute)
+            else None)
+        return root, node.attr
+    return None, None
+
+
+def _unsorted_dict_iter(node):
+    """First `.values()/.keys()/.items()` call in ``node`` that is not
+    wrapped in `sorted(...)` anywhere on its path."""
+    def visit(n, sorted_depth):
+        if isinstance(n, ast.Call):
+            f = n.func
+            if isinstance(f, ast.Name) and f.id in ("sorted", "dict",
+                                                    "OrderedDict"):
+                sorted_depth += 1
+            if (isinstance(f, ast.Attribute)
+                    and f.attr in ("values", "keys", "items")
+                    and not n.args and sorted_depth == 0):
+                return n
+        for child in ast.iter_child_nodes(n):
+            found = visit(child, sorted_depth)
+            if found is not None:
+                return found
+        return None
+    return visit(node, 0)
+
+
+class _ModuleLinter:
+    def __init__(self, path, src, tree):
+        self.path = path
+        self.src_lines = src.splitlines()
+        self.tree = tree
+        self.aliases = _Aliases(tree)
+        self.findings = []
+
+    def _line_text(self, lineno):
+        if 1 <= lineno <= len(self.src_lines):
+            return self.src_lines[lineno - 1].strip()
+        return ""
+
+    def add(self, node, code, message):
+        self.findings.append(Finding(
+            path=self.path, line=node.lineno,
+            col=getattr(node, "col_offset", 0) + 1, code=code,
+            message=message, text=self._line_text(node.lineno)))
+
+    def run(self):
+        sites = _collect_jit_sites(self.tree, self.aliases)
+        jitted_spans = []
+        for site in sites:
+            self._check_jit_body(site)
+            self._check_jit_config(site)
+            jitted_spans.append(site.func)
+        self._check_module_wide(jitted_spans)
+        return self.findings
+
+    # FL101 / FL102 / FL105: body of a traced function
+    def _check_jit_body(self, site):
+        params = set(_param_names(site.func)) - _static_param_names(site)
+        flagged_stmts = set()
+        for node in ast.walk(site.func):
+            if isinstance(node, ast.Call):
+                self._check_sync_call(node)
+                self._check_np_call(node)
+            elif isinstance(node, (ast.If, ast.While)) \
+                    and id(node) not in flagged_stmts:
+                if _tracer_name_uses(node.test, params):
+                    flagged_stmts.add(id(node))
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    self.add(node, "FL102",
+                             f"Python `{kind}` on traced argument inside "
+                             "jitted code -- use lax.cond/jnp.where or mark "
+                             "the argument static")
+            elif isinstance(node, ast.For) and id(node) not in flagged_stmts:
+                if _tracer_name_uses(node.iter, params):
+                    flagged_stmts.add(id(node))
+                    self.add(node, "FL102",
+                             "Python `for` over a traced argument inside "
+                             "jitted code -- use lax.scan/fori_loop or mark "
+                             "the bound static")
+
+    def _check_sync_call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "item" \
+                and not node.args:
+            self.add(node, "FL101", "`.item()` inside jitted code forces a "
+                                    "host sync (or fails on a tracer)")
+        elif isinstance(f, ast.Name) and f.id in _SYNC_BUILTINS \
+                and len(node.args) == 1 \
+                and not isinstance(node.args[0], ast.Constant):
+            self.add(node, "FL101",
+                     f"`{f.id}()` on a non-literal inside jitted code "
+                     "concretizes the value (host sync)")
+        elif self.aliases.is_np_attr(f, _NP_SYNC_ATTRS):
+            self.add(node, "FL101",
+                     f"`np.{f.attr}` inside jitted code pulls the traced "
+                     "value to host -- use jnp")
+        elif isinstance(f, ast.Attribute) and f.attr == "device_get":
+            self.add(node, "FL101", "`device_get` inside jitted code is a "
+                                    "blocking device->host transfer")
+
+    def _check_np_call(self, node):
+        f = node.func
+        if self.aliases.is_np_attr(f) and f.attr not in _NP_SYNC_ATTRS \
+                and f.attr not in ("float64", "double"):
+            self.add(node, "FL105",
+                     f"`np.{f.attr}` inside jitted code computes on host in "
+                     "float64 -- use the jnp equivalent")
+        for kw in node.keywords:
+            if kw.arg == "dtype" and self.aliases.is_np_attr(
+                    kw.value, ("float64", "double")):
+                self.add(kw.value, "FL105",
+                         "explicit float64 dtype in device code")
+        if self.aliases.is_np_attr(f, ("float64", "double")):
+            self.add(node, "FL105", "np.float64 cast in device code")
+
+    # FL103 / FL104: the jit call site configuration
+    def _check_jit_config(self, site):
+        func = site.func
+        if isinstance(func, ast.Lambda):
+            name = "<lambda>"
+            scalar_params = []
+        else:
+            name = func.name
+            scalar_params = self._scalar_params(func)
+        has_static = ("static_argnums" in site.kwargs
+                      or "static_argnames" in site.kwargs)
+        if scalar_params and not has_static:
+            self.add(site.site, "FL103",
+                     f"jit of `{name}` takes Python-scalar params "
+                     f"({', '.join(scalar_params)}) but no static_argnums/"
+                     "static_argnames -- retraces per value or freezes them")
+        has_donate = ("donate_argnums" in site.kwargs
+                      or "donate_argnames" in site.kwargs)
+        if name != "<lambda>" and _AGG_NAME_RE.search(name) \
+                and not has_donate:
+            self.add(site.site, "FL104",
+                     f"aggregation-path jit of `{name}` without "
+                     "donate_argnums -- the old and new model state stay "
+                     "live simultaneously (see fedml_tpu/parallel/)")
+
+    def _scalar_params(self, func):
+        out = []
+        a = func.args
+        pos = list(a.posonlyargs) + list(a.args)
+        defaults = [None] * (len(pos) - len(a.defaults)) + list(a.defaults)
+        for p, d in list(zip(pos, defaults)) + [
+                (p, d) for p, d in zip(a.kwonlyargs, a.kw_defaults)]:
+            ann = p.annotation
+            if isinstance(ann, ast.Name) and ann.id in ("int", "bool", "str"):
+                out.append(p.arg)
+            elif isinstance(d, ast.Constant) \
+                    and isinstance(d.value, (bool, int, str)) \
+                    and not isinstance(d.value, float):
+                out.append(p.arg)
+        return out
+
+    # FL106 / FL107 / FL108: module-wide
+    def _check_module_wide(self, jitted_funcs):
+        posix = self.path.replace(os.sep, "/")
+        fl107_scoped = any(fnmatch(posix, pat) for pat in _FL107_PATHS)
+        fl108_scoped = not any(fnmatch(posix, pat)
+                               for pat in _FL108_EXCLUDED)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                self._check_pytree_sink(node)
+                if fl108_scoped:
+                    self._check_debug_call(node)
+            elif isinstance(node, ast.ExceptHandler) and fl107_scoped:
+                self._check_except(node)
+
+    def _check_pytree_sink(self, node):
+        root, attr = _call_root_name(node.func)
+        if attr not in _PYTREE_SINKS:
+            return
+        if attr == "map" and root not in ("tree", "tree_util", "jax"):
+            return
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            hit = _unsorted_dict_iter(arg)
+            if hit is not None:
+                self.add(hit, "FL106",
+                         f"dict `.{hit.func.attr}()` order feeds "
+                         f"`{attr}` -- insertion order is process-dependent "
+                         "for restored/parsed dicts; wrap in sorted(...)")
+                return
+
+    def _check_debug_call(self, node):
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in ("print", "breakpoint"):
+            self.add(node, "FL108",
+                     f"`{f.id}()` in library code -- use logging")
+        elif isinstance(f, ast.Attribute) \
+                and f.attr in ("print", "breakpoint", "callback") \
+                and isinstance(f.value, ast.Attribute) \
+                and f.value.attr == "debug":
+            self.add(node, "FL108",
+                     f"`jax.debug.{f.attr}` left in library code -- a host "
+                     "callback in the compiled program")
+
+    def _check_except(self, node):
+        t = node.type
+        broad = t is None or (isinstance(t, ast.Name)
+                              and t.id in ("Exception", "BaseException"))
+        if not broad:
+            return
+        swallows = not any(
+            isinstance(n, ast.Raise) or self._is_log_call(n)
+            for n in ast.walk(node))
+        what = "bare `except:`" if t is None else f"`except {t.id}:`"
+        detail = ("silently swallows transport errors"
+                  if swallows else "hides the specific failure mode")
+        self.add(node, "FL107",
+                 f"{what} in comm/transport code {detail} -- catch the "
+                 "concrete decode/socket error types")
+
+    @staticmethod
+    def _is_log_call(node):
+        if not isinstance(node, ast.Call):
+            return False
+        root, attr = _call_root_name(node.func)
+        return root in _LOG_CALL_NAMES or attr in (
+            "warning", "error", "exception", "info", "debug", "warn")
+
+
+# -- driver ---------------------------------------------------------------
+
+def lint_source(src, path="<string>", select=None, ignore=None):
+    """Lint one module's source. Returns non-suppressed findings."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding(path=path, line=e.lineno or 1, col=(e.offset or 0),
+                        code="FL100", message=f"syntax error: {e.msg}")]
+    per_line, per_file = _parse_suppressions(src)
+    findings = _ModuleLinter(path, src, tree).run()
+    out = []
+    for f in findings:
+        if select and f.code not in select:
+            continue
+        if ignore and f.code in ignore:
+            continue
+        if _suppressed(f, per_line, per_file):
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.line, f.col, f.code))
+    return out
+
+
+def iter_python_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        yield os.path.join(root, name)
+
+
+def lint_paths(paths, select=None, ignore=None):
+    findings = []
+    for path in iter_python_files(paths):
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        rel = os.path.relpath(path)
+        findings.extend(lint_source(src, path=rel, select=select,
+                                    ignore=ignore))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return findings
+
+
+# -- baseline -------------------------------------------------------------
+
+def load_baseline(path):
+    """-> Counter of finding keys; empty when the file doesn't exist."""
+    if not path or not os.path.exists(path):
+        return Counter()
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    return Counter((e["path"], e["code"], e.get("text", ""))
+                   for e in data.get("findings", []))
+
+
+def apply_baseline(findings, baseline):
+    """Mark findings present in the baseline (multiset semantics: N
+    baselined occurrences tolerate N findings with the same fingerprint).
+    Returns the list of NEW findings."""
+    budget = Counter(baseline)
+    new = []
+    for f in findings:
+        if budget[f.key()] > 0:
+            budget[f.key()] -= 1
+            f.baselined = True
+        else:
+            new.append(f)
+    return new
+
+
+def write_baseline(findings, path):
+    entries = [{"path": f.path.replace(os.sep, "/"), "code": f.code,
+                "text": f.text} for f in findings]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "findings": entries}, fh, indent=2,
+                  sort_keys=True)
+        fh.write("\n")
+
+
+# -- reporters ------------------------------------------------------------
+
+def render_text(findings, show_baselined=False):
+    lines = []
+    for f in findings:
+        if f.baselined and not show_baselined:
+            continue
+        tag = " [baselined]" if f.baselined else ""
+        lines.append(f"{f.path}:{f.line}:{f.col}: {f.code} {f.message}{tag}")
+    new = sum(1 for f in findings if not f.baselined)
+    base = sum(1 for f in findings if f.baselined)
+    lines.append(f"fedlint: {len(findings)} finding(s) "
+                 f"({base} baselined, {new} new)")
+    return "\n".join(lines)
+
+
+def render_json(findings):
+    return json.dumps({
+        "findings": [f.as_dict() for f in findings],
+        "summary": {"total": len(findings),
+                    "baselined": sum(1 for f in findings if f.baselined),
+                    "new": sum(1 for f in findings if not f.baselined)},
+    }, indent=2)

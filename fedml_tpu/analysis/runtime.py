@@ -1,0 +1,195 @@
+"""Runtime retrace/transfer auditor.
+
+What the linter cannot see statically -- an argument whose shape changes
+every round, a cache key that silently includes a Python scalar -- shows up
+at runtime as recompilation. JAX announces every trace/compile through
+``jax.monitoring`` duration events; :func:`audit` counts them and buckets
+the counts per federated round at the round loops' single end-of-round
+sync point (``fedml_tpu.utils.profiling.end_of_round_sync``). A healthy
+run compiles in round 0 and never again: ``retraces_per_round`` is
+``[big, 0, 0, ...]``. Anything non-zero after round 0 is TPU time burned
+re-lowering the same program.
+
+The same sync point is armed with ``jax.transfer_guard``: the end-of-round
+``block_until_ready`` must not require *any* host<->device transfer, so a
+violation there means the aggregated state contains host-resident leaves
+(an accidental ``np.*`` in the aggregation path). Violations are counted,
+not raised -- the audit reports, the run continues. (On the CPU backend
+device buffers are host-visible, so device->host violations largely cannot
+trip there; the counter is exercised for real on TPU.)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+
+#: jax.monitoring event names (stable strings from jax._src.dispatch;
+#: hardcoded so the auditor never imports private modules at import time).
+TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_current = None
+
+
+def current_auditor():
+    """The auditor armed by the innermost active :func:`audit`, or None."""
+    return _current
+
+
+class RuntimeAuditor:
+    """Counts jaxpr traces / backend compiles and transfer-guard
+    violations, bucketed per round by :meth:`mark_round`."""
+
+    def __init__(self, transfer_guard="device_to_host"):
+        #: "device_to_host" (default: end-of-round sync must not pull
+        #: state to host), "all" (also flags implicit host->device uploads
+        #: -- noisy when rounds legitimately upload packed cohorts), or
+        #: None to disable guarding.
+        self.transfer_guard = transfer_guard
+        self.retraces_per_round = []
+        self.compiles_per_round = []
+        self.transfer_guard_violations = 0
+        self.rounds = 0
+        self._traces = 0
+        self._compiles = 0
+        self._off_traces = 0
+        self._off_compiles = 0
+        self._off_depth = 0
+        self._active = False
+
+    # registered with jax.monitoring for the audit's lifetime; stays cheap
+    # and inert once _active drops (listener dereg is best-effort)
+    def _on_event(self, event, duration_secs, **kwargs):
+        if not self._active:
+            return
+        if event == TRACE_EVENT:
+            if self._off_depth:
+                self._off_traces += 1
+            else:
+                self._traces += 1
+        elif event == COMPILE_EVENT:
+            if self._off_depth:
+                self._off_compiles += 1
+            else:
+                self._compiles += 1
+
+    @contextlib.contextmanager
+    def off_round(self):
+        """Book the enclosed work as off-round (trailing) instead of
+        charging the *next* round's bucket. The round loops wrap their
+        periodic eval in this: eval runs after the round's sync, so its
+        first-time compile would otherwise surface as a phantom retrace
+        in the following round -- the exact false positive the
+        steady-state gate must not have."""
+        self._off_depth += 1
+        try:
+            yield
+        finally:
+            self._off_depth -= 1
+
+    def mark_round(self):
+        """Close the current round's bucket. Round 0's bucket holds the
+        initial compilation; later buckets should be zero."""
+        self.retraces_per_round.append(self._traces)
+        self.compiles_per_round.append(self._compiles)
+        self._traces = 0
+        self._compiles = 0
+        self.rounds += 1
+
+    @contextlib.contextmanager
+    def guard(self, mode="disallow"):
+        """Arm the configured transfer guard around a block; a guard trip
+        is counted as a violation and logged, not propagated."""
+        if self.transfer_guard is None:
+            yield
+            return
+        import jax
+        arm = (jax.transfer_guard if self.transfer_guard == "all"
+               else jax.transfer_guard_device_to_host)
+        try:
+            with arm(mode):
+                yield
+        except Exception as e:
+            if "transfer" not in str(e).lower():
+                raise
+            self.transfer_guard_violations += 1
+            logging.warning("audit: guarded transfer violation: %s", e)
+
+    def sync_and_mark_round(self, state):
+        """End-of-round hook: block on the round's outputs under the
+        transfer guard, then close the round's trace bucket."""
+        import jax
+        try:
+            with self.guard():
+                jax.block_until_ready(state)
+        finally:
+            # a violation aborts block_until_ready mid-tree: redo the sync
+            # unguarded so callers still get the barrier they asked for
+            jax.block_until_ready(state)
+        self.mark_round()
+        return state
+
+    def report(self):
+        steady = sum(self.retraces_per_round[1:])
+        return {
+            "audit/rounds": self.rounds,
+            "audit/retraces_per_round": list(self.retraces_per_round),
+            "audit/compiles_per_round": list(self.compiles_per_round),
+            # the headline number: traces after round 0 == recompilation
+            # of programs that should have been cache-hits
+            "audit/steady_state_retraces": steady,
+            # activity outside any round bucket (periodic/final eval,
+            # teardown): kept separate so it never masquerades as a round
+            # retrace
+            "audit/trailing_traces": self._off_traces + self._traces,
+            "audit/trailing_compiles": self._off_compiles + self._compiles,
+            "audit/transfer_guard_violations":
+                self.transfer_guard_violations,
+        }
+
+
+@contextlib.contextmanager
+def audit(metrics_logger=None, enabled=True, transfer_guard="device_to_host"):
+    """Audit the enclosed run; yields the :class:`RuntimeAuditor` (or None
+    when ``enabled`` is falsy, so ``--audit`` wires straight through).
+
+    On exit the report is pushed to ``metrics_logger`` (any callable taking
+    a dict -- a :class:`~fedml_tpu.utils.metrics.MetricsLogger` fits) and
+    logged. Round bucketing needs the round loop to pass through
+    ``end_of_round_sync``; activity that lands outside any round (the
+    final eval, code that never syncs) is reported as trailing counts."""
+    global _current
+    if not enabled:
+        yield None
+        return
+    from jax import monitoring
+    auditor = RuntimeAuditor(transfer_guard=transfer_guard)
+    auditor._active = True
+    monitoring.register_event_duration_secs_listener(auditor._on_event)
+    prev, _current = _current, auditor
+    try:
+        yield auditor
+    finally:
+        _current = prev
+        auditor._active = False
+        _unregister(auditor._on_event)
+        report = auditor.report()
+        logging.info("runtime audit: %s", report)
+        if metrics_logger is not None:
+            metrics_logger(report)
+
+
+def _unregister(callback):
+    """Best-effort listener removal: jax only exposes clear-all publicly,
+    so reach for the testing hook and fall back to leaving the (inert)
+    listener registered on API drift."""
+    try:
+        from jax._src import monitoring as _mon
+        _mon._unregister_event_duration_listener_by_callback(callback)
+    except Exception:
+        logging.debug("audit: could not unregister monitoring listener")
+
+
+__all__ = ["RuntimeAuditor", "audit", "current_auditor",
+           "TRACE_EVENT", "COMPILE_EVENT"]

@@ -44,7 +44,7 @@ _ND_KEY = "__nd__"
 try:  # bfloat16 is a first-class wire dtype when ml_dtypes is present
     import ml_dtypes
     _EXTRA_DTYPES = {"bfloat16": np.dtype(ml_dtypes.bfloat16)}
-except Exception:  # pragma: no cover - baked image ships ml_dtypes
+except ImportError:  # pragma: no cover - baked image ships ml_dtypes
     _EXTRA_DTYPES = {}
 
 

@@ -30,7 +30,7 @@ from fedml_tpu.core.message import Message
 try:  # pragma: no cover - optional dependency
     import paho.mqtt.client as mqtt
     _HAS_PAHO = True
-except Exception:  # pragma: no cover
+except ImportError:  # pragma: no cover
     mqtt = None
     _HAS_PAHO = False
 

@@ -289,9 +289,13 @@ class TcpCommManager(BaseCommunicationManager):
             self.bytes_received += len(frame)
             try:
                 msg = message_from_wire(frame)
-            except Exception:
-                # malformed payload (corrupt bytes, version skew): same
-                # story -- treat the peer as lost, loudly
+            except (ValueError, KeyError, IndexError, TypeError,
+                    struct.error, UnicodeDecodeError):
+                # malformed payload (corrupt bytes, version skew, unknown
+                # wire dtype, truncated array-frame list -> IndexError):
+                # the concrete decode failures the codec can raise --
+                # treat the peer as lost, loudly. Anything else is a
+                # codec bug and should crash this serve thread.
                 logging.exception("tcp hub: undecodable frame from rank "
                                   "%s", peer_rank)
                 self._drop_peer(peer_rank, lost=True)
@@ -312,10 +316,13 @@ class TcpCommManager(BaseCommunicationManager):
             if receiver == 0:
                 try:
                     keep = self._dispatch(msg)
-                except Exception:
-                    # a broken FSM handler must not silently kill this
-                    # peer's serve thread (the hub would stop reading a
-                    # healthy client forever)
+                except (AttributeError, KeyError, IndexError, TypeError,
+                        ValueError, ArithmeticError):
+                    # a buggy FSM handler (bad lookup, shape/type mismatch)
+                    # must not silently kill this peer's serve thread --
+                    # the hub would stop reading a healthy client forever.
+                    # Infrastructure failures (OSError, MemoryError, ...)
+                    # are NOT survivable-by-logging and propagate.
                     logging.exception(
                         "tcp hub: handler error for type=%s from rank %s",
                         msg.get_type(), peer_rank)

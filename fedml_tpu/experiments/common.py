@@ -106,6 +106,14 @@ def add_base_args(parser: argparse.ArgumentParser):
                    help="resume from latest checkpoint in --checkpoint_dir")
     p.add_argument("--profile_dir", type=str, default=None,
                    help="write a jax.profiler trace of the round loop here")
+    p.add_argument("--audit", type=int, default=0,
+                   help="runtime retrace/transfer audit "
+                        "(fedml_tpu.analysis.runtime): count jit "
+                        "(re)traces per round and arm jax.transfer_guard "
+                        "around the end-of-round sync; the report "
+                        "(audit/retraces_per_round, "
+                        "audit/transfer_guard_violations, ...) goes to the "
+                        "metrics sink at the end of the run")
     # synthetic-dataset size overrides (CI / bench knobs; ignored by
     # file-backed loaders)
     p.add_argument("--n_train", type=int, default=None)
@@ -151,6 +159,25 @@ class _LogOnlySink:
 
     def close(self, *a, **kw):
         return None
+
+
+def audit_scope(args, logger, wired=True):
+    """``--audit`` context for the experiment mains: arms the runtime
+    retrace/transfer auditor (``fedml_tpu.analysis.runtime.audit``) with
+    the run's metrics sink. Mains whose algorithm loop has no
+    ``end_of_round_sync`` interception point yet pass ``wired=False``:
+    the flag then warns loudly instead of being silently ignored or
+    producing a misleading zero-round report."""
+    from fedml_tpu.analysis.runtime import audit
+
+    enabled = bool(getattr(args, "audit", 0))
+    if enabled and not wired:
+        logging.warning(
+            "--audit is not wired for this entry point (its round loop "
+            "has no end_of_round_sync interception point yet); ignoring "
+            "the flag")
+        enabled = False
+    return audit(metrics_logger=logger, enabled=enabled)
 
 
 def make_mesh(args):
@@ -259,7 +286,8 @@ def run_fedavg_family(api, args, logger):
                       data_rng=api_._data_rng)
 
     with profile_trace(args.profile_dir, enabled=args.profile_dir is not None):
-        api.train(on_round=on_round)
+        with audit_scope(args, logger):
+            api.train(on_round=on_round)
     if ckpt is not None:
         ckpt.close()
     return api.global_state

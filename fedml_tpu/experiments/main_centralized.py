@@ -22,7 +22,8 @@ def main(argv=None):
 
     from fedml_tpu.algorithms.centralized import CentralizedTrainer
     trainer = CentralizedTrainer(dataset, spec, args, metrics_logger=logger)
-    state = trainer.train()
+    with common.audit_scope(args, logger):
+        state = trainer.train()
     logger.close()
     return trainer, state
 

@@ -46,7 +46,8 @@ def main(argv=None):
     from fedml_tpu.algorithms.decentralized import DecentralizedFedAPI
     api = DecentralizedFedAPI(dataset, spec, args, topology=topology,
                               algorithm=args.algorithm, metrics_logger=logger)
-    states = api.train()
+    with common.audit_scope(args, logger, wired=False):
+        states = api.train()
     logger.close()
     return api, states
 
@@ -71,7 +72,8 @@ def _online_main(args):
         DecentralizedOnlineAPI)
     api = DecentralizedOnlineAPI(streams, args, algorithm=args.algorithm,
                                  metrics_logger=logger)
-    w = api.train()
+    with common.audit_scope(args, logger, wired=False):
+        w = api.train()
     logger.close()
     return api, w
 

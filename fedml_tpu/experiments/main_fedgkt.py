@@ -34,7 +34,8 @@ def main(argv=None):
     from fedml_tpu.algorithms.fedgkt import FedGKTAPI
     api = FedGKTAPI(dataset, client_model, server_model, args,
                     metrics_logger=logger)
-    api.train()
+    with common.audit_scope(args, logger, wired=False):
+        api.train()
     logger.close()
     return api, api.server_state
 

@@ -102,15 +102,17 @@ def main(argv=None):
 
     steps = args.steps or args.comm_round
     t0, losses = time.time(), []
-    for step in range(steps):
-        lo = (step * B) % max(len(data) - B + 1, 1)
-        idx = jnp.asarray(data[lo:lo + B], jnp.int32)
-        params, opt_state, loss = step_fn(params, opt_state, idx,
-                                          shift_targets(idx))
-        losses.append(float(loss))
-        logger.log({"step": step, "Train/Loss": losses[-1],
-                    "tokens_per_s": B * T * (step + 1) / (time.time() - t0),
-                    "mesh": f"{args.n_data}x{n_seq}"})
+    with common.audit_scope(args, logger, wired=False):
+        for step in range(steps):
+            lo = (step * B) % max(len(data) - B + 1, 1)
+            idx = jnp.asarray(data[lo:lo + B], jnp.int32)
+            params, opt_state, loss = step_fn(params, opt_state, idx,
+                                              shift_targets(idx))
+            losses.append(float(loss))
+            logger.log({"step": step, "Train/Loss": losses[-1],
+                        "tokens_per_s": B * T * (step + 1)
+                        / (time.time() - t0),
+                        "mesh": f"{args.n_data}x{n_seq}"})
     logger.close()
     return params, losses
 

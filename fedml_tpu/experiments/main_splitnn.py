@@ -61,7 +61,8 @@ def main(argv=None):
 
     from fedml_tpu.algorithms.splitnn import SplitNNAPI
     api = SplitNNAPI(dataset, stem, head, args, metrics_logger=logger)
-    api.train()
+    with common.audit_scope(args, logger, wired=False):
+        api.train()
     logger.close()
     return api, api.server_params
 

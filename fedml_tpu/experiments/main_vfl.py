@@ -69,7 +69,8 @@ def main(argv=None):
     from fedml_tpu.algorithms.vertical import VerticalFLAPI
     api = VerticalFLAPI(party_models, party_data, y_train, args,
                         test_party_data=test_party_data, test_labels=y_test)
-    history = api.fit()
+    with common.audit_scope(args, logger, wired=False):
+        history = api.fit()
     for record in history:
         logger(record)
     logger.close()

@@ -11,7 +11,9 @@ logger, orbax checkpoint/resume, and ``jax.profiler`` trace hooks.
 from fedml_tpu.utils.logging_utils import init_logging
 from fedml_tpu.utils.metrics import MetricsLogger
 from fedml_tpu.utils.checkpoint import Checkpointer
-from fedml_tpu.utils.profiling import profile_trace, annotate_step
+from fedml_tpu.utils.profiling import (annotate_step, end_of_round_sync,
+                                       off_round_work, profile_trace)
 
 __all__ = ["init_logging", "MetricsLogger", "Checkpointer",
-           "profile_trace", "annotate_step"]
+           "profile_trace", "annotate_step", "end_of_round_sync",
+           "off_round_work"]

@@ -35,3 +35,37 @@ def annotate_step(round_idx):
     ``with annotate_step(r): round_fn(...)``."""
     import jax
     return jax.profiler.StepTraceAnnotation("fed_round", step_num=round_idx)
+
+
+def end_of_round_sync(state):
+    """The round loops' single end-of-round host sync: block until the
+    round's outputs are materialized, so ``round_time_s`` measures device
+    work instead of dispatch latency. Every algorithm's round loop funnels
+    through here rather than calling ``jax.block_until_ready`` ad hoc --
+    it is the one interception point the runtime auditor
+    (``fedml_tpu.analysis.runtime.audit``) uses to bucket (re)trace counts
+    per round and arm the transfer guard. Returns ``state``."""
+    from fedml_tpu.analysis.runtime import current_auditor
+
+    auditor = current_auditor()
+    if auditor is not None:
+        return auditor.sync_and_mark_round(state)
+    import jax
+    jax.block_until_ready(state)
+    return state
+
+
+@contextlib.contextmanager
+def off_round_work():
+    """Mark host-driven work that legitimately falls between federated
+    rounds (periodic eval, checkpoint restore). No-op normally; under an
+    active runtime auditor the work's compile/trace events are booked as
+    trailing instead of polluting the next round's retrace bucket."""
+    from fedml_tpu.analysis.runtime import current_auditor
+
+    auditor = current_auditor()
+    if auditor is None:
+        yield
+        return
+    with auditor.off_round():
+        yield

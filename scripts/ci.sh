@@ -8,6 +8,10 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+echo "== fedlint gate (JAX/FL static analysis, fedml_tpu/analysis;"
+echo "   fails on findings not in fedml_tpu/analysis/fedlint_baseline.json) =="
+python -m fedml_tpu.analysis fedml_tpu/
+
 echo "== fast test tier (engine / core / utils / native / data-extra / online;"
 echo "   includes the federated==centralized + wave/lane==flat equivalence asserts) =="
 python -m pytest tests/ -q -m "not slow" -p no:cacheprovider
@@ -16,16 +20,26 @@ echo "== codec size-regression gate (binary framing >= 5x smaller than"
 echo "   JSON lists for a ResNet-sized pytree; bench.py --check) =="
 python bench.py --check
 
-echo "== CLI smoke: --ci equivalence run (reference CI-script-fedavg.sh) =="
+echo "== CLI smoke: --ci equivalence run under --audit (reference"
+echo "   CI-script-fedavg.sh); gates on zero steady-state retraces and"
+echo "   zero guarded-transfer violations =="
 python - <<'EOF'
 import jax
 jax.config.update("jax_platforms", "cpu")  # CI hosts have no TPU tunnel
 from fedml_tpu.experiments import main_fedavg
-main_fedavg.main([
-    "--dataset", "synthetic", "--model", "lr", "--comm_round", "2",
-    "--epochs", "1", "--client_num_in_total", "4",
-    "--client_num_per_round", "4", "--batch_size", "-1", "--ci", "1"])
-print("CI CLI smoke: OK")
+from fedml_tpu.analysis.runtime import audit
+
+report = {}
+with audit(metrics_logger=report.update) as auditor:
+    main_fedavg.main([
+        "--dataset", "synthetic", "--model", "lr", "--comm_round", "2",
+        "--epochs", "1", "--client_num_in_total", "4",
+        "--client_num_per_round", "4", "--batch_size", "-1", "--ci", "1"])
+assert report["audit/rounds"] == 2, report
+assert report["audit/steady_state_retraces"] == 0, (
+    "round loop retraced after warm-up", report)
+assert report["audit/transfer_guard_violations"] == 0, report
+print("CI CLI smoke + runtime audit: OK", report)
 EOF
 
 echo "ci.sh: all green"

@@ -1,0 +1,494 @@
+"""fedlint static rules + runtime retrace/transfer auditor.
+
+Every lint rule gets a positive (finding fires) and negative (clean idiom
+stays clean) snippet; the runtime auditor is exercised on real 2-round
+FedAvg simulations -- one healthy (zero steady-state retraces), one with
+an intentionally-introduced retrace (batch size changed between rounds)
+that the auditor must catch.
+"""
+
+import json
+import os
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu import models
+from fedml_tpu.algorithms.fedavg import FedAvgAPI
+from fedml_tpu.algorithms.specs import make_classification_spec
+from fedml_tpu.analysis import RULES, audit, current_auditor, lint_source
+from fedml_tpu.analysis.cli import main as fedlint_main
+from fedml_tpu.analysis.linter import (apply_baseline, lint_paths,
+                                       load_baseline, render_json,
+                                       render_text, write_baseline)
+from fedml_tpu.data import load_synthetic_federated
+from fedml_tpu.utils.profiling import end_of_round_sync
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+COMM_PATH = "fedml_tpu/core/comm/fake.py"  # in FL107's transport scope
+LIB_PATH = "fedml_tpu/core/fake.py"
+
+
+def codes(src, path=LIB_PATH):
+    return [f.code for f in lint_source(src, path=path)]
+
+
+class TestLintRules:
+    def test_rule_catalog_has_at_least_seven_codes(self):
+        assert len(RULES) >= 7
+        assert all(code.startswith("FL") for code in RULES)
+
+    # FL101 ---------------------------------------------------------------
+    def test_fl101_host_sync_in_jit(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return float(x) + x.item()\n")
+        assert codes(src) == ["FL101", "FL101"]
+
+    def test_fl101_np_asarray_in_jit(self):
+        src = (
+            "import jax\n"
+            "import numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return np.asarray(x)\n")
+        assert codes(src) == ["FL101"]
+
+    def test_fl101_negative_outside_jit_and_literals(self):
+        src = (
+            "import jax\n"
+            "def g(x):\n"
+            "    return float(x)\n"  # not jitted: a legitimate host read
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return x * float(2)\n")  # literal: no sync
+        assert codes(src) == []
+
+    # FL102 ---------------------------------------------------------------
+    def test_fl102_if_on_tracer(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    if x > 0:\n"
+            "        return x\n"
+            "    return -x\n")
+        assert codes(src) == ["FL102"]
+
+    def test_fl102_for_over_tracer(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(xs):\n"
+            "    acc = 0\n"
+            "    for x in xs:\n"
+            "        acc = acc + x\n"
+            "    return acc\n")
+        assert codes(src) == ["FL102"]
+
+    def test_fl102_negative_structural_and_none_checks(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x, mask=None):\n"
+            "    if mask is None:\n"       # identity check: static
+            "        return x\n"
+            "    if x.shape[0] > 2:\n"     # shape: static under trace
+            "        return x + 1\n"
+            "    for i in range(3):\n"     # static bound
+            "        x = x + i\n"
+            "    return x\n")
+        assert codes(src) == []
+
+    def test_fl102_static_argname_params_exempt(self):
+        src = (
+            "import jax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, static_argnames=('n',))\n"
+            "def f(x, n):\n"
+            "    if n > 2:\n"
+            "        return x\n"
+            "    return -x\n")
+        assert codes(src) == []
+
+    # FL103 ---------------------------------------------------------------
+    def test_fl103_scalar_params_without_static(self):
+        src = (
+            "import jax\n"
+            "def g(x, n=4):\n"
+            "    return x * n\n"
+            "step = jax.jit(g)\n")
+        assert codes(src) == ["FL103"]
+
+    def test_fl103_negative_with_static_argnums(self):
+        src = (
+            "import jax\n"
+            "def g(x, n=4):\n"
+            "    return x * n\n"
+            "step = jax.jit(g, static_argnums=(1,))\n")
+        assert codes(src) == []
+
+    # FL104 ---------------------------------------------------------------
+    def test_fl104_aggregation_jit_without_donation(self):
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def round_fn(state, data):\n"
+            "    return state\n")
+        assert codes(src) == ["FL104"]
+
+    def test_fl104_negative_donated_or_not_aggregation(self):
+        src = (
+            "import jax\n"
+            "from functools import partial\n"
+            "@partial(jax.jit, donate_argnums=(0,))\n"
+            "def round_fn(state, data):\n"
+            "    return state\n"
+            "@jax.jit\n"
+            "def predict(state, data):\n"  # not an aggregation name
+            "    return state\n")
+        assert codes(src) == []
+
+    # FL105 ---------------------------------------------------------------
+    def test_fl105_numpy_compute_in_jit(self):
+        src = (
+            "import jax\n"
+            "import numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return np.mean(x)\n")
+        assert codes(src) == ["FL105"]
+
+    def test_fl105_float64_dtype_in_jit(self):
+        src = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "import numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return jnp.zeros((2,), dtype=np.float64) + x\n")
+        assert codes(src) == ["FL105"]
+
+    def test_fl105_negative_jnp_inside_np_outside(self):
+        src = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "import numpy as np\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return jnp.mean(x)\n"
+            "def pack(x):\n"
+            "    return np.mean(x)\n")  # host-side packing: numpy is right
+        assert codes(src) == []
+
+    # FL106 ---------------------------------------------------------------
+    def test_fl106_dict_values_into_stack(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "def f(d):\n"
+            "    return jnp.stack(list(d.values()))\n")
+        assert codes(src) == ["FL106"]
+
+    def test_fl106_negative_sorted_iteration(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "def f(d):\n"
+            "    return jnp.stack([v for _, v in sorted(d.items())])\n")
+        assert codes(src) == []
+
+    # FL107 ---------------------------------------------------------------
+    def test_fl107_broad_except_in_comm_code(self):
+        src = (
+            "def recv(sock):\n"
+            "    try:\n"
+            "        return sock.recv(4)\n"
+            "    except Exception:\n"
+            "        pass\n")
+        assert codes(src, path=COMM_PATH) == ["FL107"]
+        assert "swallows" in lint_source(src, path=COMM_PATH)[0].message
+
+    def test_fl107_scoped_to_transport_paths(self):
+        src = (
+            "def f():\n"
+            "    try:\n"
+            "        return 1\n"
+            "    except Exception:\n"
+            "        pass\n")
+        assert codes(src, path="fedml_tpu/models/cnn.py") == []
+        # segment-anchored: "common.py" must not match the comm scope
+        assert codes(src, path="fedml_tpu/experiments/common.py") == []
+
+    def test_fl107_negative_specific_types(self):
+        src = (
+            "import logging\n"
+            "def recv(sock):\n"
+            "    try:\n"
+            "        return sock.recv(4)\n"
+            "    except (OSError, ConnectionError):\n"
+            "        logging.warning('peer died')\n")
+        assert codes(src, path=COMM_PATH) == []
+
+    # FL108 ---------------------------------------------------------------
+    def test_fl108_debug_output_in_library(self):
+        src = (
+            "import jax\n"
+            "def f(x):\n"
+            "    print('x =', x)\n"
+            "    jax.debug.print('traced {}', x)\n"
+            "    return x\n")
+        assert codes(src) == ["FL108", "FL108"]
+
+    def test_fl108_negative_cli_paths_exempt(self):
+        src = "def main():\n    print('usage: ...')\n"
+        assert codes(src, path="fedml_tpu/experiments/main_fedavg.py") == []
+        assert codes(src, path="fedml_tpu/data/prepare.py") == []
+
+    def test_syntax_error_reported_not_raised(self):
+        assert codes("def f(:\n") == ["FL100"]
+
+
+class TestSuppressions:
+    SRC = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x)  # fedlint: disable=FL101\n")
+
+    def test_line_suppression(self):
+        assert codes(self.SRC) == []
+
+    def test_line_suppression_is_code_specific(self):
+        src = self.SRC.replace("FL101", "FL105")
+        assert codes(src) == ["FL101"]
+
+    def test_bare_disable_suppresses_all_codes(self):
+        src = self.SRC.replace("disable=FL101", "disable")
+        assert codes(src) == []
+
+    def test_file_level_suppression(self):
+        src = ("# fedlint: disable-file=FL101\n"
+               + self.SRC.replace("  # fedlint: disable=FL101", ""))
+        assert codes(src) == []
+
+
+class TestBaseline:
+    SRC = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def round_fn(state, data):\n"
+        "    return state\n")
+
+    def _findings(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text(self.SRC)
+        return lint_paths([str(mod)])
+
+    def test_baseline_roundtrip_tolerates_known_findings(self, tmp_path):
+        findings = self._findings(tmp_path)
+        assert [f.code for f in findings] == ["FL104"]
+        bl = tmp_path / "baseline.json"
+        write_baseline(findings, str(bl))
+        fresh = self._findings(tmp_path)
+        new = apply_baseline(fresh, load_baseline(str(bl)))
+        assert new == [] and fresh[0].baselined
+
+    def test_new_findings_not_in_baseline_fail(self, tmp_path):
+        bl = tmp_path / "baseline.json"
+        write_baseline([], str(bl))
+        new = apply_baseline(self._findings(tmp_path),
+                            load_baseline(str(bl)))
+        assert [f.code for f in new] == ["FL104"]
+
+    def test_baseline_keys_on_text_not_line_numbers(self, tmp_path):
+        findings = self._findings(tmp_path)
+        bl = tmp_path / "baseline.json"
+        write_baseline(findings, str(bl))
+        # unrelated edit above the finding shifts every line number
+        (tmp_path / "mod.py").write_text("# a new leading comment\n"
+                                         + self.SRC)
+        new = apply_baseline(self._findings(tmp_path),
+                            load_baseline(str(bl)))
+        assert new == []
+
+    def test_missing_baseline_file_is_empty(self, tmp_path):
+        assert load_baseline(str(tmp_path / "nope.json")) == {}
+
+
+class TestCli:
+    SRC = TestBaseline.SRC
+
+    def test_exit_1_on_new_findings_0_with_baseline(self, tmp_path, capsys):
+        mod = tmp_path / "mod.py"
+        mod.write_text(self.SRC)
+        bl = tmp_path / "baseline.json"
+        assert fedlint_main([str(mod), "--baseline", ""]) == 1
+        assert fedlint_main([str(mod), "--baseline", str(bl),
+                             "--write-baseline"]) == 0
+        assert fedlint_main([str(mod), "--baseline", str(bl)]) == 0
+        capsys.readouterr()
+
+    def test_json_reporter(self, tmp_path, capsys):
+        mod = tmp_path / "mod.py"
+        mod.write_text(self.SRC)
+        rc = fedlint_main([str(mod), "--baseline", "", "--format", "json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert out["summary"]["new"] == 1
+        assert out["findings"][0]["code"] == "FL104"
+
+    def test_select_and_ignore(self, tmp_path, capsys):
+        mod = tmp_path / "mod.py"
+        mod.write_text(self.SRC)
+        assert fedlint_main([str(mod), "--baseline", "",
+                             "--select", "FL101"]) == 0
+        assert fedlint_main([str(mod), "--baseline", "",
+                             "--ignore", "FL104"]) == 0
+        capsys.readouterr()
+
+    def test_list_rules(self, capsys):
+        assert fedlint_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for code in RULES:
+            assert code in out
+
+    def test_reporters_render(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text(self.SRC)
+        findings = lint_paths([str(mod)])
+        assert "FL104" in render_text(findings)
+        assert json.loads(render_json(findings))["summary"]["total"] == 1
+
+    def test_repo_is_clean_against_shipped_baseline(self, monkeypatch,
+                                                    capsys):
+        # the ci.sh gate, as a test: the tree must lint clean against the
+        # checked-in baseline -- new antipatterns fail here first
+        monkeypatch.chdir(REPO_ROOT)
+        assert fedlint_main(["fedml_tpu"]) == 0
+        capsys.readouterr()
+
+    def test_default_baseline_is_package_anchored(self):
+        # the installed `fedlint` entry point must resolve its baseline
+        # from any cwd, not relative to wherever it was launched
+        from fedml_tpu.analysis.cli import DEFAULT_BASELINE
+        assert os.path.isabs(DEFAULT_BASELINE)
+        assert os.path.exists(DEFAULT_BASELINE)
+
+
+# -- runtime auditor ------------------------------------------------------
+
+def _args(**kw):
+    base = dict(client_num_per_round=2, comm_round=2, epochs=1,
+                batch_size=16, lr=0.3, client_optimizer="sgd", wd=0.0,
+                frequency_of_the_test=100, ci=0, seed=0)
+    base.update(kw)
+    return types.SimpleNamespace(**base)
+
+
+def _spec():
+    return make_classification_spec(
+        models.LogisticRegression(num_classes=10, apply_sigmoid=False),
+        jnp.zeros((1, 60)))
+
+
+def _dataset():
+    return load_synthetic_federated(client_num=2, n_train=80, n_test=20,
+                                    alpha=0.0, beta=0.0, seed=0)
+
+
+class TestRuntimeAuditor:
+    def test_healthy_two_round_fedavg_no_steady_state_retraces(self):
+        api = FedAvgAPI(_dataset(), _spec(), _args())
+        with audit() as auditor:
+            api.train_one_round()
+            api.train_one_round()
+        report = auditor.report()
+        assert report["audit/rounds"] == 2
+        assert len(report["audit/retraces_per_round"]) == 2
+        assert report["audit/retraces_per_round"][0] > 0  # warm-up compile
+        assert report["audit/steady_state_retraces"] == 0
+        assert report["audit/transfer_guard_violations"] == 0
+
+    def test_detects_intentional_retrace(self):
+        # shrinking the batch size between rounds changes the packed
+        # cohort shapes -> round 2 must re-trace, and the auditor must see
+        # it in round 2's bucket
+        api = FedAvgAPI(_dataset(), _spec(), _args())
+        with audit() as auditor:
+            api.train_one_round()
+            api.args.batch_size = 8
+            api.train_one_round()
+        assert auditor.retraces_per_round[1] > 0
+        assert auditor.report()["audit/steady_state_retraces"] > 0
+
+    def test_transfer_guard_violation_counted_not_raised(self):
+        with audit(transfer_guard="all") as auditor:
+            with auditor.guard():
+                jnp.ones((4,)) + np.ones((4,), np.float32)  # implicit h2d
+        assert auditor.transfer_guard_violations == 1
+
+    def test_report_goes_to_metrics_logger(self):
+        records = []
+        with audit(metrics_logger=records.append) as auditor:
+            jax.block_until_ready(jax.jit(lambda x: x + 1)(jnp.ones(3)))
+            auditor.mark_round()
+        assert len(records) == 1
+        assert records[0]["audit/rounds"] == 1
+        assert records[0]["audit/retraces_per_round"][0] > 0
+
+    def test_disabled_audit_yields_none(self):
+        with audit(enabled=False) as auditor:
+            assert auditor is None
+        assert current_auditor() is None
+
+    def test_end_of_round_sync_without_auditor(self):
+        state = jax.jit(lambda x: x * 2)(jnp.ones(3))
+        assert end_of_round_sync(state) is state
+
+    def test_end_of_round_sync_marks_rounds_on_active_auditor(self):
+        with audit() as auditor:
+            end_of_round_sync(jnp.ones(3))
+            end_of_round_sync(jnp.ones(3))
+        assert auditor.rounds == 2
+
+    def test_midrun_eval_does_not_pollute_round_buckets(self):
+        # eval runs BETWEEN round syncs (frequency_of_the_test=1 fires it
+        # after every round): its first-time compile must be booked as
+        # trailing, not as a phantom retrace in the next round's bucket
+        api = FedAvgAPI(_dataset(), _spec(),
+                        _args(frequency_of_the_test=1))
+        with audit() as auditor:
+            api.train()
+        report = auditor.report()
+        assert report["audit/rounds"] == 2
+        assert report["audit/steady_state_retraces"] == 0
+        assert report["audit/trailing_traces"] > 0  # the eval compile
+        assert report["audit/transfer_guard_violations"] == 0
+
+    def test_off_round_work_without_auditor_is_noop(self):
+        from fedml_tpu.utils.profiling import off_round_work
+        with off_round_work():
+            pass
+        assert current_auditor() is None
+
+    def test_trailing_activity_reported_separately(self):
+        with audit() as auditor:
+            end_of_round_sync(jnp.ones(3))
+            jax.block_until_ready(jax.jit(lambda x: x - 1)(jnp.ones(7)))
+        report = auditor.report()
+        assert report["audit/rounds"] == 1
+        assert report["audit/trailing_traces"] > 0
+        # post-round work (final eval, teardown) is not a round retrace
+        assert report["audit/steady_state_retraces"] == 0
+
+    def test_nested_audit_restores_outer(self):
+        with audit() as outer:
+            with audit() as inner:
+                assert current_auditor() is inner
+            assert current_auditor() is outer
+        assert current_auditor() is None
