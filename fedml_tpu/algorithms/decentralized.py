@@ -121,7 +121,7 @@ class DecentralizedFedAPI:
             mixed = mix_states(local_states, W)
             return mixed, pushsum_w, residuals, metrics
 
-        self._round_fn = jax.jit(round_fn)
+        self._round_fn = jax.jit(round_fn, donate_argnums=(0, 1, 2))
 
         self.rng = jax.random.PRNGKey(getattr(args, "seed", 0))
         init = spec.init_fn(jax.random.fold_in(self.rng, 0))

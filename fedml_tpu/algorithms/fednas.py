@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import time
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -198,7 +199,7 @@ class FedNASAPI:
 
         client_update = make_search_client_update(self.spec, self.cfg)
 
-        @jax.jit
+        @partial(jax.jit, donate_argnums=(0,))
         def round_fn(global_state, cohort_data, rng):
             C = cohort_data["mask"].shape[0]
             rngs = jax.random.split(jax.random.fold_in(rng, 1), C)

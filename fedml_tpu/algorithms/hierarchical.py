@@ -71,7 +71,7 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
             new_global = pytree.tree_weighted_mean(group_states, group_ns)
             return new_global, metrics
 
-        self._global_round = jax.jit(global_round)
+        self._global_round = jax.jit(global_round, donate_argnums=(0,))
 
     def train_one_round(self):
         t0 = time.time()

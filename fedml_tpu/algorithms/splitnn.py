@@ -16,6 +16,8 @@ seam maps to a mesh partition with activation transfer over ICI.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -107,7 +109,7 @@ class SplitNNAPI:
             msum = jax.tree.map(lambda m: jnp.sum(m, axis=0), metrics)
             return (sp, s_opt, cps, c_opts), msum
 
-        @jax.jit
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
         def round_fn(sp, s_opt, cps, c_opts, cohort, rng):
             def body(carry, idx):
                 return train_client(carry, idx, cohort)

@@ -43,8 +43,12 @@ class TurboAggregateAPI(FedAvgAPI):
         local_states, aux, metrics = self._client_update(
             self.global_state, packed, rngs)
 
-        # host-side secure aggregation of n_i-weighted updates
-        ns = np.asarray(aux["n"], np.float64)
+        # host-side secure aggregation of n_i-weighted updates; float64 is
+        # deliberate: sample counts are exact integers and the fixed-point
+        # encode/decode needs the full 53-bit mantissa for the weight
+        # normalization to round-trip (FL105's device-code concern does
+        # not apply on the host path)
+        ns = np.asarray(aux["n"], np.float64)  # fedlint: disable=FL105
         total_n = max(ns.sum(), 1e-12)
         leaves, treedef = jax.tree.flatten(
             jax.tree.map(np.asarray, local_states))

@@ -11,6 +11,12 @@ transport code that swallows errors. Two halves:
   suppressions, and a checked-in baseline so the gate only fails on *new*
   findings. CLI: ``python -m fedml_tpu.analysis`` (or the ``fedlint``
   entry point).
+- :mod:`fedml_tpu.analysis.dataflow` -- the v2 project-wide pass: a
+  symbol table of jitted callables (decorators, ``jax.jit(fn)`` wraps,
+  ``shard_map``/``pjit``, builder returns across imports) with their
+  donated argument indices, the FL110 use-after-donate dataflow rule,
+  and the FL104 ``--fix`` engine (infer ``donate_argnums``, verify every
+  call site, rewrite in place; ``--fix --diff`` dry-runs).
 - :mod:`fedml_tpu.analysis.runtime` -- ``audit()``, a context manager that
   counts jit (re)traces per federated round via ``jax.monitoring`` and
   arms ``jax.transfer_guard`` around the end-of-round sync, reporting
@@ -18,9 +24,12 @@ transport code that swallows errors. Two halves:
   metrics logger. Wired to ``--audit`` on the experiment mains.
 """
 
+from fedml_tpu.analysis.dataflow import (ProjectIndex, infer_donate_argnums,
+                                         plan_donation_fixes)
 from fedml_tpu.analysis.linter import (Finding, RULES, lint_paths,
                                        lint_source)
 from fedml_tpu.analysis.runtime import RuntimeAuditor, audit, current_auditor
 
 __all__ = ["Finding", "RULES", "lint_paths", "lint_source",
+           "ProjectIndex", "infer_donate_argnums", "plan_donation_fixes",
            "RuntimeAuditor", "audit", "current_auditor"]

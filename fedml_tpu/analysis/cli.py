@@ -4,15 +4,26 @@ Exit codes: 0 = clean (or all findings baselined), 1 = new findings,
 2 = usage error. ``--write-baseline`` regenerates the checked-in baseline
 from the current findings (run it after deliberately accepting debt; the
 diff review of the baseline file IS the acceptance step).
+
+``--fix`` runs the FL104 auto-fixer: for every aggregation jit without
+donation it infers the ``donate_argnums`` tuple from the signature
+(state-like positional params), verifies project-wide that no call site
+re-reads a donated buffer (the FL110 dataflow pass), and rewrites the
+site in place. ``--fix --diff`` prints the unified diff instead of
+writing (exit 1 when fixes are pending, 0 when the tree is already
+clean -- the CI idempotence gate). The fix is idempotent: donated sites
+are no longer FL104 findings, so a second run is a no-op.
 """
 
 from __future__ import annotations
 
 import argparse
+import ast
 import os
 import sys
 
-from fedml_tpu.analysis.linter import (RULES, apply_baseline, lint_paths,
+from fedml_tpu.analysis.linter import (RULES, _Aliases, apply_baseline,
+                                       iter_python_files, lint_paths,
                                        load_baseline, render_json,
                                        render_text, write_baseline)
 
@@ -48,6 +59,13 @@ def main(argv=None):
                         metavar="CODES", help="drop these codes (comma-sep)")
     parser.add_argument("--show-baselined", action="store_true",
                         help="text reporter: also print baselined findings")
+    parser.add_argument("--fix", action="store_true",
+                        help="rewrite FL104 sites with the inferred "
+                             "donate_argnums tuple (call-site safety "
+                             "checked project-wide first)")
+    parser.add_argument("--diff", action="store_true",
+                        help="with --fix: print the unified diff and "
+                             "write nothing (exit 1 if fixes are pending)")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
 
@@ -56,7 +74,18 @@ def main(argv=None):
             print(f"{code}: {title}\n    {rationale}")
         return 0
 
+    if args.diff and not args.fix:
+        print("fedlint: --diff requires --fix", file=sys.stderr)
+        return 2
+
     paths = args.paths or ["fedml_tpu"]
+
+    if args.fix:
+        try:
+            return run_fix(paths, diff=args.diff)
+        except OSError as e:
+            print(f"fedlint: {e}", file=sys.stderr)
+            return 2
     try:
         findings = lint_paths(paths, select=args.select, ignore=args.ignore)
     except OSError as e:
@@ -79,6 +108,50 @@ def main(argv=None):
     else:
         print(render_text(findings, show_baselined=args.show_baselined))
     return 1 if new else 0
+
+
+def run_fix(paths, diff=False):
+    """The FL104 donation auto-fixer. Builds the project-wide jit symbol
+    table once (so call-site safety sees cross-module builder bindings),
+    plans per-file edits, then either prints the combined diff (``diff``
+    dry run; exit 1 when non-empty) or writes the files."""
+    from fedml_tpu.analysis.dataflow import (ProjectIndex,
+                                             plan_donation_fixes,
+                                             render_fix_diff)
+    index = ProjectIndex()
+    sources = []
+    for path in iter_python_files(paths):
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        rel = os.path.relpath(path)
+        try:
+            tree = ast.parse(src, filename=rel)
+        except SyntaxError:
+            continue  # the lint run reports FL100; nothing to fix here
+        index.add_module(rel, tree, _Aliases(tree))
+        sources.append((path, rel, src))
+
+    pending = 0
+    for path, rel, src in sources:
+        plan = plan_donation_fixes(rel, src, index=index)
+        for line, name, reason in plan.skipped:
+            print(f"{rel}:{line}: FL104 fix skipped for `{name}`: "
+                  f"{reason}", file=sys.stderr)
+        if not plan.edits:
+            continue
+        pending += 1
+        if diff:
+            sys.stdout.write(render_fix_diff(plan))
+        else:
+            with open(path, "w", encoding="utf-8") as fh:
+                fh.write(plan.apply())
+            print(f"fedlint: fixed {len(plan.edits)} FL104 site(s) "
+                  f"in {rel}")
+    if diff:
+        return 1 if pending else 0
+    if not pending:
+        print("fedlint: no FL104 sites to fix")
+    return 0
 
 
 if __name__ == "__main__":

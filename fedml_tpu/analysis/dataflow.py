@@ -1,0 +1,802 @@
+"""fedlint v2: project-wide dataflow -- jit symbol table, donation
+inference, use-after-donate (FL110), and the FL104 ``--fix`` engine.
+
+The v1 linter judged each line in isolation; donation safety is a
+*caller* property: ``jax.jit(f, donate_argnums=(0,))`` deletes the
+caller's argument buffer, so whether a site is safe depends on every
+place the jitted callable is invoked. This module builds that view in
+two passes over the linted fileset:
+
+1. **Symbol table** (:class:`ProjectIndex`): every jitted callable --
+   decorator form, ``name = jax.jit(fn, ...)`` wrap form, ``pjit``, and
+   ``jax.jit(shard_map(fn, ...))`` -- with its positional parameters and
+   donated argument indices. Three binding shapes are resolved so call
+   sites elsewhere can be checked:
+
+   - module/function locals: ``step = jax.jit(fn, donate_argnums=...)``
+   - instance attributes:  ``self._round_fn = jax.jit(round_fn)`` bound
+     in one method, called as ``self._round_fn(...)`` in another
+   - **builders**: a function whose return value is a jitted local
+     (``make_sim_round`` returns its inner ``@jax.jit def round_fn``);
+     ``self.round_fn = make_sim_round(...)`` in *another module* then
+     carries the donation contract across the import edge.
+
+2. **Dataflow** (:func:`check_use_after_donate`): inside each function
+   body, statements are walked in order; a donated argument variable is
+   poisoned at the call and any later read before a rebind is FL110.
+   The call's own assignment targets rebind immediately
+   (``state = f(state)`` is the safe idiom), and a donating call inside
+   a loop whose donated operand is never rebound in the loop body is
+   flagged too -- iteration two re-reads a deleted buffer.
+
+Donation *inference* (:func:`infer_donate_argnums`) is deliberately
+name-based: aggregation jits in this repo thread state-like arguments
+(``*_state``, ``residuals``, optimizer triples) in and out, while data,
+schedules, RNG keys, and dtype templates are reused across rounds by the
+caller and must never be donated. The fix engine couples the inferred
+tuple with a project-wide FL110 simulation: a site whose call sites
+would re-read a donated buffer is reported, not rewritten.
+"""
+
+from __future__ import annotations
+
+import ast
+import difflib
+import os
+
+#: Param-name segments (underscore-split, case-sensitive) marking an
+#: argument as NOT donation-eligible: caller-owned data, schedules, PRNG
+#: keys, index maps, dtype templates, and mixing matrices are re-used
+#: across calls; donating them would delete live caller state.
+NONDONATABLE_SEGMENTS = frozenset({
+    "data", "x", "y", "xs", "ys", "idx", "ids", "rows", "row", "slot",
+    "slots", "sched", "schedule", "schedules", "key", "keys", "rng",
+    "rngs", "crng", "crngs", "seed", "seeds", "mask", "masks", "batch",
+    "batches", "cohort", "lane", "lanes", "wave", "trip", "dtype",
+    "dtypes", "template", "W", "mesh", "spec", "n", "steps", "max",
+})
+
+
+def _positional_params(func):
+    """Positional parameter names of a FunctionDef/Lambda -- the index
+    space ``donate_argnums`` refers to."""
+    a = func.args
+    return [p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+
+
+def infer_donate_argnums(func):
+    """Donation candidates for an aggregation jit: positional params that
+    are state-like by name (not data/rng/schedule/template-like)."""
+    out = []
+    for i, name in enumerate(_positional_params(func)):
+        if name == "self":
+            continue
+        segs = name.split("_")
+        if any(s in NONDONATABLE_SEGMENTS for s in segs):
+            continue
+        out.append(i)
+    return tuple(out)
+
+
+def format_argnums(nums):
+    inner = ", ".join(str(n) for n in nums)
+    return f"({inner},)" if len(nums) == 1 else f"({inner})"
+
+
+# -- symbol table ---------------------------------------------------------
+
+class JitSymbol:
+    """One jitted callable: its positional params and donated indices."""
+
+    __slots__ = ("name", "params", "donate", "module", "line")
+
+    def __init__(self, name, params, donate, module="", line=0):
+        self.name = name
+        self.params = params
+        self.donate = tuple(donate)
+        self.module = module
+        self.line = line
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (f"JitSymbol({self.name}, params={self.params}, "
+                f"donate={self.donate})")
+
+
+def _const_int_tuple(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, int))
+    return ()
+
+
+def _const_str_tuple(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+def donate_from_kwargs(kwargs, params):
+    """Donated positional indices from a jit call's keyword dict."""
+    donate = list(_const_int_tuple(kwargs["donate_argnums"])
+                  if "donate_argnums" in kwargs else ())
+    if "donate_argnames" in kwargs:
+        for name in _const_str_tuple(kwargs["donate_argnames"]):
+            if name in params:
+                donate.append(params.index(name))
+    return tuple(sorted(set(donate)))
+
+
+class _ModuleSymbols:
+    """Per-module symbol collection (pass 1)."""
+
+    def __init__(self, module, tree, aliases):
+        self.module = module
+        self.aliases = aliases
+        self.tree = tree
+        #: scope-flat name -> JitSymbol (module + function locals; call
+        #: resolution is name-based; shadowing is handled temporally --
+        #: the most recent definition before a binding wins)
+        self.jits = {}
+        #: builder function name -> JitSymbol of the jit it returns
+        self.builders = {}
+        #: class name -> {attr: JitSymbol} for ``self.attr = <jit>``
+        self.class_attrs = {}
+        #: class name -> {attr: callee name} for ``self.attr = fn(...)``
+        #: where ``fn`` could not be resolved locally (possibly an
+        #: imported builder -- resolved lazily by ProjectIndex)
+        self.class_attr_calls = {}
+        #: local import name -> (module, original name)
+        self.imports = {}
+        self._collect_imports(tree)
+        self._walk(tree, class_name=None, fn_stack=[])
+
+    # .. imports ..........................................................
+    def _collect_imports(self, tree):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    self.imports[a.asname or a.name] = (node.module, a.name)
+
+    # .. jit binding shapes ...............................................
+    def _jit_call_symbol(self, call, scope_defs):
+        """``jax.jit(target, ...)`` call -> JitSymbol or None. ``target``
+        may be a def name, a lambda, or a name bound to
+        ``jax.shard_map(fn, ...)`` / ``pjit(fn, ...)`` (one unwrap)."""
+        from fedml_tpu.analysis.linter import _jit_call_info
+        kwargs = _jit_call_info(call, self.aliases)
+        if kwargs is None or not call.args:
+            return None
+        func = self._resolve_traced(call.args[0], scope_defs)
+        if func is None:
+            return None
+        params = _positional_params(func)
+        name = getattr(func, "name", "<lambda>")
+        return JitSymbol(name, params, donate_from_kwargs(kwargs, params),
+                         module=self.module, line=call.lineno)
+
+    def _resolve_traced(self, node, scope_defs):
+        """The FunctionDef/Lambda actually traced by a jit call arg."""
+        if isinstance(node, ast.Lambda):
+            return node
+        if isinstance(node, ast.Call):
+            node = self._shard_map_target(node)
+            if node is None:
+                return None
+            if isinstance(node, ast.Lambda):
+                return node
+        if isinstance(node, ast.Name):
+            target = scope_defs.get(node.id)
+            if isinstance(target, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda)):
+                return target
+            if isinstance(target, ast.Call):
+                inner = self._shard_map_target(target)
+                if isinstance(inner, ast.Lambda):
+                    return inner
+                if isinstance(inner, ast.Name):
+                    t2 = scope_defs.get(inner.id)
+                    if isinstance(t2, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                        return t2
+        return None
+
+    @staticmethod
+    def _shard_map_target(call):
+        f = call.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if name in ("shard_map", "pjit") and call.args:
+            arg = call.args[0]
+            if isinstance(arg, (ast.Name, ast.Lambda)):
+                return arg
+        return None
+
+    def _decorated_symbol(self, node):
+        """FunctionDef with a jit decorator -> JitSymbol or None."""
+        from fedml_tpu.analysis.linter import _jit_call_info
+        params = _positional_params(node)
+        for dec in node.decorator_list:
+            if self.aliases.is_jit_ref(dec):
+                return JitSymbol(node.name, params, (),
+                                 module=self.module, line=node.lineno)
+            if isinstance(dec, ast.Call):
+                kwargs = _jit_call_info(dec, self.aliases)
+                if kwargs is not None:
+                    return JitSymbol(
+                        node.name, params,
+                        donate_from_kwargs(kwargs, params),
+                        module=self.module, line=node.lineno)
+        return None
+
+    # .. scope walk .......................................................
+    def _walk(self, node, class_name, fn_stack):
+        body = getattr(node, "body", [])
+        scope_defs = {}
+        for stmt in ast.walk(node):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and stmt is not node:
+                scope_defs.setdefault(stmt.name, stmt)
+            elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                scope_defs.setdefault(stmt.targets[0].id, stmt.value)
+
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                sym = self._decorated_symbol(stmt)
+                if sym is not None:
+                    self.jits[stmt.name] = sym
+                self._walk(stmt, class_name, fn_stack + [stmt])
+            elif isinstance(stmt, ast.ClassDef):
+                self._walk(stmt, stmt.name, fn_stack)
+            else:
+                self._scan_assigns(stmt, scope_defs, class_name)
+                # compound statements may nest assigns/defs one level in
+                for attr in ("body", "orelse", "finalbody"):
+                    for sub in getattr(stmt, attr, ()):
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            sym = self._decorated_symbol(sub)
+                            if sym is not None:
+                                self.jits[sub.name] = sym
+                            self._walk(sub, class_name, fn_stack + [sub])
+                        else:
+                            self._scan_assigns(sub, scope_defs, class_name)
+
+        # builder detection: does this function return a jitted local?
+        if fn_stack and isinstance(node, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+            for stmt in body:
+                if isinstance(stmt, ast.Return) \
+                        and isinstance(stmt.value, ast.Name):
+                    sym = self.jits.get(stmt.value.id)
+                    if sym is not None:
+                        self.builders[node.name] = sym
+
+    def _scan_assigns(self, stmt, scope_defs, class_name):
+        if not isinstance(stmt, ast.Assign):
+            return
+        value = stmt.value
+        sym = None
+        if isinstance(value, ast.Call):
+            sym = self._jit_call_symbol(value, scope_defs)
+        elif isinstance(value, ast.Name):
+            sym = self.jits.get(value.id)
+        for tgt in stmt.targets:
+            if isinstance(tgt, ast.Name):
+                if sym is not None:
+                    self.jits[tgt.id] = sym
+            elif isinstance(tgt, ast.Attribute) \
+                    and isinstance(tgt.value, ast.Name) \
+                    and tgt.value.id == "self" and class_name:
+                if sym is not None:
+                    self.class_attrs.setdefault(
+                        class_name, {})[tgt.attr] = sym
+                elif isinstance(value, ast.Call) \
+                        and isinstance(value.func, ast.Name):
+                    local = self.builders.get(value.func.id)
+                    if local is not None:
+                        self.class_attrs.setdefault(
+                            class_name, {})[tgt.attr] = local
+                    else:
+                        self.class_attr_calls.setdefault(
+                            class_name, {})[tgt.attr] = value.func.id
+
+
+class ProjectIndex:
+    """Cross-module jit symbol resolution over the linted fileset."""
+
+    def __init__(self):
+        self.modules = {}  # dotted module name -> _ModuleSymbols
+
+    @staticmethod
+    def module_name(path):
+        rel = path.replace(os.sep, "/")
+        if rel.endswith(".py"):
+            rel = rel[:-3]
+        return rel.strip("/").replace("/", ".")
+
+    def add_module(self, path, tree, aliases):
+        mod = self.module_name(path)
+        self.modules[mod] = _ModuleSymbols(mod, tree, aliases)
+        return self.modules[mod]
+
+    def _lookup(self, module, name, seen=None):
+        """-> (JitSymbol, kind) with kind in ('jit', 'builder'), or
+        (None, None). Follows import edges; a bare import module name is
+        matched against full dotted names by suffix so relative layouts
+        (tmp dirs, package roots) resolve."""
+        seen = set() if seen is None else seen
+        if (module, name) in seen:
+            return None, None
+        seen.add((module, name))
+        info = self.modules.get(module)
+        if info is None:
+            return None, None
+        if name in info.jits:
+            return info.jits[name], "jit"
+        if name in info.builders:
+            return info.builders[name], "builder"
+        if name in info.imports:
+            src_mod, src_name = info.imports[name]
+            cands = [src_mod] + [m for m in self.modules
+                                 if m == src_mod
+                                 or m.endswith("." + src_mod)]
+            for cand in cands:
+                sym, kind = self._lookup(cand, src_name, seen)
+                if sym is not None:
+                    return sym, kind
+        return None, None
+
+    def resolve_call(self, module, call, class_name=None, local_syms=None):
+        """JitSymbol for a call node, or None. Handles bare names
+        (locals bound from builder calls via ``local_syms``, module
+        jits) and ``self.attr`` calls (including attrs bound from
+        imported builders)."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            if local_syms and f.id in local_syms:
+                return local_syms[f.id]
+            sym, kind = self._lookup(module, f.id)
+            return sym if kind == "jit" else None
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == "self" and class_name:
+            info = self.modules.get(module)
+            if info is None:
+                return None
+            sym = info.class_attrs.get(class_name, {}).get(f.attr)
+            if sym is not None:
+                return sym
+            callee = info.class_attr_calls.get(class_name, {}).get(f.attr)
+            if callee is not None:
+                sym, kind = self._lookup(module, callee)
+                if kind == "builder":
+                    return sym
+        return None
+
+    def resolve_binding(self, module, value):
+        """JitSymbol produced by an assignment RHS that calls a builder
+        (``fn = make_sim_round(...)``), local or imported."""
+        if isinstance(value, ast.Call) and isinstance(value.func, ast.Name):
+            sym, kind = self._lookup(module, value.func.id)
+            if kind == "builder":
+                return sym
+        return None
+
+
+# -- FL110: use-after-donate ----------------------------------------------
+
+def _var_key(node):
+    """Trackable operand identity: bare name or ``self.attr``."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return ("self", node.attr)
+    return None
+
+
+def _key_disp(key):
+    return ".".join(key) if isinstance(key, tuple) else key
+
+
+def _assigned_keys(target, out):
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for e in target.elts:
+            _assigned_keys(e, out)
+    elif isinstance(target, ast.Starred):
+        _assigned_keys(target.value, out)
+    else:
+        key = _var_key(target)
+        if key is not None:
+            out.add(key)
+
+
+def _header_nodes(stmt):
+    """The expressions of a statement that evaluate at its own point in
+    the sequence (compound bodies are recursed into separately)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, ast.For):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.AsyncFor,)):
+        return [stmt.iter]
+    if isinstance(stmt, ast.With):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, ast.Try):
+        return []
+    return [stmt]
+
+
+class _DonationChecker:
+    """Linear-order statement walk flagging reads of donated buffers."""
+
+    def __init__(self, index, module, add_finding):
+        self.index = index
+        self.module = module
+        self.add = add_finding
+
+    def check_stmts(self, stmts, class_name=None):
+        local_syms = {}
+        for stmt in stmts:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                sym = self.index.resolve_binding(self.module, stmt.value)
+                if sym is not None:
+                    local_syms[stmt.targets[0].id] = sym
+        self._run(stmts, {}, class_name, local_syms)
+
+    def _donations_in(self, node, class_name, local_syms):
+        """(key -> (sym, call, param-name)) for donating calls under
+        ``node``."""
+        out = {}
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            sym = self.index.resolve_call(self.module, sub, class_name,
+                                          local_syms)
+            if sym is None or not sym.donate:
+                continue
+            for i in sym.donate:
+                if i < len(sub.args):
+                    key = _var_key(sub.args[i])
+                    if key is not None:
+                        pname = sym.params[i] if i < len(sym.params) else i
+                        out[key] = (sym, sub, pname)
+        return out
+
+    def _run(self, stmts, donated, class_name, local_syms):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested scopes analyzed separately
+            headers = _header_nodes(stmt)
+            # 1) reads of previously-donated buffers in this statement's
+            # own expressions
+            for h in headers:
+                for node in ast.walk(h):
+                    key = _var_key(node)
+                    if key is not None and key in donated \
+                            and isinstance(getattr(node, "ctx", None),
+                                           ast.Load):
+                        sym, call, pname = donated[key]
+                        self.add(node, "FL110",
+                                 f"`{_key_disp(key)}` was donated to "
+                                 f"`{sym.name}` (param `{pname}`, line "
+                                 f"{call.lineno}) and is read again -- "
+                                 "the buffer is deleted after the call; "
+                                 "pass a copy or rebind the result")
+                        donated.pop(key, None)  # report once per donation
+                        break
+            # 2) loops: a donated operand never rebound inside the loop
+            # body is re-read (deleted) on the next iteration
+            if isinstance(stmt, (ast.For, ast.While)):
+                self._check_loop(stmt, class_name, local_syms)
+            # 3) register this statement's donations, then rebinds
+            for h in headers:
+                donated.update(self._donations_in(h, class_name,
+                                                  local_syms))
+            rebound = set()
+            if isinstance(stmt, ast.Assign):
+                for tgt in stmt.targets:
+                    _assigned_keys(tgt, rebound)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign, ast.For)):
+                _assigned_keys(stmt.target, rebound)
+            elif isinstance(stmt, ast.Delete):
+                for tgt in stmt.targets:
+                    _assigned_keys(tgt, rebound)
+            for key in rebound:
+                donated.pop(key, None)
+            # 4) recurse into compound bodies: each branch starts from a
+            # COPY of the current poison set (a donation in the if-body
+            # must not flag reads in the mutually-exclusive orelse), and
+            # the branch outcomes union back in afterwards -- code after
+            # the statement sees a poison if ANY path could have donated
+            branch_outs = []
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if isinstance(sub, list) and sub:
+                    branch = dict(donated)
+                    self._run(sub, branch, class_name, local_syms)
+                    branch_outs.append(branch)
+            for handler in getattr(stmt, "handlers", ()):
+                branch = dict(donated)
+                self._run(handler.body, branch, class_name, local_syms)
+                branch_outs.append(branch)
+            for branch in branch_outs:
+                donated.update(branch)
+
+    def _check_loop(self, loop, class_name, local_syms):
+        rebound = set()
+        for stmt in ast.walk(loop):
+            if isinstance(stmt, ast.Assign):
+                for tgt in stmt.targets:
+                    _assigned_keys(tgt, rebound)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign, ast.For)):
+                _assigned_keys(stmt.target, rebound)
+
+        def scan(node, top):
+            for sub in ast.iter_child_nodes(node):
+                if isinstance(sub, (ast.For, ast.While)) and not top:
+                    continue  # nested loops get their own pass
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                    continue
+                if isinstance(sub, ast.Call):
+                    sym = self.index.resolve_call(
+                        self.module, sub, class_name, local_syms)
+                    if sym is not None and sym.donate:
+                        for i in sym.donate:
+                            if i < len(sub.args):
+                                key = _var_key(sub.args[i])
+                                if key is not None and key not in rebound:
+                                    self.add(
+                                        sub.args[i], "FL110",
+                                        f"`{_key_disp(key)}` is donated "
+                                        f"to `{sym.name}` inside a loop "
+                                        "but never rebound in the loop "
+                                        "body -- the next iteration "
+                                        "reads a deleted buffer")
+                scan(sub, False)
+
+        scan(loop, True)
+
+
+def check_use_after_donate(index, module, tree, add_finding):
+    """Run FL110 over every function body (and the module body) of one
+    module, resolving donating callables through ``index``."""
+    checker = _DonationChecker(index, module, add_finding)
+
+    def visit(node, class_name):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                visit(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                checker.check_stmts(child.body, class_name)
+                visit(child, class_name)
+            else:
+                visit(child, class_name)
+
+    visit(tree, None)
+    module_stmts = [s for s in tree.body
+                    if not isinstance(s, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.ClassDef))]
+    checker.check_stmts(module_stmts, None)
+
+
+# -- FL104 fix engine -----------------------------------------------------
+
+class FixPlan:
+    """One file's planned donation fixes."""
+
+    def __init__(self, path, src):
+        self.path = path
+        self.src = src
+        self.edits = []       # (lineno, col, end_lineno, end_col, text)
+        self.need_partial_import = False
+        self.skipped = []     # (lineno, name, reason)
+
+    def add_replace(self, node, text):
+        self.edits.append((node.lineno, node.col_offset,
+                           node.end_lineno, node.end_col_offset, text))
+
+    def add_insert_before_close(self, call, text):
+        """Insert ``text`` (", donate_argnums=...") before the call's
+        closing paren, anchored to the last non-whitespace character so
+        multi-line calls and trailing commas (`jax.jit(fn,)`) stay
+        syntactically valid -- a trailing comma absorbs the inserted
+        leading ", "."""
+        lines = self.src.splitlines()
+        line, col = call.end_lineno, call.end_col_offset - 1
+        # walk back from the ")" to the last real character
+        while line >= call.lineno:
+            seg = lines[line - 1][:col]
+            stripped = seg.rstrip()
+            if stripped:
+                col = len(stripped)
+                break
+            line -= 1
+            col = len(lines[line - 1]) if line >= 1 else 0
+        if lines[line - 1][:col].endswith(","):
+            text = " " + text.lstrip(", ")
+        self.edits.append((line, col, line, col, text))
+
+    def apply(self):
+        lines = self.src.splitlines(keepends=True)
+        for (l0, c0, l1, c1, text) in sorted(self.edits, reverse=True):
+            if l0 == l1:
+                line = lines[l0 - 1]
+                lines[l0 - 1] = line[:c0] + text + line[c1:]
+            else:
+                first, last = lines[l0 - 1], lines[l1 - 1]
+                lines[l0 - 1:l1] = [first[:c0] + text + last[c1:]]
+        out = "".join(lines)
+        if self.need_partial_import:
+            out = _ensure_partial_import(out)
+        return out
+
+
+def _ensure_partial_import(src):
+    if "from functools import partial" in src:
+        return src
+    lines = src.splitlines(keepends=True)
+    last_import = 0
+    for i, line in enumerate(lines):
+        if line.startswith(("import ", "from ")):
+            last_import = i + 1
+    lines.insert(last_import, "from functools import partial\n")
+    return "".join(lines)
+
+
+def _decorator_src(src_lines, node):
+    if node.lineno == node.end_lineno:
+        return src_lines[node.lineno - 1][
+            node.col_offset:node.end_col_offset]
+    parts = [src_lines[node.lineno - 1][node.col_offset:]]
+    parts += src_lines[node.lineno:node.end_lineno - 1]
+    parts.append(src_lines[node.end_lineno - 1][:node.end_col_offset])
+    return "\n".join(parts)
+
+
+def plan_donation_fixes(path, src, index=None):
+    """Plan ``donate_argnums`` insertions for every un-donated FL104
+    site in one module. Returns a :class:`FixPlan` (possibly empty).
+
+    A site is skipped (recorded in ``plan.skipped``) when no positional
+    parameter is donation-eligible, or when ``index`` is given and any
+    resolvable call site of the symbol would trip FL110 under the
+    proposed tuple -- the fix must never *introduce* a use-after-donate.
+    """
+    from fedml_tpu.analysis.linter import (_AGG_NAME_RE, _Aliases,
+                                           _collect_jit_sites,
+                                           _jit_call_info,
+                                           _parse_suppressions)
+    tree = ast.parse(src, filename=path)
+    aliases = _Aliases(tree)
+    per_line, per_file = _parse_suppressions(src)
+    plan = FixPlan(path, src)
+    src_lines = src.splitlines()
+    module = ProjectIndex.module_name(path)
+
+    for site in _collect_jit_sites(tree, aliases):
+        func = site.func
+        name = getattr(func, "name", "<lambda>")
+        if name == "<lambda>" or not _AGG_NAME_RE.search(name):
+            continue
+        if "donate_argnums" in site.kwargs \
+                or "donate_argnames" in site.kwargs:
+            continue
+        line_codes = per_line.get(site.site.lineno, set()) | per_file
+        if "*" in line_codes or "FL104" in line_codes:
+            continue
+        donate = infer_donate_argnums(func)
+        if not donate:
+            plan.skipped.append((site.site.lineno, name,
+                                 "no donation-eligible positional params"))
+            continue
+        if index is not None and _fix_would_break_callers(
+                index, module, site.site.lineno, name, func, donate):
+            plan.skipped.append((site.site.lineno, name,
+                                 "a call site re-reads a donated buffer "
+                                 "(would introduce FL110); fix the caller "
+                                 "first"))
+            continue
+        tup = format_argnums(donate)
+        if isinstance(site.site, ast.Call):
+            # `name = jax.jit(fn)` wrap form: append the kwarg
+            plan.add_insert_before_close(site.site,
+                                         f", donate_argnums={tup}")
+        else:
+            # decorator form on site.func's FunctionDef
+            dec, as_call = _find_jit_decorator(site.site, aliases,
+                                               _jit_call_info)
+            if dec is None:
+                plan.skipped.append((site.site.lineno, name,
+                                     "could not locate jit decorator"))
+                continue
+            if as_call:
+                plan.add_insert_before_close(
+                    dec, f", donate_argnums={tup}")
+            else:
+                text = _decorator_src(src_lines, dec)
+                plan.add_replace(
+                    dec, f"partial({text}, donate_argnums={tup})")
+                plan.need_partial_import = True
+    return plan
+
+
+def _find_jit_decorator(func_def, aliases, jit_call_info):
+    for dec in func_def.decorator_list:
+        if aliases.is_jit_ref(dec):
+            return dec, False
+        if isinstance(dec, ast.Call) \
+                and jit_call_info(dec, aliases) is not None:
+            return dec, True
+    return None, None
+
+
+class _ProbeIndex:
+    """Index view where ONE symbol (matched by module + line, so name
+    collisions across builders don't leak) reports a proposed donation
+    set -- used to simulate FL110 before a fix is applied."""
+
+    def __init__(self, base, module, line, probe):
+        self.base = base
+        self.modules = base.modules
+        self._module = module
+        self._line = line
+        self._probe = probe
+
+    def _swap(self, sym):
+        if sym is not None and not sym.donate \
+                and sym.module == self._module \
+                and abs(sym.line - self._line) <= 1:
+            return self._probe
+        return sym
+
+    def resolve_call(self, module, call, class_name=None, local_syms=None):
+        return self._swap(self.base.resolve_call(module, call, class_name,
+                                                 local_syms))
+
+    def resolve_binding(self, module, value):
+        return self._swap(self.base.resolve_binding(module, value))
+
+
+def _fix_would_break_callers(index, module, line, name, func, donate):
+    """Simulate FL110 project-wide with the site donating ``donate``:
+    True when any module reports a hit (the fix would break a caller)."""
+    probe = JitSymbol(name, _positional_params(func), donate,
+                      module=module, line=line)
+    probe_index = _ProbeIndex(index, module, line, probe)
+    hits = []
+    for mod, info in index.modules.items():
+        check_use_after_donate(probe_index, mod, info.tree,
+                               lambda n, c, m: hits.append((mod, n)))
+        if hits:
+            return True
+    return False
+
+
+def render_fix_diff(plan):
+    """Unified diff of a fix plan (the ``--fix --diff`` dry run)."""
+    if not plan.edits:
+        return ""
+    fixed = plan.apply()
+    return "".join(difflib.unified_diff(
+        plan.src.splitlines(keepends=True),
+        fixed.splitlines(keepends=True),
+        fromfile=f"a/{plan.path}", tofile=f"b/{plan.path}"))
+
+
+__all__ = ["NONDONATABLE_SEGMENTS", "infer_donate_argnums",
+           "format_argnums", "donate_from_kwargs", "JitSymbol",
+           "ProjectIndex", "check_use_after_donate", "plan_donation_fixes",
+           "render_fix_diff", "FixPlan"]

@@ -79,7 +79,39 @@ RULES = {
         "library modules bypass the logging config (and `jax.debug.print` "
         "inserts host callbacks into compiled programs -- a per-step "
         "device->host sync)."),
+    "FL109": (
+        "shard_map/pjit with no operand partitioned on any mesh axis",
+        "`shard_map`/`pjit` whose in_specs are all empty `PartitionSpec()` "
+        "replicates every operand: the program pays SPMD dispatch and "
+        "collective plumbing while every shard computes the full array. "
+        "Put the cohort/batch operands on the `clients` (or another mesh) "
+        "axis, or drop the shard_map."),
+    "FL110": (
+        "use of a buffer after it was donated",
+        "an argument passed at a `donate_argnums` position is deleted when "
+        "the jitted call returns; reading it afterwards raises "
+        "`RuntimeError: Array has been deleted` (or silently corrupts on "
+        "backends that alias late). Rebind the result over the operand "
+        "(`state = f(state)`) or pass a defensive copy."),
+    "FL111": (
+        "lax.scan carry initialized from a weak-typed Python scalar",
+        "a bare `0`/`0.0` carry init is weakly typed; when the body "
+        "returns a strongly-typed array the carry dtype drifts between "
+        "init and output -- a trace-time TypeError at best, a silent "
+        "upcast retrace at worst. Initialize the carry with an explicit "
+        "dtype (`jnp.zeros((), jnp.float32)`)."),
+    "FL112": (
+        "jit closure captures a large concrete array",
+        "a jitted function that closes over a module/outer-scope device "
+        "array bakes it into the jaxpr as a constant: it is re-hashed on "
+        "every trace, copied into every compiled executable, and doubles "
+        "HBM against the runtime-passed copy. Pass large arrays as "
+        "arguments instead."),
 }
+
+#: FL112 only flags captures whose *static* element count is at least
+#: this (64 KiB of f32): closing over small constant tables is idiomatic.
+FL112_MIN_ELEMENTS = 16384
 
 #: FL107 only applies to transport/codec paths (broad handlers elsewhere
 #: are a judgement call; on the wire they corrupt rounds silently).
@@ -177,6 +209,7 @@ class _Aliases:
         self.jnp = set()
         self.partial = {"partial"}
         self.jit_funcs = set()  # `from jax import jit, pmap` style
+        self.pspec = {"PartitionSpec"}  # PartitionSpec local names
         for node in ast.walk(tree):
             if isinstance(node, ast.Import):
                 for a in node.names:
@@ -199,6 +232,11 @@ class _Aliases:
                     for a in node.names:
                         if a.name == "partial":
                             self.partial.add(a.asname or a.name)
+                if node.module in ("jax.sharding", "jax.experimental.pjit",
+                                   "jax.interpreters.pxla"):
+                    for a in node.names:
+                        if a.name == "PartitionSpec":
+                            self.pspec.add(a.asname or a.name)
 
     def is_jit_ref(self, node):
         """`jax.jit` / `jax.pmap` / bare `jit` (from-imported)."""
@@ -361,6 +399,72 @@ def _unsorted_dict_iter(node):
     return visit(node, 0)
 
 
+def _weak_const_leaves(node):
+    """Bare numeric Constants at pytree-leaf positions of a scan-init
+    expression (descending containers only, never calls: a constant
+    inside ``jnp.zeros((3,))`` is a shape, not a carry leaf)."""
+    out = []
+
+    def visit(n):
+        if isinstance(n, ast.Constant) \
+                and isinstance(n.value, (int, float)) \
+                and not isinstance(n.value, bool):
+            out.append(n)
+        elif isinstance(n, (ast.Tuple, ast.List)):
+            for e in n.elts:
+                visit(e)
+        elif isinstance(n, ast.Dict):
+            for v in n.values:
+                visit(v)
+        elif isinstance(n, ast.UnaryOp):
+            visit(n.operand)
+
+    visit(node)
+    return out
+
+
+def _own_returns(fn):
+    """Return statements belonging to ``fn`` itself (nested defs and
+    lambdas excluded)."""
+    out, stack = [], list(fn.body) if not isinstance(fn, ast.Lambda) else []
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        if isinstance(n, ast.Return):
+            out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _scan_body_modifies_carry(fn):
+    """True when a scan body's returned carry is neither the carry
+    parameter passed through untouched nor a constant dummy."""
+    params = _param_names(fn)
+    carry_name = params[0] if params else None
+    if isinstance(fn, ast.Lambda):
+        v = fn.body
+        carry = v.elts[0] if isinstance(v, ast.Tuple) and v.elts else v
+        return not ((isinstance(carry, ast.Name)
+                     and carry.id == carry_name)
+                    or isinstance(carry, ast.Constant))
+    returns = _own_returns(fn)
+    if not returns:
+        return False
+    for r in returns:
+        v = r.value
+        if v is None:
+            continue
+        carry = v.elts[0] if isinstance(v, ast.Tuple) and v.elts else v
+        if isinstance(carry, ast.Name) and carry.id == carry_name:
+            continue
+        if isinstance(carry, ast.Constant):
+            continue
+        return True
+    return False
+
+
 class _ModuleLinter:
     def __init__(self, path, src, tree):
         self.path = path
@@ -382,10 +486,14 @@ class _ModuleLinter:
 
     def run(self):
         sites = _collect_jit_sites(self.tree, self.aliases)
+        parents = {id(child): node for node in ast.walk(self.tree)
+                   for child in ast.iter_child_nodes(node)}
+        self._parents = parents
         jitted_spans = []
         for site in sites:
             self._check_jit_body(site)
             self._check_jit_config(site)
+            self._check_jit_captures(site, parents)
             jitted_spans.append(site.func)
         self._check_module_wide(jitted_spans)
         return self.findings
@@ -491,7 +599,7 @@ class _ModuleLinter:
                 out.append(p.arg)
         return out
 
-    # FL106 / FL107 / FL108: module-wide
+    # FL106 / FL107 / FL108 / FL109 / FL111: module-wide
     def _check_module_wide(self, jitted_funcs):
         posix = self.path.replace(os.sep, "/")
         fl107_scoped = any(fnmatch(posix, pat) for pat in _FL107_PATHS)
@@ -500,10 +608,178 @@ class _ModuleLinter:
         for node in ast.walk(self.tree):
             if isinstance(node, ast.Call):
                 self._check_pytree_sink(node)
+                self._check_shard_specs(node)
+                self._check_scan_carry(node)
                 if fl108_scoped:
                     self._check_debug_call(node)
             elif isinstance(node, ast.ExceptHandler) and fl107_scoped:
                 self._check_except(node)
+
+    # FL109: shard_map/pjit whose in_specs partition nothing
+    def _check_shard_specs(self, node):
+        f = node.func
+        fname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if fname not in ("shard_map", "pjit"):
+            return
+        for kw in node.keywords:
+            if kw.arg not in ("in_specs", "in_shardings"):
+                continue
+            entries = (kw.value.elts
+                       if isinstance(kw.value, (ast.Tuple, ast.List))
+                       else [kw.value])
+            any_partitioned = False
+            for entry in entries:
+                pcalls = [c for c in ast.walk(entry)
+                          if isinstance(c, ast.Call)
+                          and self._is_pspec_ref(c.func)]
+                if not pcalls:
+                    # spec bound to a name / built elsewhere: out of
+                    # static reach -- judge nothing rather than guess
+                    return
+                if any(c.args or c.keywords for c in pcalls):
+                    any_partitioned = True
+            if entries and not any_partitioned:
+                self.add(node, "FL109",
+                         f"every `{kw.arg}` entry of this `{fname}` is an "
+                         "empty PartitionSpec -- no operand is partitioned "
+                         "on any mesh axis (the `clients` cohort operand "
+                         "should carry one), so every shard replicates the "
+                         "full computation")
+                return
+
+    def _is_pspec_ref(self, node):
+        if isinstance(node, ast.Name):
+            return node.id in self.aliases.pspec
+        return isinstance(node, ast.Attribute) \
+            and node.attr == "PartitionSpec"
+
+    # FL111: scan carry initialized from weak-typed Python scalars
+    def _check_scan_carry(self, node):
+        root, attr = _call_root_name(node.func)
+        if attr != "scan" or root != "lax":
+            return
+        init = None
+        if len(node.args) >= 2:
+            init = node.args[1]
+        else:
+            for kw in node.keywords:
+                if kw.arg == "init":
+                    init = kw.value
+        if init is None:
+            return
+        weak = _weak_const_leaves(init)
+        if not weak:
+            return
+        body = node.args[0] if node.args else None
+        body_fn = self._resolve_local_callable(body, near=node)
+        if body_fn is None or not _scan_body_modifies_carry(body_fn):
+            # unresolvable body, or the scalar carry is threaded through
+            # untouched (the common `scan(step, 0, xs)` dummy-carry idiom)
+            return
+        self.add(weak[0], "FL111",
+                 "lax.scan carry initialized from a weak-typed Python "
+                 "scalar while the body rebuilds the carry -- the carry "
+                 "dtype can drift between init and output; use an "
+                 "explicit dtype (e.g. jnp.zeros((), jnp.float32))")
+
+    def _resolve_local_callable(self, node, near=None):
+        """Resolve a callable expression to its def, innermost enclosing
+        scope of ``near`` first (modules here define many same-named
+        ``step``/``body`` helpers -- flat name lookup would cross-wire
+        them)."""
+        if isinstance(node, ast.Lambda):
+            return node
+        if isinstance(node, ast.Call):  # partial(body, ...)
+            if self.aliases.is_partial_ref(node.func) and node.args:
+                return self._resolve_local_callable(node.args[0], near)
+            return None
+        if not isinstance(node, ast.Name):
+            return None
+        scope = near if near is not None else node
+        while scope is not None:
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Module)):
+                for stmt in scope.body:
+                    if isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) \
+                            and stmt.name == node.id:
+                        return stmt
+            scope = self._parents.get(id(scope))
+        return None
+
+    # FL112: jit closures over large concrete arrays
+    def _check_jit_captures(self, site, parents):
+        func = site.func
+        bound = set(_param_names(func))
+        for n in ast.walk(func):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                bound.add(n.id)
+            elif isinstance(n, ast.arg):
+                bound.add(n.arg)
+            elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and n is not func:
+                bound.add(n.name)
+        free = {}
+        for n in ast.walk(func):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and n.id not in bound:
+                free.setdefault(n.id, n)
+        if not free:
+            return
+        scope_assigns = {}
+        p = parents.get(id(func))
+        while p is not None:
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Module)):
+                for stmt in p.body:
+                    if isinstance(stmt, ast.Assign) \
+                            and len(stmt.targets) == 1 \
+                            and isinstance(stmt.targets[0], ast.Name):
+                        scope_assigns.setdefault(stmt.targets[0].id,
+                                                 stmt.value)
+            p = parents.get(id(p))
+        for name in sorted(free):
+            value = scope_assigns.get(name)
+            size = self._static_array_size(value)
+            if size is not None and size >= FL112_MIN_ELEMENTS:
+                self.add(site.site, "FL112",
+                         f"jitted function closes over `{name}` "
+                         f"(~{size} elements built in an outer scope) -- "
+                         "the array is baked into the jaxpr as a "
+                         "constant; pass it as an argument so XLA "
+                         "aliases one copy")
+                return
+
+    def _static_array_size(self, node):
+        """Element count of a jnp/np array-constructor call with literal
+        shape, else None."""
+        if not isinstance(node, ast.Call):
+            return None
+        f = node.func
+        if not (isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and (f.value.id in self.aliases.jnp
+                     or f.value.id in self.aliases.np)):
+            return None
+        if f.attr in ("zeros", "ones", "full", "empty") and node.args:
+            shape = node.args[0]
+            if isinstance(shape, ast.Constant) \
+                    and isinstance(shape.value, int):
+                return shape.value
+            if isinstance(shape, (ast.Tuple, ast.List)):
+                size = 1
+                for e in shape.elts:
+                    if not (isinstance(e, ast.Constant)
+                            and isinstance(e.value, int)):
+                        return None
+                    size *= e.value
+                return size
+        if f.attr == "arange" and len(node.args) == 1 \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, int):
+            return node.args[0].value
+        return None
 
     def _check_pytree_sink(self, node):
         root, attr = _call_root_name(node.func)
@@ -560,17 +836,19 @@ class _ModuleLinter:
 
 # -- driver ---------------------------------------------------------------
 
-def lint_source(src, path="<string>", select=None, ignore=None):
-    """Lint one module's source. Returns non-suppressed findings."""
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [Finding(path=path, line=e.lineno or 1, col=(e.offset or 0),
-                        code="FL100", message=f"syntax error: {e.msg}")]
+def _lint_module(path, src, tree, index, select=None, ignore=None):
+    """Per-module rules + (when ``index`` is given) the project-wide
+    FL110 dataflow pass, filtered through suppressions/select/ignore."""
     per_line, per_file = _parse_suppressions(src)
-    findings = _ModuleLinter(path, src, tree).run()
+    linter = _ModuleLinter(path, src, tree)
+    linter.run()
+    if index is not None:
+        from fedml_tpu.analysis.dataflow import (ProjectIndex,
+                                                 check_use_after_donate)
+        check_use_after_donate(index, ProjectIndex.module_name(path), tree,
+                               linter.add)
     out = []
-    for f in findings:
+    for f in linter.findings:
         if select and f.code not in select:
             continue
         if ignore and f.code in ignore:
@@ -580,6 +858,21 @@ def lint_source(src, path="<string>", select=None, ignore=None):
         out.append(f)
     out.sort(key=lambda f: (f.line, f.col, f.code))
     return out
+
+
+def lint_source(src, path="<string>", select=None, ignore=None):
+    """Lint one module's source (project-wide rules see only this one
+    module). Returns non-suppressed findings."""
+    from fedml_tpu.analysis.dataflow import ProjectIndex
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding(path=path, line=e.lineno or 1, col=(e.offset or 0),
+                        code="FL100", message=f"syntax error: {e.msg}")]
+    index = ProjectIndex()
+    index.add_module(path, tree, _Aliases(tree))
+    return _lint_module(path, src, tree, index, select=select,
+                        ignore=ignore)
 
 
 def iter_python_files(paths):
@@ -596,13 +889,29 @@ def iter_python_files(paths):
 
 
 def lint_paths(paths, select=None, ignore=None):
-    findings = []
+    """Two-pass project lint: pass 1 parses every file and builds the
+    cross-module jit symbol table (donation contracts travel through
+    builder returns and imports); pass 2 runs the rules per module with
+    that index in scope."""
+    from fedml_tpu.analysis.dataflow import ProjectIndex
+    index = ProjectIndex()
+    modules, findings = [], []
     for path in iter_python_files(paths):
         with open(path, encoding="utf-8") as fh:
             src = fh.read()
         rel = os.path.relpath(path)
-        findings.extend(lint_source(src, path=rel, select=select,
-                                    ignore=ignore))
+        try:
+            tree = ast.parse(src, filename=rel)
+        except SyntaxError as e:
+            findings.append(Finding(
+                path=rel, line=e.lineno or 1, col=(e.offset or 0),
+                code="FL100", message=f"syntax error: {e.msg}"))
+            continue
+        index.add_module(rel, tree, _Aliases(tree))
+        modules.append((rel, src, tree))
+    for rel, src, tree in modules:
+        findings.extend(_lint_module(rel, src, tree, index, select=select,
+                                     ignore=ignore))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
     return findings
 

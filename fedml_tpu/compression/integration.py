@@ -22,6 +22,8 @@ fidelity (they are small and bias-sensitive).
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 
@@ -53,7 +55,7 @@ def make_compressed_sim_round(spec, cfg, compressor: Compressor,
     payload_fn = payload_fn or _default_payload
     server_fn = server_fn or _default_server
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(0, 1, 3))
     def round_fn(global_state, server_state, cohort_data, residuals, rng):
         C = cohort_data["mask"].shape[0]
         # rng derivation parity with make_sim_round (folds 1 and 2) so a

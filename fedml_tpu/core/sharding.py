@@ -1,0 +1,28 @@
+"""``shard_map`` across JAX versions.
+
+The public ``jax.shard_map`` (with its ``check_vma`` parameter) landed
+after the experimental ``jax.experimental.shard_map.shard_map`` (whose
+equivalent knob is ``check_rep``). Every shard_map in this repo goes
+through this one wrapper so the supported-version window is a property
+of one module, not of five call sites.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None):
+    """Version-portable ``shard_map``. ``check_vma=None`` leaves the
+    library default in place on either API."""
+    if hasattr(jax, "shard_map"):
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    kw = {} if check_vma is None else {"check_rep": check_vma}
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)
+
+
+__all__ = ["shard_map"]

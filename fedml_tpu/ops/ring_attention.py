@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from fedml_tpu.core.sharding import shard_map
 from fedml_tpu.ops.attention import (NEG_INF, _finalize, _online_step,
                                      blockwise_attention)
 
@@ -111,8 +112,8 @@ def make_ring_attention(mesh, axis_name: str = SEQ_AXIS,
     body = partial(_ring_body, axis_name=axis_name, causal=causal,
                    scale=scale, block_size=block_size)
     spec = P(batch_axis, axis_name, None, None)
-    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)
+    return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)
 
 
 def ring_attention(q, k, v, mesh, axis_name: str = SEQ_AXIS,

@@ -37,6 +37,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from fedml_tpu.core import pytree
+from fedml_tpu.core.sharding import shard_map
 from fedml_tpu.core.trainer import TrainSpec
 from fedml_tpu.parallel.mesh import CLIENT_AXIS, zero_pad_leading
 
@@ -682,7 +683,7 @@ class LaneRunner:
             lane_update = make_lane_update(spec, cfg, self.payload_fn)
         server_fn_ = self.server_fn
 
-        @jax.jit
+        @partial(jax.jit, donate_argnums=(0, 1))
         def round_fn(global_state, server_state, device_x, device_y, rows,
                      lanes, step_keys, trip, dtypes, rng):
             R, n_max = device_x.shape[0], device_x.shape[1]
@@ -819,14 +820,14 @@ class ShardedLaneRunner:
                                                 server_state, rng)
             return new_global, new_server, metrics
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             shard_fn, mesh=mesh,
             in_specs=(P(), P(), P(CLIENT_AXIS), P(CLIENT_AXIS),
                       P(CLIENT_AXIS), P(CLIENT_AXIS), P(CLIENT_AXIS),
                       P(), P(), P()),
             out_specs=(P(), P(), P()),
             check_vma=False)
-        self._round_fn = jax.jit(sharded)
+        self._round_fn = jax.jit(sharded, donate_argnums=(0, 1))
         self._fold_keys = fold_step_keys
         self._dtypes = None
 
@@ -941,7 +942,7 @@ def make_indexed_sim_round(spec: TrainSpec, cfg: ClientUpdateConfig,
     payload_fn = payload_fn or _default_payload
     server_fn = server_fn or _default_server
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(0, 1))
     def round_fn(global_state, server_state, device_data, sched, rng):
         C = sched["mask"].shape[0]
         rngs = jax.random.split(jax.random.fold_in(rng, 1), C)
@@ -1029,7 +1030,7 @@ def make_sim_round(spec: TrainSpec, cfg: ClientUpdateConfig,
     payload_fn = payload_fn or _default_payload
     server_fn = server_fn or _default_server
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(0, 1))
     def round_fn(global_state, server_state, cohort_data, rng):
         C = cohort_data["mask"].shape[0]
         # identical rng derivation as make_sharded_round so the two placements
@@ -1081,13 +1082,13 @@ def make_sharded_round(spec: TrainSpec, cfg: ClientUpdateConfig, mesh,
             global_state, avg_payload, server_state, rng)
         return new_global, new_server_state, {"aux": aux, "metrics": metrics}
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(), P(), P(CLIENT_AXIS), P()),
         out_specs=(P(), P(), P(CLIENT_AXIS)),
         check_vma=False)
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(0, 1))
     def round_fn(global_state, server_state, cohort_data, rng):
         C = cohort_data["mask"].shape[0]
         rngs = jax.random.split(jax.random.fold_in(rng, 1), C)

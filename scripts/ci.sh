@@ -9,8 +9,32 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== fedlint gate (JAX/FL static analysis, fedml_tpu/analysis;"
-echo "   fails on findings not in fedml_tpu/analysis/fedlint_baseline.json) =="
-python -m fedml_tpu.analysis fedml_tpu/
+echo "   fails on findings not in fedml_tpu/analysis/fedlint_baseline.json,"
+echo "   on ANY remaining baseline debt, and on a non-idempotent --fix) =="
+mkdir -p bench_results
+if ! python -m fedml_tpu.analysis fedml_tpu/ --format json \
+        > bench_results/fedlint_report.json; then
+    # fail LOUD: echo the findings into the CI log, don't make the
+    # maintainer reproduce locally to learn which rule fired
+    cat bench_results/fedlint_report.json
+    echo "fedlint gate: new findings (see report above)"
+    exit 1
+fi
+python - <<'EOF'
+import json
+rep = json.load(open("bench_results/fedlint_report.json"))
+assert rep["summary"]["new"] == 0, ("new fedlint findings", rep["summary"])
+# the FL104 donation debt was burned to zero; the gate now also holds the
+# baseline itself at zero -- re-accepting debt means re-arguing for it in
+# a baseline diff, not silently growing the register
+assert rep["summary"]["baselined"] == 0, (
+    "baseline debt must stay at zero", rep["summary"])
+bl = json.load(open("fedml_tpu/analysis/fedlint_baseline.json"))
+assert bl["findings"] == [], "fedlint_baseline.json must stay empty"
+print("fedlint gate: 0 findings, baseline empty")
+EOF
+echo "-- fedlint --fix idempotence (clean tree => empty diff) --"
+python -m fedml_tpu.analysis fedml_tpu/ --fix --diff
 
 echo "== fast test tier (engine / core / utils / native / data-extra / online;"
 echo "   includes the federated==centralized + wave/lane==flat equivalence asserts) =="

@@ -195,6 +195,58 @@ class TestHierarchical:
         assert last["Train/Acc"] > first["Train/Acc"]
 
 
+class TestDonationSafety:
+    """The algorithm round fns donate their state args (FL104 burn-down).
+    Every API threads state as ``self.x, ... = self._round_fn(self.x,
+    ...)``, so multi-round training, evaluation after training, and the
+    A/B reductions above must all still hold -- these tests pin the
+    buffer-lifetime side of that contract explicitly."""
+
+    def test_hierarchical_reference_equality_survives_donation(self):
+        # same reduction as test_one_group_one_subround_equals_fedavg,
+        # but run for TWO rounds: round 2 consumes round 1's donated-in
+        # output, which catches any use-after-donate in the round loop
+        ds = load_synthetic_federated(client_num=4, n_train=400, n_test=100,
+                                      alpha=0.0, beta=0.0,
+                                      partition="homo", seed=0)
+        a1 = FedAvgAPI(ds, _spec(), _args(client_num_per_round=4))
+        a2 = HierarchicalFedAvgAPI(
+            ds, _spec(), _args(client_num_per_round=4, group_num=1,
+                               group_comm_round=1))
+        for _ in range(2):
+            a1.train_one_round()
+            a2.train_one_round()
+        for x, y in zip(jax.tree.leaves(a1.global_state["params"]),
+                        jax.tree.leaves(a2.global_state["params"])):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       atol=1e-5)
+
+    def test_decentralized_state_readable_after_donated_rounds(self):
+        # states/pushsum_w/residuals are donated every round; the public
+        # accessors must keep working on the rebound outputs
+        ds = _dataset(4, 400)
+        api = DecentralizedFedAPI(ds, _spec(),
+                                  _args(client_num_per_round=4,
+                                        comm_round=2, lr=0.1))
+        api.train_one_round()
+        api.train_one_round()
+        assert np.isfinite(api.consensus_distance())
+        node = api.node_state(0)
+        assert all(np.isfinite(np.asarray(x)).all()
+                   for x in jax.tree.leaves(node))
+
+    def test_fedopt_server_state_donated_across_rounds(self):
+        # FedOpt threads REAL server optimizer state through the donated
+        # position; three rounds + eval prove the rebind chain is sound
+        ds = _dataset()
+        api = FedOptAPI(ds, _spec(), _args(server_optimizer="adam",
+                                           server_lr=0.05, comm_round=3))
+        for _ in range(3):
+            api.train_one_round()
+        out = api.evaluate_global()
+        assert np.isfinite(out["Test/Loss"])
+
+
 class TestDecentralized:
     def test_mixing_preserves_average(self):
         # row-stochastic symmetric W with uniform weights preserves the mean

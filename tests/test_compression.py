@@ -259,12 +259,17 @@ class TestCompressedFedAvg:
         return ds, spec
 
     def test_none_compressor_matches_uncompressed(self):
+        # two rounds on purpose: the compressed round fn donates its
+        # state AND residual args (fedlint FL104 burn-down), and round 2
+        # re-gathers the cohort residuals from the full per-client store
+        # -- proving the donated round-1 buffers were never re-read
         from fedml_tpu.algorithms.fedavg import FedAvgAPI
         ds, spec = self._setup()
         a = FedAvgAPI(ds, spec, _fed_args(compressor="none"))
         b = FedAvgAPI(ds, spec, _fed_args())
-        a.train_one_round()
-        b.train_one_round()
+        for _ in range(2):
+            a.train_one_round()
+            b.train_one_round()
         for x, y in zip(jax.tree.leaves(a.global_state["params"]),
                         jax.tree.leaves(b.global_state["params"])):
             np.testing.assert_allclose(np.asarray(x), np.asarray(y),

@@ -50,7 +50,7 @@ class TestPytree:
 
     def test_weighted_psum_mean_under_shard_map(self):
         from jax.sharding import Mesh, PartitionSpec as P
-        from jax import shard_map
+        from fedml_tpu.core.sharding import shard_map
 
         devs = np.array(jax.devices()[:8])
         mesh = Mesh(devs, ("clients",))

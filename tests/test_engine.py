@@ -29,6 +29,14 @@ def _args(**kw):
     return types.SimpleNamespace(**base)
 
 
+def _fresh(tree):
+    """Deep-copy a state pytree. The engine round fns donate their state
+    arguments (fedlint FL104 burn-down): a donated buffer is deleted when
+    the call returns, so A/B comparisons that invoke two round paths from
+    one initial state must hand each its own buffers."""
+    return jax.tree.map(jnp.copy, tree)
+
+
 def _lr_spec(feature_dim=60, classes=10):
     model = models.LogisticRegression(num_classes=classes, apply_sigmoid=False)
     return make_classification_spec(model, jnp.zeros((1, feature_dim)))
@@ -93,10 +101,11 @@ class TestFederatedEqualsCentralized:
 
         round_fn = make_sim_round(spec, cfg)
         packed = pack_cohort(clients, batch_size=64, epochs=1)
-        fed_state, _, _ = round_fn(state, (), packed, rng)
+        fed_state, _, _ = round_fn(_fresh(state), (), packed, rng)
 
         central_packed = pack_cohort([pooled], batch_size=64, epochs=1)
-        central_state, _, _ = round_fn(state, (), central_packed, rng)
+        central_state, _, _ = round_fn(_fresh(state), (), central_packed,
+                                       rng)
 
         for a, b in zip(jax.tree.leaves(fed_state["params"]),
                         jax.tree.leaves(central_state["params"])):
@@ -116,8 +125,9 @@ class TestFederatedEqualsCentralized:
         mesh = make_client_mesh(8)
         sharded = make_sharded_round(spec, cfg, mesh)
 
-        s1, _, _ = sim(state, (), packed, jax.random.PRNGKey(5))
-        s2, _, _ = sharded(state, (), packed, jax.random.PRNGKey(5))
+        s1, _, _ = sim(_fresh(state), (), packed, jax.random.PRNGKey(5))
+        s2, _, _ = sharded(_fresh(state), (), packed,
+                           jax.random.PRNGKey(5))
         for a, b in zip(jax.tree.leaves(s1["params"]),
                         jax.tree.leaves(s2["params"])):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
@@ -133,8 +143,9 @@ class TestFederatedEqualsCentralized:
         packed = pack_cohort(clients, batch_size=8, epochs=1)
         sim = make_sim_round(spec, cfg)
         sharded = make_sharded_round(spec, cfg, make_client_mesh(8))
-        s1, _, _ = sim(state, (), packed, jax.random.PRNGKey(5))
-        s2, _, _ = sharded(state, (), packed, jax.random.PRNGKey(5))
+        s1, _, _ = sim(_fresh(state), (), packed, jax.random.PRNGKey(5))
+        s2, _, _ = sharded(_fresh(state), (), packed,
+                           jax.random.PRNGKey(5))
         np.testing.assert_allclose(
             np.asarray(s1["params"]["linear"]["kernel"]),
             np.asarray(s2["params"]["linear"]["kernel"]), atol=1e-5)
@@ -167,11 +178,11 @@ class TestWaveRunner:
 
         flat = make_indexed_sim_round(spec, cfg)
         js = {k: jnp.asarray(v) for k, v in sched.items()}
-        s_flat, _, info_flat = flat(state, (), dd, js, rng)
+        s_flat, _, info_flat = flat(_fresh(state), (), dd, js, rng)
 
         wr = WaveRunner(spec, cfg, client_chunk=chunk)
         s_wave, _, info_wave = wr.run_round(
-            state, (), dd, list(range(len(sizes))), sched, rng)
+            _fresh(state), (), dd, list(range(len(sizes))), sched, rng)
 
         for a, b in zip(jax.tree.leaves(s_flat), jax.tree.leaves(s_wave)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
@@ -205,10 +216,10 @@ class TestWaveRunner:
         rng = jax.random.PRNGKey(11)
         flat = make_indexed_sim_round(spec, cfg, payload_fn, server_fn)
         js = {k: jnp.asarray(v) for k, v in sched.items()}
-        s_flat, _, _ = flat(state, (), dd, js, rng)
+        s_flat, _, _ = flat(_fresh(state), (), dd, js, rng)
         wr = WaveRunner(spec, cfg, payload_fn, server_fn, client_chunk=2)
         s_wave, _, _ = wr.run_round(
-            state, (), dd, list(range(len(sizes))), sched, rng)
+            _fresh(state), (), dd, list(range(len(sizes))), sched, rng)
         for a, b in zip(jax.tree.leaves(s_flat), jax.tree.leaves(s_wave)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-5)
@@ -225,11 +236,11 @@ class TestWaveRunner:
 
         flat = make_indexed_sim_round(spec, cfg)
         js = {k: jnp.asarray(v) for k, v in sched.items()}
-        s_flat, _, info_flat = flat(state, (), dd, js, rng)
+        s_flat, _, info_flat = flat(_fresh(state), (), dd, js, rng)
 
         lr_ = LaneRunner(spec, cfg, n_lanes=n_lanes)
         s_lane, _, info_lane = lr_.run_round(
-            state, (), dd, list(range(len(sizes))), sched, rng)
+            _fresh(state), (), dd, list(range(len(sizes))), sched, rng)
 
         for a, b in zip(jax.tree.leaves(s_flat), jax.tree.leaves(s_lane)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
@@ -263,10 +274,10 @@ class TestWaveRunner:
         rng = jax.random.PRNGKey(11)
         flat = make_indexed_sim_round(spec, cfg, payload_fn, server_fn)
         js = {k: jnp.asarray(v) for k, v in sched.items()}
-        s_flat, _, _ = flat(state, (), dd, js, rng)
+        s_flat, _, _ = flat(_fresh(state), (), dd, js, rng)
         lr_ = LaneRunner(spec, cfg, payload_fn, server_fn, n_lanes=2)
         s_lane, _, _ = lr_.run_round(
-            state, (), dd, list(range(len(sizes))), sched, rng)
+            _fresh(state), (), dd, list(range(len(sizes))), sched, rng)
         for a, b in zip(jax.tree.leaves(s_flat), jax.tree.leaves(s_lane)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-5)
@@ -299,14 +310,14 @@ class TestWaveRunner:
 
         flat = make_indexed_sim_round(spec, cfg)
         js = {k: jnp.asarray(v) for k, v in sched.items()}
-        s_flat, _, info_flat = flat(state, (), dd, js, rng)
+        s_flat, _, info_flat = flat(_fresh(state), (), dd, js, rng)
 
         mesh = make_client_mesh(8)
         placed = global_cohort(mesh, {"x": np.asarray(dd["x"]),
                                       "y": np.asarray(dd["y"])})
         slr = ShardedLaneRunner(spec, cfg, mesh, n_lanes=2)
         s_sh, _, info_sh = slr.run_round(
-            state, (), placed, list(range(len(sizes))), sched, rng)
+            _fresh(state), (), placed, list(range(len(sizes))), sched, rng)
 
         for a, b in zip(jax.tree.leaves(s_flat), jax.tree.leaves(s_sh)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
@@ -343,14 +354,15 @@ class TestWaveRunner:
         sel = np.asarray(cohort)
         dd_sub = {k: jnp.asarray(np.asarray(v)[sel]) for k, v in dd.items()}
         js = {k: jnp.asarray(v) for k, v in sched.items()}
-        s_flat, _, _ = flat(state, (), dd_sub, js, rng)
+        s_flat, _, _ = flat(_fresh(state), (), dd_sub, js, rng)
 
         mesh = make_client_mesh(8)
         placed = global_cohort(mesh, {"x": np.asarray(dd["x"]),
                                       "y": np.asarray(dd["y"])})
         slr = ShardedLaneRunner(spec, cfg, mesh, payload_fn, server_fn,
                                 n_lanes=2)
-        s_sh, _, info = slr.run_round(state, (), placed, cohort, sched, rng)
+        s_sh, _, info = slr.run_round(_fresh(state), (), placed, cohort,
+                                      sched, rng)
         assert float(np.asarray(info["metrics"]["count"])) == sum(ns)
         for a, b in zip(jax.tree.leaves(s_flat), jax.tree.leaves(s_sh)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
@@ -372,6 +384,99 @@ class TestWaveRunner:
             assert np.isfinite(np.asarray(leaf)).all()
 
 
+class TestDonationSafety:
+    """The FL104 burn-down contract: round fns donate their state args
+    (old + new model state must not be live simultaneously on TPU), and
+    that must change nothing about the math -- re-invocation on fresh
+    buffers reproduces the identical trajectory, outputs stay readable,
+    and the only thing that dies is the donated input."""
+
+    def _setup(self):
+        spec = _lr_spec()
+        cfg = ClientUpdateConfig(lr=0.4)
+        state = spec.init_fn(jax.random.PRNGKey(2))
+        rnd = np.random.default_rng(9)
+        clients = [{"x": rnd.normal(size=(n, 60)).astype(np.float32),
+                    "y": rnd.integers(0, 10, n).astype(np.int64)}
+                   for n in (12, 20, 8, 16)]
+        packed = pack_cohort(clients, batch_size=8, epochs=1)
+        return spec, cfg, state, packed
+
+    @staticmethod
+    def _backend_donates():
+        probe = jnp.ones((4,))
+        jax.jit(lambda v: v * 2, donate_argnums=(0,))(probe)
+        return probe.is_deleted()
+
+    def test_donation_is_real_and_input_is_deleted(self):
+        if not self._backend_donates():
+            pytest.skip("backend ignores buffer donation")
+        spec, cfg, state, packed = self._setup()
+        round_fn = make_sim_round(spec, cfg)
+        arg = _fresh(state)
+        out, _, _ = round_fn(arg, (), packed, jax.random.PRNGKey(0))
+        # the HBM claim is real: the donated input buffers are gone...
+        assert all(leaf.is_deleted() for leaf in jax.tree.leaves(arg))
+        # ...and reading one raises rather than returning stale data
+        with pytest.raises(RuntimeError, match="deleted"):
+            np.asarray(jax.tree.leaves(arg)[0])
+        # outputs are live, finite, and the original template untouched
+        assert all(np.isfinite(np.asarray(x)).all()
+                   for x in jax.tree.leaves(out))
+        assert all(not leaf.is_deleted() for leaf in jax.tree.leaves(state))
+
+    def test_reinvocation_on_fresh_buffers_is_deterministic(self):
+        # the A/B guarantee donation must not break: two invocations from
+        # fresh copies of the same initial state are bit-identical
+        spec, cfg, state, packed = self._setup()
+        round_fn = make_sim_round(spec, cfg)
+        rng = jax.random.PRNGKey(7)
+        s1, _, _ = round_fn(_fresh(state), (), packed, rng)
+        s2, _, _ = round_fn(_fresh(state), (), packed, rng)
+        for a, b in zip(jax.tree.leaves(s1), jax.tree.leaves(s2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_round_chaining_through_donated_state(self):
+        # the production idiom: state = round_fn(state, ...) chains rounds
+        # through donated buffers without copies
+        spec, cfg, state, packed = self._setup()
+        round_fn = make_sim_round(spec, cfg)
+        chained = _fresh(state)
+        for r in range(3):
+            chained, _, _ = round_fn(chained, (), packed,
+                                     jax.random.fold_in(jax.random.PRNGKey(1),
+                                                        r))
+        # reference trajectory without ever donating the caller's copy
+        ref = _fresh(state)
+        for r in range(3):
+            ref, _, _ = round_fn(_fresh(ref), (), packed,
+                                 jax.random.fold_in(jax.random.PRNGKey(1), r))
+        for a, b in zip(jax.tree.leaves(chained), jax.tree.leaves(ref)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_indexed_round_does_not_donate_device_data(self):
+        # device-resident shards persist across rounds: only the state
+        # args are donated, never the HBM dataset or the schedule
+        spec, cfg, state, _ = self._setup()
+        rnd = np.random.default_rng(3)
+        clients = [{"x": rnd.normal(size=(n, 60)).astype(np.float32),
+                    "y": rnd.integers(0, 10, n).astype(np.int64)}
+                   for n in (10, 14, 6)]
+        stacked = stack_clients(clients)
+        dd = {"x": jnp.asarray(stacked["x"]), "y": jnp.asarray(stacked["y"])}
+        sched = {k: jnp.asarray(v) for k, v in pack_schedule(
+            [len(c["y"]) for c in clients], 8, epochs=1,
+            rng=np.random.default_rng(1)).items()}
+        flat = make_indexed_sim_round(spec, cfg)
+        s = _fresh(state)
+        for r in range(2):  # second round re-reads dd/sched: must be live
+            s, _, _ = flat(s, (), dd, sched,
+                           jax.random.fold_in(jax.random.PRNGKey(4), r))
+        assert not dd["x"].is_deleted() and not sched["idx"].is_deleted()
+        assert all(np.isfinite(np.asarray(x)).all()
+                   for x in jax.tree.leaves(s))
+
+
 class TestBatchNormState:
     def test_batch_stats_travel_through_round(self):
         class TinyBN(nn.Module):
@@ -391,7 +496,8 @@ class TestBatchNormState:
                    for _ in range(4)]
         packed = pack_cohort(clients, batch_size=4, epochs=1)
         round_fn = make_sim_round(spec, ClientUpdateConfig(lr=0.1))
-        new_state, _, _ = round_fn(state, (), packed, jax.random.PRNGKey(1))
+        new_state, _, _ = round_fn(_fresh(state), (), packed,
+                                   jax.random.PRNGKey(1))
         # running stats must have moved away from init (mean 0)
         assert not np.allclose(
             np.asarray(jax.tree.leaves(new_state["batch_stats"])[0]),

@@ -250,16 +250,18 @@ def test_sharded_packed_lanes_equal_flat():
                           rng=np.random.default_rng(5))
     rng = jax.random.PRNGKey(3)
 
+    # both round paths donate their state args: hand each a fresh copy
+    fresh = lambda t: jax.tree.map(jnp.copy, t)
     flat = make_indexed_sim_round(spec, cfg)
     dd = {"x": jnp.asarray(stacked["x"]), "y": jnp.asarray(stacked["y"])}
     js = {k: jnp.asarray(v) for k, v in sched.items()}
-    s_flat, _, _ = flat(state, (), dd, js, rng)
+    s_flat, _, _ = flat(fresh(state), (), dd, js, rng)
 
     mesh = make_client_mesh(8)
     placed = global_cohort(mesh, {"x": stacked["x"], "y": stacked["y"]})
     slr = ShardedLaneRunner(spec, cfg, mesh, n_lanes=2, packed=True)
     s_sh, _, _ = slr.run_round(
-        state, (), placed, list(range(len(sizes))), sched, rng)
+        fresh(state), (), placed, list(range(len(sizes))), sched, rng)
     for a, b in zip(jax.tree.leaves(s_flat), jax.tree.leaves(s_sh)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=5e-5)
