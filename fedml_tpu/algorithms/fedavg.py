@@ -25,15 +25,27 @@ from fedml_tpu.parallel.packing import (
     pack_cohort, pack_eval, pack_schedule, stack_clients)
 
 
-def client_sampling(round_idx, client_num_in_total, client_num_per_round):
+def attempt_seed(round_idx, attempt=0):
+    """Cohort-sampling seed for ``(round, attempt)``. Attempt 0 is the
+    historical per-round seed (bit-compatible with every pre-resilience
+    run); abandoned-round re-runs (``fedml_tpu.resilience``) fold the
+    attempt in to draw a fresh cohort for the same round index. The ONE
+    definition shared by the simulation path and the distributed FSM --
+    the cross-path A/B and resume contracts depend on them agreeing."""
+    return round_idx if attempt == 0 else round_idx + 1_000_003 * attempt
+
+
+def client_sampling(round_idx, client_num_in_total, client_num_per_round,
+                    attempt=0):
     """Seeded-by-round cohort sampling, exactly the reference's
     ``FedAVGAggregator._client_sampling`` (``FedAVGAggregator.py:89-97``):
     reseeding with the round index makes runs reproducible and lets A/B runs
-    pick identical client subsets."""
+    pick identical client subsets. ``attempt`` folds into the seed via
+    :func:`attempt_seed` for abandoned-round re-runs."""
     num_clients = min(client_num_per_round, client_num_in_total)
     if client_num_in_total == num_clients:
         return list(range(client_num_in_total))
-    np.random.seed(round_idx)
+    np.random.seed(attempt_seed(round_idx, attempt))
     return list(np.random.choice(range(client_num_in_total),
                                  num_clients, replace=False))
 
@@ -163,6 +175,13 @@ class FedAvgAPI:
                         and spec.lane_loss_builder is not None))
         self.server_state = server_state if server_state is not None else ()
 
+        # over-selection + simulated deadline misses (--overselect /
+        # --straggler_p): cohort restriction IS the renormalized partial
+        # aggregate, since the round fns weight by per-client sample counts
+        from fedml_tpu.resilience.integration import SimResilience
+        self.resilience = SimResilience.from_args(args)
+        self._last_res_record = None
+
         seed = getattr(args, "seed", 0)
         self.rng = jax.random.PRNGKey(seed)
         self.global_state = spec.init_fn(jax.random.fold_in(self.rng, 0))
@@ -216,10 +235,23 @@ class FedAvgAPI:
               else stacked["x"])
         return {"host": {"x": xh, "y": stacked["y"]}, "n": stacked["n"]}
 
-    def _cohort(self, round_idx):
-        client_indexes = client_sampling(
+    def _sample_cohort(self, round_idx):
+        """Cohort for one round: plain seeded sampling, or -- with
+        resilience enabled -- over-selection trimmed to the reporting
+        subset (``fedml_tpu.resilience.SimResilience.sample``)."""
+        if self.resilience is None:
+            self._last_res_record = None
+            return client_sampling(round_idx,
+                                   len(self.train_data_local_dict),
+                                   self.args.client_num_per_round)
+        client_indexes, record = self.resilience.sample(
             round_idx, len(self.train_data_local_dict),
             self.args.client_num_per_round)
+        self._last_res_record = record
+        return client_indexes
+
+    def _cohort(self, round_idx):
+        client_indexes = self._sample_cohort(round_idx)
         logging.info("client_indexes = %s", client_indexes)
         datasets = [self.train_data_local_dict[i] for i in client_indexes]
         if all(len(d["y"]) == 0 for d in datasets):
@@ -239,9 +271,7 @@ class FedAvgAPI:
         self.rng, round_rng = jax.random.split(self.rng)
         if self.device_data is not None:
             import jax.numpy as jnp
-            client_indexes = client_sampling(
-                self.round_idx, len(self.train_data_local_dict),
-                self.args.client_num_per_round)
+            client_indexes = self._sample_cohort(self.round_idx)
             logging.info("client_indexes = %s", client_indexes)
             ns = [self._client_ns[i] for i in client_indexes]
             if sum(ns) == 0:
@@ -304,6 +334,8 @@ class FedAvgAPI:
             "Train/Acc": float(m["correct"].sum() / max(m["count"].sum(), 1)),
             "round_time_s": dt,
         }
+        if self._last_res_record is not None:
+            train_metrics.update(self._last_res_record)
         if self.compressed_round_fn is not None:
             # client->server update traffic this round (uplink; the
             # downlink model broadcast is uncompressed and identical in

@@ -55,7 +55,14 @@ def make_fedopt_hooks(server_tx):
 class FedOptAPI(FedAvgAPI):
     """FedAvg loop + server optimizer (reference ``fedopt_api.py:62-109``).
     Extra args: ``server_optimizer`` (default ``sgd``), ``server_lr``
-    (default 1.0), ``server_momentum``."""
+    (default 1.0), ``server_momentum``.
+
+    Resilience (``--overselect`` / ``--straggler_p`` / ``--quorum``)
+    composes through the inherited round loop: the pseudo-gradient is
+    ``global - avg`` where ``avg`` is already the renormalized average
+    over the *reporting* subset, so a degraded round steps the server
+    optimizer on the surviving cohort's consensus -- exactly the
+    Bonawitz-style partial aggregate, never a zero-biased one."""
 
     def __init__(self, dataset, spec, args, mesh=None, metrics_logger=None,
                  compressor=None):

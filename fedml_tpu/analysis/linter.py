@@ -634,9 +634,19 @@ class _ModuleLinter:
                           if isinstance(c, ast.Call)
                           and self._is_pspec_ref(c.func)]
                 if not pcalls:
-                    # spec bound to a name / built elsewhere: out of
-                    # static reach -- judge nothing rather than guess
-                    return
+                    # spec bound to a name: resolve through ONE assignment
+                    # hop (`spec = P(...)` in an enclosing scope, the
+                    # ring_attention idiom); anything further -- parameter,
+                    # rebinding, name-of-a-name -- stays out of static
+                    # reach and judges nothing rather than guessing
+                    value = self._resolve_spec_assignment(entry, node)
+                    if value is None:
+                        return
+                    pcalls = [c for c in ast.walk(value)
+                              if isinstance(c, ast.Call)
+                              and self._is_pspec_ref(c.func)]
+                    if not pcalls:
+                        return
                 if any(c.args or c.keywords for c in pcalls):
                     any_partitioned = True
             if entries and not any_partitioned:
@@ -653,6 +663,40 @@ class _ModuleLinter:
             return node.id in self.aliases.pspec
         return isinstance(node, ast.Attribute) \
             and node.attr == "PartitionSpec"
+
+    def _resolve_spec_assignment(self, entry, near):
+        """One-hop name resolution for FL109: find the single
+        ``name = <expr>`` binding of ``entry`` in an enclosing scope of
+        ``near`` (innermost first) and return the assigned expression.
+        Returns None -- judge nothing -- when the name is a function
+        parameter (caller-supplied), is bound more than once or through
+        non-Assign forms (loop targets, tuple unpacking), or resolves to
+        another bare name (a second hop)."""
+        if not isinstance(entry, ast.Name):
+            return None
+        name = entry.id
+        scope = near
+        while scope is not None:
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and name in _param_names(scope):
+                return None
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Module)):
+                assigns = [stmt.value for stmt in scope.body
+                           if isinstance(stmt, ast.Assign)
+                           and len(stmt.targets) == 1
+                           and isinstance(stmt.targets[0], ast.Name)
+                           and stmt.targets[0].id == name]
+                stores = [n for n in ast.walk(scope)
+                          if isinstance(n, ast.Name)
+                          and isinstance(n.ctx, ast.Store) and n.id == name]
+                if len(assigns) == 1 and len(stores) == 1:
+                    value = assigns[0]
+                    return None if isinstance(value, ast.Name) else value
+                if stores:  # rebound or bound through complex targets
+                    return None
+            scope = self._parents.get(id(scope))
+        return None
 
     # FL111: scan carry initialized from weak-typed Python scalars
     def _check_scan_carry(self, node):

@@ -28,6 +28,17 @@ class Observer(abc.ABC):
 
 
 class BaseCommunicationManager(abc.ABC):
+    """Transport contract. Two optional extensions the concrete backends
+    (local/tcp/mqtt) all implement and ``fedml_tpu.resilience`` relies on:
+
+    - ``send_message(msg, is_resend=False)``: the retry layer flags
+      resends so wire accounting counts the re-sent bytes without
+      double-counting the logical payload.
+    - ``abort()``: die abruptly (no clean-shutdown handshake) so peers
+      observe :data:`MSG_TYPE_PEER_LOST` -- the fault-injection harness's
+      crash primitive.
+    """
+
     @abc.abstractmethod
     def send_message(self, msg):
         ...

@@ -17,7 +17,8 @@ from __future__ import annotations
 import queue
 import threading
 
-from fedml_tpu.core.comm.base import BaseCommunicationManager
+from fedml_tpu.core.comm.base import (BaseCommunicationManager,
+                                      MSG_TYPE_PEER_LOST)
 from fedml_tpu.core.message import Message
 
 
@@ -40,6 +41,18 @@ class LocalCommNetwork:
     def manager(self, rank):
         return LocalCommManager(self, rank)
 
+    def announce_lost(self, rank):
+        """Deliver ``MSG_TYPE_PEER_LOST`` for ``rank`` to every other
+        rank's mailbox -- the in-process analog of the TCP transport's
+        EOF-without-GOODBYE synthesis, used by ``LocalCommManager.abort``
+        (crash simulation, ``fedml_tpu.resilience.faults``)."""
+        for other in range(self.world_size):
+            if other == rank:
+                continue
+            lost = Message(MSG_TYPE_PEER_LOST, rank, other)
+            self.mailboxes[other].put(
+                lost.to_bytes() if self.serialize else lost)
+
 
 _STOP = object()
 
@@ -50,6 +63,7 @@ class LocalCommManager(BaseCommunicationManager):
         self.rank = rank
         self.bytes_sent = 0  # wire-codec bytes (serialize=True networks)
         self.bytes_received = 0
+        self.resends = 0  # frames re-sent by the retry layer
         self._observers = []
         self._running = False
 
@@ -59,8 +73,10 @@ class LocalCommManager(BaseCommunicationManager):
     def remove_observer(self, observer):
         self._observers.remove(observer)
 
-    def send_message(self, msg: Message):
+    def send_message(self, msg: Message, is_resend=False):
         receiver = msg.get_receiver_id()
+        if is_resend:
+            self.resends += 1
         if self.network.serialize:
             payload = msg.to_bytes()
             self.bytes_sent += len(payload)
@@ -85,6 +101,14 @@ class LocalCommManager(BaseCommunicationManager):
     def stop_receive_message(self):
         self._running = False
         self.network.mailboxes[self.rank].put(_STOP)
+
+    def abort(self):
+        """Crash simulation: stop our own loop WITHOUT a clean shutdown
+        handshake and tell every peer we are gone (the in-process analog
+        of a TCP EOF-without-GOODBYE)."""
+        self._running = False
+        self.network.mailboxes[self.rank].put(_STOP)
+        self.network.announce_lost(self.rank)
 
 
 def run_ranks_in_threads(targets):

@@ -49,6 +49,7 @@ class MqttCommManager(BaseCommunicationManager):
         self._binary = bool(binary)
         self.bytes_sent = 0
         self.bytes_received = 0
+        self.resends = 0  # frames re-sent by the retry layer
         if client_factory is None:
             if not _HAS_PAHO:
                 raise RuntimeError(
@@ -80,7 +81,7 @@ class MqttCommManager(BaseCommunicationManager):
         for obs in self._observers:
             obs.receive_message(m.get_type(), m)
 
-    def send_message(self, msg: Message):
+    def send_message(self, msg: Message, is_resend=False):
         receiver = msg.get_receiver_id()
         if self.client_id == 0:
             topic = self._topic + "0_" + str(receiver)
@@ -88,6 +89,8 @@ class MqttCommManager(BaseCommunicationManager):
             topic = self._topic + str(self.client_id)
         payload = msg.to_bytes() if self._binary else msg.to_json()
         self.bytes_sent += len(payload)
+        if is_resend:
+            self.resends += 1
         self._client.publish(topic, payload=payload)
 
     def add_observer(self, observer):
@@ -102,3 +105,22 @@ class MqttCommManager(BaseCommunicationManager):
     def stop_receive_message(self):
         self._client.loop_stop()
         self._client.disconnect()
+
+    def abort(self):
+        """Crash simulation (``fedml_tpu.resilience``): kill the broker
+        connection WITHOUT a DISCONNECT packet, so the broker's last-will
+        / keepalive-timeout machinery fires -- what peers would see on a
+        real device power-off. ``disconnect()`` would be a clean hang-up
+        (the broker discards the last-will), defeating the simulation;
+        close the raw socket instead when the client exposes it (paho
+        does); permissive test fakes without a socket fall back to a
+        plain stop."""
+        self._client.loop_stop()
+        sock = getattr(self._client, "socket", lambda: None)()
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        else:  # fake client without a transport: best-effort teardown
+            self._client.disconnect()

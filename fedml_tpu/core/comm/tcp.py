@@ -107,7 +107,7 @@ class TcpCommManager(BaseCommunicationManager):
     """
 
     def __init__(self, host, port, rank, world_size, timeout=60.0,
-                 binary=True):
+                 binary=True, metrics_logger=None):
         self.rank = int(rank)
         self.world_size = int(world_size)
         self._binary = bool(binary)
@@ -116,6 +116,14 @@ class TcpCommManager(BaseCommunicationManager):
         #: forward to MetricsLogger.count_wire for bytes_on_wire accounting
         self.bytes_sent = 0
         self.bytes_received = 0
+        #: frames re-sent by the retry layer (resilience.send_with_retry)
+        self.resends = 0
+        # live wire accounting: every outbound payload (sends + relays)
+        # feeds count_wire as it happens. A RESENT frame counts its bytes
+        # again but its raw (logical) payload only once, so the logged
+        # compression_ratio honestly degrades under retries instead of
+        # pretending the retry was free.
+        self._metrics = metrics_logger
         self._observers = []
         self._running = False
         # _lock guards peer membership (and the client's single pipe);
@@ -176,7 +184,15 @@ class TcpCommManager(BaseCommunicationManager):
     def remove_observer(self, observer):
         self._observers.remove(observer)
 
-    def send_message(self, msg: Message):
+    def _count_out(self, nbytes, is_resend=False):
+        self.bytes_sent += nbytes
+        if is_resend:
+            self.resends += 1
+        if self._metrics is not None:
+            self._metrics.count_wire(nbytes,
+                                     raw_bytes=0 if is_resend else nbytes)
+
+    def send_message(self, msg: Message, is_resend=False):
         receiver = int(msg.get_receiver_id())
         if self.rank == 0 and receiver == 0:
             # self-addressed: dispatch locally -- no serialization, and no
@@ -184,7 +200,7 @@ class TcpCommManager(BaseCommunicationManager):
             self._dispatch(msg)
             return
         payload = msg.to_bytes() if self._binary else msg.to_json().encode()
-        self.bytes_sent += len(payload)
+        self._count_out(len(payload), is_resend=is_resend)
         if self.rank == 0:
             with self._lock:
                 dest = self._peers.get(receiver)
@@ -349,7 +365,7 @@ class TcpCommManager(BaseCommunicationManager):
                     try:
                         with slock:
                             _send_frame(dest, frame)
-                        self.bytes_sent += len(frame)
+                        self._count_out(len(frame))
                     except OSError:
                         # DESTINATION died mid-relay; its own serve thread
                         # may race to report it -- _drop_peer dedups. The
@@ -470,6 +486,17 @@ class TcpCommManager(BaseCommunicationManager):
             except OSError:
                 pass
             self.close()
+
+    def abort(self):
+        """Die abruptly -- crash simulation (``fedml_tpu.resilience``).
+
+        No GOODBYE, no STOP wave: sockets are hard-closed, so every peer
+        observes EOF-without-GOODBYE and raises MSG_TYPE_PEER_LOST, exactly
+        as a power-off would look. ``_stopping`` is set first so our own
+        receive loop's EOF does not dispatch PEER_LOST locally."""
+        self._running = False
+        self._stopping = True
+        self.close()
 
     def close(self):
         if self.rank == 0:

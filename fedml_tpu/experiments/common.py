@@ -114,6 +114,11 @@ def add_base_args(parser: argparse.ArgumentParser):
                         "(audit/retraces_per_round, "
                         "audit/transfer_guard_violations, ...) goes to the "
                         "metrics sink at the end of the run")
+    # resilience knobs (fedml_tpu.resilience): over-selection, report
+    # deadline, quorum, simulated stragglers; --resume above is the
+    # recovery half
+    from fedml_tpu.resilience.integration import add_resilience_args
+    add_resilience_args(p)
     # synthetic-dataset size overrides (CI / bench knobs; ignored by
     # file-backed loaders)
     p.add_argument("--n_train", type=int, default=None)
@@ -267,6 +272,9 @@ def run_fedavg_family(api, args, logger):
                     api._data_rng = saved["data_rng"]
                 api.round_idx = saved["round_idx"]
                 logging.info("resumed from round %d", api.round_idx)
+                # surfaces in metrics.jsonl/summary.json next to the
+                # res/* counters (resilience observability contract)
+                logger({"round": api.round_idx, "res/resumes": 1})
 
     def on_round(api_, metrics):
         last = api_.round_idx == args.comm_round

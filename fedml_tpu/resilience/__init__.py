@@ -1,0 +1,43 @@
+"""Fault injection, deadline-based partial aggregation, round recovery.
+
+The reference's distributed paradigm blocks on the slowest MPI rank and
+dies with it; production FL at scale is defined by churn (Bonawitz et al.,
+*Towards Federated Learning at Scale*, MLSys 2019). This subsystem makes
+failure a first-class, *testable* event for the control plane:
+
+- ``faults``      -- deterministic, seeded fault injection over any
+                     transport (drop/delay/duplicate/reorder/stall/kill).
+- ``policy``      -- send retry with exponential backoff; over-selection,
+                     report deadlines, quorum, round abandonment.
+- ``recovery``    -- round-granular crash/resume over utils/checkpoint.
+- ``integration`` -- wiring into FedAvg-family algorithms, the comm
+                     managers, MetricsLogger, and the experiment flags.
+
+See docs/RESILIENCE.md for the failure model and determinism contract.
+"""
+
+from fedml_tpu.resilience.faults import (ACTIONS, FaultPlan, FaultRule,
+                                         FaultyCommManager)
+from fedml_tpu.resilience.integration import (ResilientFedAvgClient,
+                                              ResilientFedAvgServer,
+                                              SimResilience,
+                                              add_resilience_args,
+                                              quadratic_trainer,
+                                              run_tcp_fedavg)
+from fedml_tpu.resilience.policy import (ROUND_ABANDONED, ROUND_COMPLETE,
+                                         ROUND_DEGRADED,
+                                         PeerUnreachableError,
+                                         RetryPolicy, RoundController,
+                                         RoundPolicy, aggregate_reports,
+                                         send_with_retry)
+from fedml_tpu.resilience.recovery import RoundRecovery
+
+__all__ = [
+    "ACTIONS", "FaultRule", "FaultPlan", "FaultyCommManager",
+    "RetryPolicy", "RoundPolicy", "RoundController", "PeerUnreachableError",
+    "send_with_retry", "aggregate_reports",
+    "ROUND_COMPLETE", "ROUND_DEGRADED", "ROUND_ABANDONED",
+    "RoundRecovery",
+    "SimResilience", "ResilientFedAvgClient", "ResilientFedAvgServer",
+    "add_resilience_args", "quadratic_trainer", "run_tcp_fedavg",
+]

@@ -1,0 +1,250 @@
+"""Deterministic fault injection for the control-plane transports.
+
+Production FL is defined by churn (Bonawitz et al., MLSys 2019 §3: devices
+drop out of every round), but a test suite cannot wait for real networks to
+misbehave. This module makes every failure mode a *scheduled, seeded event*:
+a :class:`FaultPlan` compiles a set of :class:`FaultRule`\\ s into per-rank
+action streams, and :class:`FaultyCommManager` wraps any
+``BaseCommunicationManager`` (local, tcp, mqtt) to apply them at send time.
+Two runs with the same plan and the same per-rank send sequences take
+byte-identical fault decisions -- the property ``tests/test_resilience.py``
+pins and the chaos smoke in ``scripts/ci.sh`` relies on.
+
+Faults are injected on the *send* side only: each rank's outbound sequence
+is totally ordered (one sender thread), so per-rank decisions are
+reproducible even though cross-rank interleaving is not. Supported actions:
+
+- ``drop``      -- the message never reaches the wire.
+- ``delay``     -- the send happens ``delay_s`` late (straggler).
+- ``stall``     -- like ``delay``, but the intent is "past the server's
+                   report deadline"; kept distinct so schedules read as the
+                   failure they model.
+- ``duplicate`` -- the frame is sent twice (at-least-once transports).
+- ``reorder``   -- the message is held back and sent after the *next*
+                   outbound message (pending holds flush on stop/kill).
+- ``kill``      -- the rank dies: every later send/receive is swallowed and
+                   the transport is severed abruptly (no GOODBYE), so the
+                   server observes ``MSG_TYPE_PEER_LOST``, exactly like a
+                   powered-off client.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from fedml_tpu.core.comm.base import (BaseCommunicationManager,
+                                      MSG_TYPE_PEER_LOST)
+from fedml_tpu.core.message import Message
+
+ACTIONS = ("drop", "delay", "stall", "duplicate", "reorder", "kill")
+
+
+@dataclass(frozen=True)
+class FaultRule:
+    """One scheduled (or probabilistic) fault.
+
+    Matching is per sending rank over that rank's outbound messages:
+
+      rank:     sending rank the rule applies to (None = every rank).
+      msg_type: only messages of this type count as matches (None = all;
+                transport-internal frames never match).
+      nth:      fire on the nth matching message, 1-based (exact,
+                deterministic). Mutually exclusive with ``p``.
+      p:        fire with probability ``p`` per matching message, drawn
+                from the plan's per-rank seeded stream -- still
+                reproducible given the same seed and send sequence.
+      action:   one of :data:`ACTIONS`.
+      delay_s:  sleep for delay/stall actions.
+    """
+
+    action: str
+    rank: Optional[int] = None
+    msg_type: Optional[str] = None
+    nth: Optional[int] = None
+    p: Optional[float] = None
+    delay_s: float = 0.0
+
+    def __post_init__(self):
+        if self.action not in ACTIONS:
+            raise ValueError(f"unknown fault action {self.action!r}; "
+                             f"expected one of {ACTIONS}")
+        if (self.nth is None) == (self.p is None):
+            raise ValueError(
+                "exactly one of nth= (deterministic) or p= (seeded "
+                f"probabilistic) must be set, got nth={self.nth} p={self.p}")
+        if self.nth is not None and self.nth < 1:
+            raise ValueError("nth is 1-based and must be >= 1")
+
+
+class FaultPlan:
+    """A seed plus a rule set; ``for_rank(r)`` derives that rank's injector
+    state (independent RNG stream + fresh match counters), so every rank's
+    decisions are a pure function of ``(seed, rank, its send sequence)``."""
+
+    def __init__(self, seed: int = 0, rules: tuple = ()):
+        self.seed = int(seed)
+        self.rules = tuple(rules)
+
+    def for_rank(self, rank: int) -> "_RankFaults":
+        rules = tuple(r for r in self.rules
+                      if r.rank is None or r.rank == int(rank))
+        return _RankFaults(self.seed, int(rank), rules)
+
+    def wrap(self, comm: BaseCommunicationManager,
+             rank: int) -> "FaultyCommManager":
+        return FaultyCommManager(comm, self.for_rank(rank))
+
+
+class _RankFaults:
+    """Per-rank decision stream. Not thread-safe by design: one sender."""
+
+    def __init__(self, seed, rank, rules):
+        self.rank = rank
+        self.rules = rules
+        # independent, collision-free per-rank stream (SeedSequence spawn
+        # keys, not ad-hoc seed arithmetic)
+        self._rng = np.random.default_rng(
+            np.random.SeedSequence(seed).spawn(rank + 1)[-1])
+        self._matches = [0] * len(rules)
+        self.decisions = []  # (send_index, action) audit log
+
+    def decide(self, send_index: int, msg_type: str) -> list:
+        """Actions firing for this outbound message (schedule order)."""
+        fired = []
+        for i, rule in enumerate(self.rules):
+            if rule.msg_type is not None and rule.msg_type != msg_type:
+                continue
+            self._matches[i] += 1
+            if rule.nth is not None:
+                hit = self._matches[i] == rule.nth
+            else:
+                hit = bool(self._rng.random() < rule.p)
+            if hit:
+                fired.append(rule)
+                self.decisions.append((send_index, rule.action))
+        return fired
+
+
+class FaultyCommManager(BaseCommunicationManager):
+    """Transparent fault-injecting wrapper around any comm manager.
+
+    Observer registration and the receive loop pass straight through to the
+    inner manager, so FSMs are oblivious; only ``send_message`` consults the
+    schedule. ``kill()`` (also reachable via a ``kill`` rule) severs the
+    inner transport without a clean shutdown and swallows all later
+    traffic in both directions.
+    """
+
+    def __init__(self, inner: BaseCommunicationManager, faults: _RankFaults,
+                 sleep=time.sleep):
+        self.inner = inner
+        self.faults = faults
+        self._sleep = sleep
+        self._send_index = 0
+        self._held = None  # reorder buffer (at most one message)
+        self._dead = False
+        self._lock = threading.Lock()  # kill() may race the sender thread
+
+    # -- fault application -------------------------------------------------
+    def send_message(self, msg: Message, **kw):
+        with self._lock:
+            if self._dead:
+                return
+            idx = self._send_index
+            self._send_index += 1
+            fired = self.faults.decide(idx, msg.get_type())
+        actions = [r.action for r in fired]
+        if "kill" in actions:
+            self.kill()
+            return
+        if "drop" in actions:
+            logging.info("faults: rank %d dropping send #%d (type=%s)",
+                         self.faults.rank, idx, msg.get_type())
+            self._flush_held(**kw)
+            return
+        for r in fired:
+            if r.action in ("delay", "stall"):
+                logging.info("faults: rank %d %sing send #%d by %.3fs",
+                             self.faults.rank, r.action, idx, r.delay_s)
+                self._sleep(r.delay_s)
+        if "reorder" in actions:
+            with self._lock:
+                if self._held is None:
+                    self._held = (msg, kw)
+                    return
+        self.inner.send_message(msg, **kw)
+        if "duplicate" in actions:
+            self.inner.send_message(msg, **kw)
+        self._flush_held(**kw)
+
+    def _flush_held(self, **kw):
+        with self._lock:
+            held, self._held = self._held, None
+        if held is not None and not self._dead:
+            msg, held_kw = held
+            self.inner.send_message(msg, **(held_kw or kw))
+
+    def kill(self):
+        """Die abruptly: no GOODBYE, no STOP -- peers observe a crash."""
+        with self._lock:
+            if self._dead:
+                return
+            self._dead = True
+            self._held = None
+        logging.info("faults: rank %d killed", self.faults.rank)
+        sever = getattr(self.inner, "abort", None)
+        if sever is not None:
+            sever()
+        else:  # transports without an abrupt-death hook: best-effort close
+            close = getattr(self.inner, "close", None)
+            if close is not None:
+                close()
+
+    # -- pass-through ------------------------------------------------------
+    def add_observer(self, observer):
+        # interpose: a dead rank must not deliver inbound messages either
+        self.inner.add_observer(_DeadFilter(self, observer))
+
+    def remove_observer(self, observer):
+        # remove the matching interposer (identity on the wrapped observer)
+        for obs in list(getattr(self.inner, "_observers", [])):
+            if isinstance(obs, _DeadFilter) and obs.wrapped is observer:
+                self.inner.remove_observer(obs)
+                return
+        self.inner.remove_observer(observer)
+
+    def handle_receive_message(self):
+        self.inner.handle_receive_message()
+
+    def stop_receive_message(self):
+        self._flush_held()
+        self.inner.stop_receive_message()
+
+    def __getattr__(self, name):
+        # byte counters, close(), transport extras: delegate untouched
+        return getattr(self.inner, name)
+
+
+class _DeadFilter:
+    """Observer interposer: drops deliveries after the wrapper died (a
+    crashed process cannot handle the messages already in its mailbox).
+    ``MSG_TYPE_PEER_LOST`` still passes -- it is synthesized locally by the
+    transport, not received, and tests assert on it."""
+
+    def __init__(self, manager: FaultyCommManager, wrapped):
+        self.manager = manager
+        self.wrapped = wrapped
+
+    def receive_message(self, msg_type, msg_params):
+        if self.manager._dead and str(msg_type) != MSG_TYPE_PEER_LOST:
+            return
+        self.wrapped.receive_message(msg_type, msg_params)
+
+
+__all__ = ["ACTIONS", "FaultRule", "FaultPlan", "FaultyCommManager"]

@@ -1,0 +1,507 @@
+"""Wiring: resilience policies into the algorithms, transports, and mains.
+
+Three integration surfaces:
+
+1. **Simulation path** (``FedAvgAPI``/``FedOptAPI`` and everything built on
+   them): :class:`SimResilience` implements over-selection + simulated
+   deadline misses for the vmapped/sharded rounds. The engine already
+   weights the aggregate by per-client sample counts over the *packed
+   cohort*, so restricting the cohort to the reporting subset IS the
+   renormalized partial aggregate -- no aggregation math changes, and the
+   empty-cohort fail-fast (``engine.py:325``) stays in force.
+2. **Distributed control plane**: :class:`ResilientFedAvgServer` /
+   :class:`ResilientFedAvgClient` FSMs run deadline-based partial
+   aggregation with retryable sends over any ``BaseCommunicationManager``
+   (local, tcp, mqtt), with optional per-round crash recovery.
+   :func:`run_tcp_fedavg` drives a whole multi-rank scenario in one
+   process -- the chaos smoke in ``scripts/ci.sh`` and
+   ``tests/test_resilience.py`` both use it.
+3. **Flags**: :func:`add_resilience_args` contributes ``--deadline`` /
+   ``--overselect`` / ``--quorum`` / ``--straggler_p`` to the FedAvg-family
+   mains (``--resume`` already exists on the checkpoint side).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+import numpy as np
+
+from fedml_tpu.core.comm.base import MSG_TYPE_PEER_LOST
+from fedml_tpu.core.managers import ClientManager, ServerManager
+from fedml_tpu.core.message import Message
+from fedml_tpu.resilience.policy import (
+    ROUND_DEGRADED, RetryPolicy, RoundController, RoundPolicy,
+    aggregate_reports, send_with_retry)
+
+MSG_S2C_SYNC = "res_sync"        # server -> client: params, round, attempt
+MSG_C2S_REPORT = "res_report"    # client -> server: params, n, round, attempt
+
+
+def add_resilience_args(parser):
+    parser.add_argument(
+        "--deadline", type=float, default=0.0,
+        help="per-round report deadline in seconds for the distributed "
+             "control plane (0 = wait for every report, the reference's "
+             "block-on-slowest behavior). Simulation rounds have no wall "
+             "clock; there --straggler_p models deadline misses")
+    parser.add_argument(
+        "--overselect", type=float, default=0.0,
+        help="over-selection eps (Bonawitz MLSys'19 S3): select "
+             "ceil((1+eps)*C) clients, aggregate the first C reports")
+    parser.add_argument(
+        "--quorum", type=float, default=0.5,
+        help="minimum reporting fraction of the aggregation target for a "
+             "deadline-bounded round to complete (degraded); below it the "
+             "round is abandoned and re-run with a fresh cohort")
+    parser.add_argument(
+        "--straggler_p", type=float, default=0.0,
+        help="simulation only: per-(round, client) probability of missing "
+             "the report deadline, drawn from a seeded stream keyed on "
+             "(seed, round, attempt, client) -- reproducible chaos for the "
+             "vmapped rounds")
+    return parser
+
+
+class SimResilience:
+    """Over-selection + seeded deadline-miss simulation for the sim rounds.
+
+    ``sample(round_idx, total, per_round)`` replaces the bare
+    ``client_sampling`` call: it over-selects, removes simulated deadline
+    misses, keeps the first C survivors ("first C reports win"), and
+    re-runs below-quorum rounds with a fresh cohort (attempt folded into
+    the sampling seed). Cumulative counters ride every round's metrics
+    record so degraded rounds are visible in summary.json.
+    """
+
+    def __init__(self, policy: RoundPolicy, straggler_p: float = 0.0,
+                 seed: int = 0, miss_fn=None):
+        self.policy = policy
+        self.straggler_p = float(straggler_p)
+        self.seed = int(seed)
+        self._miss_fn = miss_fn
+        self.rounds_degraded = 0
+        self.rounds_abandoned = 0
+        self.clients_dropped = 0
+
+    @classmethod
+    def from_args(cls, args) -> Optional["SimResilience"]:
+        over = float(getattr(args, "overselect", 0.0) or 0.0)
+        sp = float(getattr(args, "straggler_p", 0.0) or 0.0)
+        if over <= 0 and sp <= 0:
+            return None
+        policy = RoundPolicy(overselect=over,
+                             quorum=float(getattr(args, "quorum", 0.5)))
+        return cls(policy, straggler_p=sp,
+                   seed=int(getattr(args, "seed", 0)))
+
+    def misses_deadline(self, round_idx, attempt, client_id) -> bool:
+        if self._miss_fn is not None:
+            return bool(self._miss_fn(round_idx, attempt, client_id))
+        if self.straggler_p <= 0:
+            return False
+        # keyed (not sequential) stream: order-independent, reproducible
+        rng = np.random.default_rng(
+            (self.seed, int(round_idx), int(attempt), int(client_id)))
+        return bool(rng.random() < self.straggler_p)
+
+    def sample(self, round_idx, client_num_in_total, client_num_per_round):
+        """Returns ``(reporting_client_ids, round_record_dict)``."""
+        from fedml_tpu.algorithms.fedavg import client_sampling
+
+        target = min(client_num_per_round, client_num_in_total)
+        for attempt in range(self.policy.max_round_retries + 1):
+            selected = client_sampling(
+                round_idx, client_num_in_total,
+                self.policy.select_count(target, client_num_in_total),
+                attempt=attempt)
+            # seeded permutation before the "first C win" trim: when
+            # select_count reaches the total, client_sampling's
+            # all-clients early-return is an ORDERED range, and trimming
+            # that untouched would hand the lowest ids every round (a
+            # silently biased cohort). The permutation models report
+            # arrival order; the final subset is sorted so the packed
+            # cohort (and thus the aggregate) has one canonical order.
+            perm = np.random.default_rng(
+                (self.seed, int(round_idx), int(attempt))).permutation(
+                    len(selected))
+            selected = [selected[i] for i in perm]
+            reporting = [c for c in selected
+                         if not self.misses_deadline(round_idx, attempt, c)]
+            dropped = len(selected) - len(reporting)
+            if len(reporting) >= self.policy.quorum_count(target):
+                reporting = sorted(reporting[:target])
+                self.clients_dropped += dropped
+                degraded = len(reporting) < target
+                self.rounds_degraded += int(degraded)
+                return reporting, {
+                    "res/selected": len(selected),
+                    "res/reporting": len(reporting),
+                    "res/degraded": int(degraded),
+                    "res/attempts": attempt + 1,
+                    "res/rounds_degraded": self.rounds_degraded,
+                    "res/rounds_abandoned": self.rounds_abandoned,
+                    "res/clients_dropped": self.clients_dropped,
+                }
+            # below quorum: abandon, re-run with a fresh cohort
+            self.rounds_abandoned += 1
+            self.clients_dropped += dropped
+            logging.warning(
+                "round %d attempt %d: %d/%d reports is below quorum %d -- "
+                "abandoning and re-sampling", round_idx, attempt,
+                len(reporting), len(selected),
+                self.policy.quorum_count(target))
+        raise RuntimeError(
+            f"round {round_idx}: abandoned "
+            f"{self.policy.max_round_retries + 1} consecutive attempts "
+            "(straggler rate incompatible with the quorum; lower --quorum "
+            "or --straggler_p)")
+
+
+class ResilientFedAvgClient(ClientManager):
+    """Client FSM: on sync, run local training and report.
+
+    ``local_train_fn(params, round_idx, rank) -> (params, num_samples)``
+    over numpy pytrees. A lost server ends the loop cleanly (there is
+    nobody left to report to; the default fail-fast would raise out of a
+    worker thread instead).
+    """
+
+    def __init__(self, args, comm, rank, size, local_train_fn,
+                 retry_policy: Optional[RetryPolicy] = None):
+        super().__init__(args, comm, rank=rank, size=size)
+        self.local_train_fn = local_train_fn
+        self.retry_policy = retry_policy
+        self.counters = {"retries": 0}
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MSG_S2C_SYNC, self._on_sync)
+        self.register_message_receive_handler(MSG_TYPE_PEER_LOST,
+                                              self._on_server_lost)
+
+    def _on_sync(self, msg):
+        params, n = self.local_train_fn(msg.get("params"),
+                                        int(msg.get("round")), self.rank)
+        out = Message(MSG_C2S_REPORT, self.rank, 0)
+        out.add("params", params)
+        out.add("num_samples", float(n))
+        out.add("round", int(msg.get("round")))
+        out.add("attempt", int(msg.get("attempt")))
+        try:
+            if self.retry_policy is not None:
+                send_with_retry(self.com_manager, out, self.retry_policy,
+                                counters=self.counters)
+            else:
+                self.send_message(out)
+        except (ConnectionError, OSError):
+            # server gone mid-report; the peer-lost path ends the loop
+            logging.warning("rank %d: report send failed (server lost?)",
+                            self.rank)
+
+    def _on_server_lost(self, msg):
+        # sender is the LOST rank: only rank 0 dying concerns a client.
+        # On the local transport a killed sibling's PEER_LOST fans out to
+        # every mailbox -- that must not collapse the healthy federation.
+        if int(msg.get_sender_id()) != 0:
+            logging.info("rank %d: sibling rank %s lost (ignored)",
+                         self.rank, msg.get_sender_id())
+            return
+        logging.warning("rank %d: server lost -- stopping", self.rank)
+        self.finish()
+
+
+class ResilientFedAvgServer(ServerManager):
+    """Rank-0 FSM: over-selection, report deadline, partial aggregation,
+    abandoned-round re-runs, and per-round crash recovery.
+
+    Args:
+      init_params: initial global weights (numpy pytree).
+      rounds: total federated rounds.
+      round_policy / retry_policy: see ``resilience.policy``.
+      client_ns: optional ``{rank: num_samples}`` override for weighting
+        (otherwise reports carry their own ``num_samples``).
+      cohort_target: aggregation target C (default: all clients).
+      cohort_override: ``fn(round_idx, attempt) -> [ranks]`` forcing the
+        cohort (the A/B harness replays a faulted run's reporting subsets).
+      recovery: ``RoundRecovery`` for per-round snapshots + resume.
+      metrics_logger: per-round records (``res/*`` counters; wire bytes
+        attach via the transport's ``count_wire`` feed when wired).
+    """
+
+    def __init__(self, args, comm, size, init_params, rounds,
+                 round_policy: RoundPolicy,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 cohort_target: Optional[int] = None, cohort_override=None,
+                 recovery=None, metrics_logger=None):
+        super().__init__(args, comm, rank=0, size=size)
+        self.params = {k: np.asarray(v) for k, v in init_params.items()}
+        self.rounds = int(rounds)
+        self.round_policy = round_policy
+        self.retry_policy = retry_policy or RetryPolicy()
+        self.cohort_target = cohort_target
+        self.cohort_override = cohort_override
+        self.recovery = recovery
+        self.metrics_logger = metrics_logger
+        self.alive = set(range(1, size))
+        self.round_idx = 0
+        self.attempt = 0
+        self.failed = None  # set to a reason string on unrecoverable stop
+        self.history = []          # per-round aggregated params
+        self.reporting_log = []    # per-round sorted reporting ranks
+        self.counters = {"rounds_degraded": 0, "rounds_abandoned": 0,
+                         "clients_dropped": 0, "retries": 0, "resumes": 0}
+        self._controller = RoundController(
+            round_policy, self._on_round_complete, self._on_round_abandoned)
+        # serializes round turnover and guards `alive`. Sync sends happen
+        # OUTSIDE this lock (_open_round returns them, _send_syncs
+        # delivers) so a blocking write to a wedged peer can never pin
+        # the deadline/abandon machinery. RLock as defense in depth: a
+        # failed unlocked send dispatches PEER_LOST synchronously on the
+        # sending thread, and that chain may re-enter a turnover callback
+        # (depth bounded by max_round_retries -- the abandon path is the
+        # only recursive one, since zero reports can never meet quorum).
+        self._advance_lock = threading.RLock()
+
+    # -- FSM surface -------------------------------------------------------
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MSG_C2S_REPORT,
+                                              self._on_report)
+        self.register_message_receive_handler(MSG_TYPE_PEER_LOST,
+                                              self._on_peer_lost)
+
+    def start(self):
+        """Kick off round 0 (or the checkpointed round on resume)."""
+        if self.recovery is not None:
+            saved = self.recovery.restore_latest()
+            if saved is not None:
+                self.params = {k: np.asarray(v)
+                               for k, v in saved["global_state"].items()}
+                self.round_idx = int(saved["round_idx"])
+                self.counters["resumes"] += 1
+        if self.round_idx >= self.rounds:
+            self.finish()
+            return
+        with self._advance_lock:
+            syncs = self._open_round()
+        self._send_syncs(syncs)
+
+    def _open_round(self):
+        """Open the next round attempt: sample the cohort and arm the
+        controller. Runs UNDER ``_advance_lock``; returns the sync
+        messages for :meth:`_send_syncs` to deliver OUTSIDE the lock --
+        a blocking ``sendall`` to a wedged-but-alive client (full send
+        buffer, keepalives still ACKed) must never pin the lock the
+        deadline/abandon machinery needs."""
+        alive = sorted(self.alive)
+        if not alive:
+            self._fail("every client is lost")
+            return []
+        target = min(self.cohort_target or len(alive), len(alive))
+        if self.cohort_override is not None:
+            cohort = list(self.cohort_override(self.round_idx, self.attempt))
+            target = min(target, len(cohort))
+        else:
+            cohort = _sample_ranks(self.round_idx, self.attempt, alive,
+                                   self.round_policy.select_count(
+                                       target, len(alive)))
+        self._controller.begin(self.round_idx, self.attempt, cohort, target)
+        syncs = []
+        for r in cohort:
+            m = Message(MSG_S2C_SYNC, 0, r)
+            m.add("params", self.params)
+            m.add("round", self.round_idx)
+            m.add("attempt", self.attempt)
+            syncs.append((r, m))
+        return syncs
+
+    def _send_syncs(self, syncs):
+        """Deliver the opened round's syncs (no locks held). A send that
+        outlives its round attempt (deadline fired mid-delivery and a new
+        attempt opened) is harmless: the message carries its (round,
+        attempt) tag and stale reports land in the late counter."""
+        for _r, m in syncs:
+            try:
+                send_with_retry(self.com_manager, m, self.retry_policy,
+                                counters=self.counters)
+            except (ConnectionError, OSError):
+                pass  # peer-lost dispatch already told the controller
+
+    def _on_report(self, msg):
+        self._controller.report(
+            msg.get("round"), msg.get("attempt"), msg.get_sender_id(),
+            msg.get("num_samples"),
+            {k: np.asarray(v) for k, v in msg.get("params").items()})
+
+    def _on_peer_lost(self, msg):
+        rank = int(msg.get_sender_id())
+        # alive mutates under _advance_lock: _open_round reads it
+        # (sorted) on the turnover thread, and mutating a set
+        # mid-iteration raises. controller.peer_lost runs OUTSIDE the
+        # lock: it can fire a turnover callback, and those must never
+        # inherit a held _advance_lock (their _send_syncs runs unlocked
+        # by design -- see _open_round).
+        with self._advance_lock:
+            if rank in self.alive:
+                self.alive.discard(rank)
+                self.counters["clients_dropped"] += 1
+                logging.warning("server: client rank %d lost "
+                                "(%d alive)", rank, len(self.alive))
+        self._controller.peer_lost(rank)
+
+    # -- round turnover (serve/timer threads) ------------------------------
+    def _on_round_complete(self, reports, outcome):
+        with self._advance_lock:
+            self.params, _total = aggregate_reports(reports)
+            self.history.append(dict(self.params))
+            self.reporting_log.append(sorted(reports))
+            degraded = outcome == ROUND_DEGRADED
+            self.counters["rounds_degraded"] += int(degraded)
+            self._log_round(len(reports), degraded)
+            if self.recovery is not None:
+                done = self.round_idx + 1 >= self.rounds
+                self.recovery.maybe_save(self.round_idx + 1, self.params,
+                                         last=done)
+            self.round_idx += 1
+            self.attempt = 0
+            if self.round_idx >= self.rounds:
+                self.finish()
+                return
+            syncs = self._open_round()
+        self._send_syncs(syncs)
+
+    def _on_round_abandoned(self, reports):
+        with self._advance_lock:
+            self.counters["rounds_abandoned"] += 1
+            logging.warning("round %d attempt %d abandoned with %d reports",
+                            self.round_idx, self.attempt, len(reports))
+            self.attempt += 1
+            if self.attempt > self.round_policy.max_round_retries:
+                self._fail(f"round {self.round_idx} abandoned "
+                           f"{self.attempt} times")
+                return
+            syncs = self._open_round()
+        self._send_syncs(syncs)
+
+    def _log_round(self, n_reports, degraded):
+        if self.metrics_logger is None:
+            return
+        rec = {"round": self.round_idx, "res/reports": n_reports,
+               "res/degraded": int(degraded)}
+        rec.update({f"res/{k}": v for k, v in self.counters.items()})
+        rec.update({f"res/{k}": v
+                    for k, v in self._controller.counters.items()})
+        self.metrics_logger(rec)
+
+    def _fail(self, reason):
+        self.failed = reason
+        logging.error("resilient server giving up: %s", reason)
+        self._controller.cancel()
+        self.finish()
+
+    def finish(self):
+        self._controller.cancel()
+        super().finish()
+
+
+def _sample_ranks(round_idx, attempt, ranks, k):
+    """Seeded-by-(round, attempt) cohort over explicit rank ids -- the
+    distributed analog of ``algorithms.fedavg.client_sampling``, sharing
+    its :func:`~fedml_tpu.algorithms.fedavg.attempt_seed` fold so both
+    paths draw agreeing cohorts for the same (round, attempt)."""
+    from fedml_tpu.algorithms.fedavg import attempt_seed
+
+    ranks = sorted(int(r) for r in ranks)
+    if k >= len(ranks):
+        return list(ranks)
+    np.random.seed(attempt_seed(round_idx, attempt))
+    return sorted(int(r) for r in
+                  np.random.choice(ranks, k, replace=False))
+
+
+def quadratic_trainer(lr=0.25):
+    """Deterministic 'local training' oracle for control-plane scenarios:
+    one gradient-descent step on ``0.5 * ||w - t_rank||^2`` where the
+    target is a fixed function of the rank. Real GD arithmetic, bitwise
+    reproducible, rank-distinguishable -- the chaos smoke's A/B oracle."""
+
+    def train(params, round_idx, rank):
+        out = {}
+        for k in sorted(params):
+            w = np.asarray(params[k], np.float32)
+            target = np.full_like(w, np.float32(rank))
+            out[k] = w + np.float32(lr) * (target - w)
+        return out, float(10 * rank)
+
+    return train
+
+
+def run_tcp_fedavg(world_size, rounds, round_policy, init_params,
+                   fault_plan=None, retry_policy=None, cohort_target=None,
+                   cohort_override=None, trainer=None, recovery=None,
+                   metrics_logger=None, host="localhost", port=None,
+                   timeout=60.0, join_timeout=90.0):
+    """Drive a full multi-rank TCP FedAvg scenario in one process.
+
+    Clients run in daemon threads (rank r wrapped by ``fault_plan`` when
+    given); the server FSM runs its receive loop on the caller thread.
+    Returns the server (``.history``, ``.reporting_log``, ``.counters``,
+    ``.failed``). Used by the ci.sh chaos smoke and test_resilience.py.
+    """
+    import socket
+
+    from fedml_tpu.core.comm.tcp import TcpCommManager
+
+    if port is None:
+        s = socket.socket()
+        s.bind((host, 0))
+        port = s.getsockname()[1]
+        s.close()
+    trainer = trainer or quadratic_trainer()
+
+    def run_client(rank):
+        comm = TcpCommManager(host, port, rank, world_size, timeout=timeout)
+        if fault_plan is not None:
+            comm = fault_plan.wrap(comm, rank)
+        fsm = ResilientFedAvgClient(None, comm, rank, world_size, trainer)
+        fsm.run()
+
+    threads = [threading.Thread(target=run_client, args=(r,), daemon=True,
+                                name=f"res-client-{r}")
+               for r in range(1, world_size)]
+    for t in threads:
+        t.start()
+    comm = TcpCommManager(host, port, 0, world_size, timeout=timeout,
+                          metrics_logger=metrics_logger)
+    server = ResilientFedAvgServer(
+        None, comm, world_size, init_params, rounds, round_policy,
+        retry_policy=retry_policy, cohort_target=cohort_target,
+        cohort_override=cohort_override, recovery=recovery,
+        metrics_logger=metrics_logger)
+    server.register_message_receive_handlers()
+    server.start()
+    if server.round_idx < server.rounds and server.failed is None:
+        loop = threading.Thread(target=server.com_manager
+                                .handle_receive_message, daemon=True,
+                                name="res-server-loop")
+        loop.start()
+        loop.join(timeout=join_timeout)
+        if loop.is_alive():
+            server.com_manager.stop_receive_message()
+            loop.join(timeout=10.0)
+            raise TimeoutError(
+                f"resilient server hung past {join_timeout}s "
+                f"(round {server.round_idx}, failed={server.failed})")
+    else:
+        # resume found nothing to do (or start() already failed):
+        # release the connected clients
+        server.com_manager.stop_receive_message()
+    for t in threads:
+        t.join(timeout=10.0)
+    return server
+
+
+__all__ = ["MSG_S2C_SYNC", "MSG_C2S_REPORT", "add_resilience_args",
+           "SimResilience", "ResilientFedAvgClient", "ResilientFedAvgServer",
+           "quadratic_trainer", "run_tcp_fedavg"]

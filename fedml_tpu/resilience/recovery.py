@@ -1,0 +1,87 @@
+"""Round-granular server recovery: kill -9 at round k, resume at round k.
+
+``utils/checkpoint.py`` already round-trips the full round-loop state
+(model pytree, server aux state, both RNG streams, round index) through
+orbax; this module is the thin resilience-facing layer over it:
+
+- :class:`RoundRecovery` snapshots *every* completed round (or every
+  ``save_every``) and restores the latest on construction of a restarted
+  server, counting ``resumes`` for the metrics record.
+- The determinism contract (docs/RESILIENCE.md): with no faults firing,
+  a server killed after round k and restarted with ``--resume`` produces a
+  bitwise-identical round-(k+1..n) trajectory, because every input to
+  round k+1 -- params, server aux, the jax PRNG key, the host data-RNG
+  bit-generator state, and the round counter -- is restored exactly, and
+  cohort selection is a pure function of the round index
+  (``client_sampling`` reseeds per round; ``attempt`` folds in for
+  abandoned-round re-runs).
+
+The distributed server FSM (``integration.ResilientFedAvgServer``) stores
+numpy weight pytrees; the simulation path (``FedAvgAPI`` via
+``experiments/common.run_fedavg_family``) stores jax pytrees -- orbax
+handles both, and restore hands back numpy that callers re-place.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from fedml_tpu.utils.checkpoint import Checkpointer
+
+
+class RoundRecovery:
+    """Per-round snapshot/restore for a federated server.
+
+    Args:
+      directory: checkpoint root (orbax layout, shared with the
+        ``--checkpoint_dir`` flag).
+      save_every: snapshot cadence in rounds (1 = every round, the
+        resilience default -- a control-plane server's state is a few MB
+        of weights, and losing rounds to a crash costs more than the
+        write).
+      max_to_keep: orbax GC horizon.
+    """
+
+    def __init__(self, directory: str, save_every: int = 1, max_to_keep: int = 3):
+        # synchronous saves: round turnover happens on whichever transport
+        # serve thread delivered the last report, and orbax's async
+        # finalize thread cannot be handed between threads
+        self._ckpt = Checkpointer(directory, max_to_keep=max_to_keep,
+                                  async_save=False)
+        self.save_every = max(1, int(save_every))
+        self.resumes = 0
+        self.saves = 0
+
+    def maybe_save(self, round_idx: int, global_state, server_state=(),
+                   rng=None, data_rng=None, last: bool = False) -> bool:
+        """Snapshot round ``round_idx`` when on cadence (or ``last``)."""
+        if round_idx % self.save_every and not last:
+            return False
+        self._ckpt.save(round_idx, global_state, server_state=server_state,
+                        rng=rng, data_rng=data_rng)
+        self.saves += 1
+        return True
+
+    def restore_latest(self, server_state_template=None) -> Optional[dict]:
+        """Latest snapshot as ``{"global_state","server_state","rng",
+        "data_rng","round_idx"}``, or None on a fresh directory. Counts a
+        resume only when something was actually restored."""
+        kw = ({} if server_state_template is None
+              else {"server_state_template": server_state_template})
+        saved = self._ckpt.restore(**kw)
+        if saved is None:
+            return None
+        self.resumes += 1
+        logging.info("resilience: resuming from round %d snapshot",
+                     saved["round_idx"])
+        return saved
+
+    def latest_round(self) -> Optional[int]:
+        return self._ckpt.latest_round()
+
+    def close(self):
+        self._ckpt.close()
+
+
+__all__ = ["RoundRecovery"]

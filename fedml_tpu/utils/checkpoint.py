@@ -26,13 +26,20 @@ _NO_TEMPLATE = object()  # sentinel: "caller supplied no template"
 class Checkpointer:
     """Orbax-backed checkpoint manager with Saver-parity extras."""
 
-    def __init__(self, directory, max_to_keep=3, best_mode: Optional[str] = None):
+    def __init__(self, directory, max_to_keep=3, best_mode: Optional[str] = None,
+                 async_save=True):
         """Args:
           directory: checkpoint root (created if absent).
           max_to_keep: retained steps (orbax GC).
           best_mode: None keeps the most recent ``max_to_keep``; "max"/"min"
             keeps the best by the ``metric`` passed to ``save`` (Saver's
             best-mIoU behavior, ``fedseg/utils.py:189-204``).
+          async_save: False forces synchronous orbax saves. Required when
+            ``save`` can be called from *changing* threads (the resilient
+            server snapshots from whichever transport serve thread
+            completed the round): orbax's async finalize thread is only
+            reset by the thread that started it, so cross-thread async
+            saves trip ``assert self._finalize_thread is None``.
         """
         import orbax.checkpoint as ocp
         self._ocp = ocp
@@ -43,6 +50,7 @@ class Checkpointer:
             max_to_keep=max_to_keep,
             best_fn=(lambda m: m["metric"]) if best_mode else None,
             best_mode=best_mode or "max",
+            enable_async_checkpointing=bool(async_save),
         )
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
 
@@ -57,6 +65,11 @@ class Checkpointer:
         backend (native C++ vs numpy -- different shuffle PRNG families)
         rides too, so restore can detect a backend switch."""
         from fedml_tpu.parallel.packing import packing_backend
+        # orbax saves finalize on a background thread and assert that no
+        # finalize is still in flight when the next save starts; rounds
+        # can turn over faster than a finalize (resilience.RoundRecovery
+        # snapshots every round), so drain first
+        self._mgr.wait_until_finished()
         payload = {
             "global_state": global_state,
             "server_state": _pack_aux(server_state),
@@ -92,7 +105,12 @@ class Checkpointer:
         step = round_idx if round_idx is not None else self._mgr.latest_step()
         if step is None:
             return None
-        payload = self._mgr.restore(step)
+        # explicit StandardRestore: a freshly-constructed manager (a
+        # restarted process resuming -- the whole point of resume) has no
+        # handler registry entry for the saved item and raises KeyError
+        # when left to infer it
+        payload = self._mgr.restore(
+            step, args=self._ocp.args.StandardRestore())
         has_rng = bool(np.asarray(payload.get("has_rng", True)))
         rng_state = _decode_json(payload.get("data_rng_state"))
         data_rng = None

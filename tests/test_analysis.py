@@ -276,13 +276,70 @@ class TestShardingScanRules:
             "                         in_specs=(P(), P('clients')),\n"
             "                         out_specs=P())\n")
         assert codes(src) == []
-        # specs bound to names are out of static reach: judge nothing
+        # specs bound to caller-supplied PARAMETERS are out of static
+        # reach: judge nothing
         src = (
             "import jax\n"
             "from jax.sharding import PartitionSpec as P\n"
             "def build(f, mesh, spec):\n"
             "    return jax.shard_map(f, mesh=mesh, in_specs=(spec, P()),\n"
             "                         out_specs=P())\n")
+        assert codes(src) == []
+
+    def test_fl109_name_bound_spec_resolved_one_hop(self):
+        # `spec = P()` in the enclosing scope resolves through one
+        # assignment hop and still fires
+        src = (
+            "import jax\n"
+            "from jax.sharding import PartitionSpec as P\n"
+            "def build(f, mesh):\n"
+            "    spec = P()\n"
+            "    return jax.shard_map(f, mesh=mesh, in_specs=(spec, spec),\n"
+            "                         out_specs=spec)\n")
+        assert codes(src) == ["FL109"]
+        # module-level binding resolves too
+        src = (
+            "import jax\n"
+            "from jax.sharding import PartitionSpec as P\n"
+            "SPEC = P()\n"
+            "def build(f, mesh):\n"
+            "    return jax.shard_map(f, mesh=mesh, in_specs=(SPEC,),\n"
+            "                         out_specs=SPEC)\n")
+        assert codes(src) == ["FL109"]
+
+    def test_fl109_name_bound_partitioned_spec_negative(self):
+        # the ring_attention idiom: a name-bound spec that DOES partition
+        src = (
+            "import jax\n"
+            "from jax.sharding import PartitionSpec as P\n"
+            "def build(f, mesh, axis):\n"
+            "    spec = P('seq', axis, None, None)\n"
+            "    return jax.shard_map(f, mesh=mesh,\n"
+            "                         in_specs=(spec, spec, spec),\n"
+            "                         out_specs=spec)\n")
+        assert codes(src) == []
+
+    def test_fl109_name_resolution_stays_one_hop_and_single_binding(self):
+        # name-of-a-name (two hops): out of reach, judge nothing
+        src = (
+            "import jax\n"
+            "from jax.sharding import PartitionSpec as P\n"
+            "def build(f, mesh):\n"
+            "    a = P()\n"
+            "    spec = a\n"
+            "    return jax.shard_map(f, mesh=mesh, in_specs=(spec,),\n"
+            "                         out_specs=a)\n")
+        assert codes(src) == []
+        # rebound name: ambiguous, judge nothing
+        src = (
+            "import jax\n"
+            "from jax.sharding import PartitionSpec as P\n"
+            "def build(f, mesh, flag):\n"
+            "    spec = P()\n"
+            "    if flag:\n"
+            "        spec = P('clients')\n"
+            "    return jax.shard_map(f, mesh=mesh, in_specs=(spec,),\n"
+            "                         out_specs=spec)\n")
         assert codes(src) == []
 
     # FL111 ---------------------------------------------------------------

@@ -60,10 +60,20 @@ def test_two_process_round_matches_single_process():
         env=env, cwd=os.path.dirname(os.path.dirname(worker)))
         for i in range(2)]
     outs = []
+    rcs = []
     for p in procs:
         out, _ = p.communicate(timeout=420)
         outs.append(out)
-        assert p.returncode == 0, out[-2000:]
+        rcs.append(p.returncode)
+    if any(rcs) and any("Multiprocess computations aren't implemented"
+                        in out for out in outs):
+        # some jaxlib builds ship a CPU backend without cross-process
+        # collectives (distributed init succeeds, the first collective
+        # raises): an environment limitation, not a regression
+        pytest.skip("this jaxlib's CPU backend does not implement "
+                    "multi-process computations")
+    for rc, out in zip(rcs, outs):
+        assert rc == 0, out[-2000:]
     results = {}
     for out in outs:
         line = [ln for ln in out.splitlines() if ln.startswith("RESULT")]

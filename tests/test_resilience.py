@@ -1,0 +1,487 @@
+"""Resilience subsystem (fedml_tpu/resilience): seeded fault injection is
+reproducible; deadline-based partial aggregation renormalizes over the
+reporting subset (never NaN/zero-biased); below-quorum rounds abandon and
+re-run; retry/backoff gives up after the cap and raises MSG_TYPE_PEER_LOST;
+a killed-and-restarted server resumes bitwise (docs/RESILIENCE.md)."""
+
+import time
+import types
+
+import numpy as np
+import pytest
+
+from fedml_tpu.core.comm.base import MSG_TYPE_PEER_LOST
+from fedml_tpu.core.comm.local import LocalCommNetwork
+from fedml_tpu.core.message import Message
+from fedml_tpu.resilience import (
+    ROUND_ABANDONED, ROUND_COMPLETE, ROUND_DEGRADED, FaultPlan, FaultRule,
+    PeerUnreachableError, RetryPolicy, RoundController, RoundPolicy,
+    RoundRecovery, SimResilience, aggregate_reports, quadratic_trainer,
+    run_tcp_fedavg, send_with_retry)
+
+
+# ---------------------------------------------------------------------------
+# faults.py: determinism + actions
+# ---------------------------------------------------------------------------
+class TestFaultPlan:
+    def test_rule_validation(self):
+        with pytest.raises(ValueError):
+            FaultRule("explode", nth=1)
+        with pytest.raises(ValueError):
+            FaultRule("drop")  # neither nth nor p
+        with pytest.raises(ValueError):
+            FaultRule("drop", nth=1, p=0.5)  # both
+        with pytest.raises(ValueError):
+            FaultRule("drop", nth=0)  # 1-based
+
+    def test_seeded_decisions_reproducible(self):
+        rules = (FaultRule("drop", p=0.5),
+                 FaultRule("delay", nth=3, delay_s=0.0))
+
+        def decisions(seed):
+            rf = FaultPlan(seed=seed, rules=rules).for_rank(1)
+            for i in range(40):
+                rf.decide(i, "m")
+            return rf.decisions
+
+        assert decisions(7) == decisions(7)
+        assert decisions(7) != decisions(8)
+        # deterministic nth fires exactly once at the 3rd match
+        assert [d for d in decisions(7) if d[1] == "delay"] == [(2, "delay")]
+
+    def test_per_rank_streams_independent(self):
+        plan = FaultPlan(seed=3, rules=(FaultRule("drop", p=0.5),))
+        a = plan.for_rank(1)
+        b = plan.for_rank(2)
+        da = [bool(a.decide(i, "m")) for i in range(64)]
+        db = [bool(b.decide(i, "m")) for i in range(64)]
+        assert da != db  # spawned streams, not a shared/duplicated one
+
+    def test_msg_type_filter_counts_only_matches(self):
+        plan = FaultPlan(rules=(FaultRule("drop", msg_type="b", nth=2),))
+        rf = plan.for_rank(0)
+        assert rf.decide(0, "a") == []   # non-matching: no count
+        assert rf.decide(1, "b") == []   # 1st match
+        assert len(rf.decide(2, "b")) == 1  # 2nd match fires
+
+
+class _Collect:
+    def __init__(self):
+        self.got = []
+
+    def receive_message(self, t, m):
+        self.got.append((str(t), m))
+
+
+class TestFaultyLocalTransport:
+    def _pair(self, rules, seed=0):
+        net = LocalCommNetwork(2)
+        plan = FaultPlan(seed=seed, rules=rules)
+        sender = plan.wrap(net.manager(1), 1)
+        receiver = net.manager(0)
+        sink = _Collect()
+        receiver.add_observer(sink)
+        return net, sender, receiver, sink
+
+    def test_drop_duplicate_reorder(self):
+        net, sender, receiver, sink = self._pair((
+            FaultRule("drop", msg_type="m", nth=2),
+            FaultRule("duplicate", msg_type="m", nth=3),
+            FaultRule("reorder", msg_type="m", nth=4),
+        ))
+        for i in range(5):
+            m = Message("m", 1, 0)
+            m.add("i", i)
+            sender.send_message(m)
+        sender.stop_receive_message()  # flushes any held reorder buffer
+        order = [m.get("i") for t, m in self._iter_msgs(receiver)
+                 if t == "m"]
+        # sent 0..4: #2 dropped (nth=2 is i=1), #3 duplicated (i=2),
+        # #4 (i=3) held and released after #5 (i=4)
+        assert order == [0, 2, 2, 4, 3]
+
+    def _iter_msgs(self, receiver):
+        box = receiver.network.mailboxes[receiver.rank]
+        out = []
+        while not box.empty():
+            msg = box.get()
+            if isinstance(msg, Message):
+                out.append((msg.get_type(), msg))
+        return out
+
+    def test_kill_announces_peer_lost_and_silences(self):
+        net = LocalCommNetwork(2)
+        plan = FaultPlan(rules=(FaultRule("kill", msg_type="m", nth=2),))
+        sender = plan.wrap(net.manager(1), 1)
+        for i in range(4):  # send #2 triggers the kill; later sends vanish
+            sender.send_message(Message("m", 1, 0))
+        box = net.mailboxes[0]
+        types_seen = []
+        while not box.empty():
+            m = box.get()
+            if isinstance(m, Message):
+                types_seen.append(m.get_type())
+        assert types_seen == ["m", MSG_TYPE_PEER_LOST]
+
+
+# ---------------------------------------------------------------------------
+# policy.py: retry/backoff, controller, renormalized aggregation
+# ---------------------------------------------------------------------------
+class _FlakyComm:
+    """send_message fails the first ``fails`` times, then succeeds."""
+
+    def __init__(self, fails):
+        self.fails = fails
+        self.calls = []
+        self._observers = []
+        self.rank = 0
+
+    def add_observer(self, obs):
+        self._observers.append(obs)
+
+    def send_message(self, msg, is_resend=False):
+        self.calls.append(bool(is_resend))
+        if len(self.calls) <= self.fails:
+            raise ConnectionError("transient")
+
+
+class TestSendWithRetry:
+    def test_succeeds_after_transients_counts_retries(self):
+        comm = _FlakyComm(fails=2)
+        sleeps = []
+        counters = {}
+        pol = RetryPolicy(max_retries=3, base_delay=0.1, multiplier=2.0)
+        used = send_with_retry(comm, Message("m", 0, 1), pol,
+                               counters=counters, sleep=sleeps.append)
+        assert used == 2 and counters["retries"] == 2
+        assert sleeps == [0.1, 0.2]  # exponential
+        assert comm.calls == [False, True, True]  # resends flagged
+
+    def test_gives_up_after_cap_and_raises_peer_lost(self):
+        comm = _FlakyComm(fails=99)
+        sink = _Collect()
+        comm.add_observer(sink)
+        pol = RetryPolicy(max_retries=2, base_delay=0.0)
+        with pytest.raises(PeerUnreachableError):
+            send_with_retry(comm, Message("m", 0, 7), pol,
+                            sleep=lambda s: None)
+        assert len(comm.calls) == 3  # 1 try + 2 retries
+        assert [t for t, _ in sink.got] == [MSG_TYPE_PEER_LOST]
+        assert sink.got[0][1].get_sender_id() == 7  # the lost rank
+
+    def test_timeout_budget_stops_before_retry_cap(self):
+        comm = _FlakyComm(fails=99)
+        t = [0.0]
+
+        def clock():
+            t[0] += 10.0
+            return t[0]
+
+        pol = RetryPolicy(max_retries=50, timeout_s=5.0)
+        with pytest.raises(PeerUnreachableError):
+            send_with_retry(comm, Message("m", 0, 1), pol,
+                            sleep=lambda s: None, clock=clock)
+        assert len(comm.calls) < 5
+
+
+class TestRoundController:
+    def _controller(self, policy):
+        done = []
+        ctl = RoundController(policy,
+                              lambda reps, out: done.append((out, reps)),
+                              lambda reps: done.append((ROUND_ABANDONED,
+                                                        reps)))
+        return ctl, done
+
+    def test_completes_at_target_ignores_overflow(self):
+        ctl, done = self._controller(RoundPolicy(deadline_s=0.0))
+        ctl.begin(0, 0, [1, 2, 3], target=2)
+        assert ctl.report(0, 0, 1, 4, "p1")
+        assert not ctl.report(0, 0, 1, 4, "dup")   # duplicate
+        assert ctl.report(0, 0, 2, 6, "p2")        # completes here
+        assert not ctl.report(0, 0, 3, 5, "p3")    # late (decided)
+        assert done == [(ROUND_COMPLETE, {1: (4.0, "p1"), 2: (6.0, "p2")})]
+        assert ctl.counters["duplicate_reports"] == 1
+        assert ctl.counters["late_reports"] == 1
+
+    def test_deadline_degraded_at_quorum(self):
+        ctl, done = self._controller(RoundPolicy(deadline_s=0.15,
+                                                 quorum=0.5))
+        ctl.begin(3, 0, [1, 2, 3, 4], target=4)
+        ctl.report(3, 0, 1, 1, "p1")
+        ctl.report(3, 0, 2, 1, "p2")
+        deadline = time.monotonic() + 5.0
+        while not done and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert done and done[0][0] == ROUND_DEGRADED
+        assert sorted(done[0][1]) == [1, 2]
+
+    def test_deadline_below_quorum_abandons(self):
+        ctl, done = self._controller(RoundPolicy(deadline_s=0.15,
+                                                 quorum=0.75))
+        ctl.begin(0, 0, [1, 2, 3, 4], target=4)
+        ctl.report(0, 0, 1, 1, "p1")  # 1 < ceil(0.75*4)=3
+        deadline = time.monotonic() + 5.0
+        while not done and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert done == [(ROUND_ABANDONED, {1: (1.0, "p1")})]
+
+    def test_all_outstanding_dead_resolves_early(self):
+        # no deadline at all: the cohort dying is what must resolve it
+        ctl, done = self._controller(RoundPolicy(deadline_s=0.0,
+                                                 quorum=0.5))
+        ctl.begin(0, 0, [1, 2], target=2)
+        ctl.report(0, 0, 1, 1, "p1")
+        ctl.peer_lost(2)
+        assert done and done[0][0] == ROUND_DEGRADED  # 1 >= ceil(0.5*2)
+        ctl2, done2 = self._controller(RoundPolicy(deadline_s=0.0,
+                                                   quorum=0.5))
+        ctl2.begin(0, 0, [1, 2], target=2)
+        ctl2.peer_lost(1)
+        ctl2.peer_lost(2)
+        assert done2 and done2[0][0] == ROUND_ABANDONED
+
+    def test_wrong_round_or_attempt_is_late(self):
+        ctl, done = self._controller(RoundPolicy())
+        ctl.begin(5, 1, [1, 2], target=2)
+        assert not ctl.report(4, 1, 1, 1, "old-round")
+        assert not ctl.report(5, 0, 1, 1, "old-attempt")
+        assert ctl.counters["late_reports"] == 2
+
+
+class TestAggregateReports:
+    def test_renormalizes_over_reporting_subset(self):
+        w = lambda v: {"w": np.full((2,), v, np.float32)}
+        full = {1: (10.0, w(1.0)), 2: (30.0, w(2.0)), 3: (60.0, w(3.0))}
+        sub = {k: full[k] for k in (1, 2)}
+        agg_sub, total = aggregate_reports(sub)
+        # weights renormalize over the REPORTERS' 40 samples, not 100:
+        # (10*1 + 30*2)/40 = 1.75 -- a zero-biased average would give 0.7
+        np.testing.assert_array_equal(agg_sub["w"],
+                                      np.full((2,), 1.75, np.float32))
+        assert total == 40.0
+        assert not np.isnan(agg_sub["w"]).any()
+
+    def test_bitwise_deterministic_order(self):
+        rng = np.random.default_rng(0)
+        reports = {r: (float(r), {"w": rng.normal(size=(8,))
+                                  .astype(np.float32)})
+                   for r in (5, 1, 9, 3)}
+        a, _ = aggregate_reports(dict(sorted(reports.items())))
+        b, _ = aggregate_reports(dict(reversed(sorted(reports.items()))))
+        np.testing.assert_array_equal(a["w"], b["w"])
+
+    def test_empty_subset_fails_fast(self):
+        with pytest.raises(ValueError):
+            aggregate_reports({})
+
+
+# ---------------------------------------------------------------------------
+# integration.py: sim path (renormalized partial aggregation over FedAvgAPI)
+# ---------------------------------------------------------------------------
+def _sim_setup(clients=4):
+    import jax.numpy as jnp
+
+    from fedml_tpu import models
+    from fedml_tpu.algorithms.specs import make_classification_spec
+    from fedml_tpu.data import load_synthetic_federated
+
+    ds = load_synthetic_federated(client_num=clients, n_train=200,
+                                  n_test=80, alpha=0.0, beta=0.0, seed=0)
+    spec = make_classification_spec(
+        models.LogisticRegression(num_classes=10, apply_sigmoid=False),
+        jnp.zeros((1, 60)))
+    return ds, spec
+
+
+def _sim_args(**kw):
+    base = dict(client_num_per_round=4, comm_round=2, epochs=1,
+                batch_size=16, lr=0.3, client_optimizer="sgd", wd=0.0,
+                frequency_of_the_test=100, ci=0, seed=0)
+    base.update(kw)
+    return types.SimpleNamespace(**base)
+
+
+class TestSimResilience:
+    def test_dropped_client_renormalizes_not_zero_biases(self):
+        import jax
+
+        from fedml_tpu.algorithms.fedavg import FedAvgAPI
+
+        ds, spec = _sim_setup()
+        # A: resilience drops client 2 in round 0 (simulated deadline miss)
+        miss = lambda r, a, c: c == 2
+        api_a = FedAvgAPI(ds, spec, _sim_args(straggler_p=1.0))
+        api_a.resilience = SimResilience(RoundPolicy(quorum=0.5),
+                                         miss_fn=miss)
+        api_a.train_one_round()
+        assert api_a._last_res_record["res/degraded"] == 1
+        assert api_a._last_res_record["res/reporting"] == 3
+        # B: no resilience, cohort forced to the same reporting subset
+        api_b = FedAvgAPI(ds, spec, _sim_args())
+        api_b._sample_cohort = lambda r: [0, 1, 3]
+        api_b.train_one_round()
+        for a, b in zip(jax.tree.leaves(api_a.global_state),
+                        jax.tree.leaves(api_b.global_state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # and it differs from the full-cohort round (the drop mattered)
+        api_c = FedAvgAPI(ds, spec, _sim_args())
+        api_c.train_one_round()
+        assert any(
+            not np.array_equal(np.asarray(a), np.asarray(c))
+            for a, c in zip(jax.tree.leaves(api_a.global_state),
+                            jax.tree.leaves(api_c.global_state)))
+        for leaf in jax.tree.leaves(api_a.global_state):
+            assert not np.isnan(np.asarray(leaf)).any()
+
+    def test_below_quorum_resamples_then_gives_up(self):
+        res = SimResilience(RoundPolicy(quorum=0.75, max_round_retries=2),
+                            miss_fn=lambda r, a, c: a == 0 and c < 3)
+        # attempt 0 drops clients 0..2 of [0..3] -> 1/4 < quorum 3;
+        # attempt 1 drops nobody -> completes, counted as abandoned once
+        reporting, rec = res.sample(0, 4, 4)
+        assert rec["res/attempts"] == 2
+        assert res.rounds_abandoned == 1
+        assert len(reporting) == 4
+        res2 = SimResilience(RoundPolicy(quorum=0.75, max_round_retries=1),
+                             miss_fn=lambda r, a, c: True)
+        with pytest.raises(RuntimeError):
+            res2.sample(0, 4, 4)
+
+    def test_overselect_trims_to_target(self):
+        res = SimResilience(RoundPolicy(overselect=0.5))
+        reporting, rec = res.sample(0, 10, 4)
+        assert rec["res/selected"] == 6  # ceil(1.5 * 4)
+        assert len(reporting) == 4      # first C reports win
+        assert rec["res/degraded"] == 0
+
+    def test_client_sampling_attempt_folds_seed(self):
+        from fedml_tpu.algorithms.fedavg import client_sampling
+
+        base = client_sampling(3, 20, 5)
+        assert client_sampling(3, 20, 5, attempt=0) == base  # back-compat
+        assert client_sampling(3, 20, 5, attempt=1) != base
+
+
+# ---------------------------------------------------------------------------
+# integration.py: distributed TCP control plane under chaos
+# ---------------------------------------------------------------------------
+W0 = {"w": np.zeros((2, 3), np.float32), "b": np.ones(3, np.float32)}
+
+
+class TestTcpChaos:
+    def test_kill_and_stall_complete_degraded_with_exact_subset_average(self):
+        # one client killed before its round-1 report, another stalled past
+        # the deadline: the 3-round run must complete degraded (not hang)
+        plan = FaultPlan(seed=7, rules=(
+            FaultRule("kill", rank=3, msg_type="res_report", nth=2),
+            FaultRule("stall", rank=2, msg_type="res_report", nth=1,
+                      delay_s=3.0),
+        ))
+        srv = run_tcp_fedavg(
+            4, 3, RoundPolicy(deadline_s=1.0, quorum=0.3), W0,
+            fault_plan=plan, join_timeout=60)
+        assert srv.failed is None and len(srv.history) == 3
+        assert srv.counters["rounds_degraded"] >= 1
+        assert srv.counters["clients_dropped"] == 1
+        # A/B: a no-fault run forced onto the same reporting subsets
+        # produces the identical trajectory -- the degraded aggregate IS
+        # the reporting-subset weighted average, bit for bit
+        subsets = srv.reporting_log
+        ref = run_tcp_fedavg(
+            4, 3, RoundPolicy(deadline_s=10.0, quorum=0.3), W0,
+            cohort_override=lambda r, a: subsets[r], join_timeout=60)
+        assert ref.reporting_log == subsets
+        for got, want in zip(srv.history, ref.history):
+            for k in got:
+                np.testing.assert_array_equal(got[k], want[k])
+
+    def test_no_fault_run_is_clean(self):
+        srv = run_tcp_fedavg(3, 2, RoundPolicy(deadline_s=5.0, quorum=0.5),
+                             W0, join_timeout=45)
+        assert srv.failed is None
+        assert srv.counters["rounds_degraded"] == 0
+        assert srv.reporting_log == [[1, 2], [1, 2]]
+        # oracle: quadratic_trainer pulls w toward rank with lr=0.25;
+        # round 1 weighted avg (n_r = 10r): (10*.25*1 + 20*.25*2)/30
+        expect = np.float32((10 * 0.25 * 1 + 20 * 0.25 * 2) / 30)
+        np.testing.assert_allclose(srv.history[0]["w"],
+                                   np.full((2, 3), expect), rtol=1e-6)
+
+    def test_wire_metrics_and_resend_accounting(self):
+        from fedml_tpu.utils.metrics import MetricsLogger
+
+        logger = MetricsLogger()
+        srv = run_tcp_fedavg(3, 1, RoundPolicy(deadline_s=5.0), W0,
+                             metrics_logger=logger, join_timeout=45)
+        assert srv.failed is None
+        # server counted its sync sends; receives counted by byte counters
+        assert srv.com_manager.bytes_sent > 0
+        assert srv.com_manager.bytes_received > 0
+        assert srv.com_manager.resends == 0
+
+    def test_resend_flag_counts_wire_but_not_raw(self):
+        from fedml_tpu.utils.metrics import MetricsLogger
+
+        logger = MetricsLogger()
+        net = LocalCommNetwork(2, serialize=True)
+        m = net.manager(1)
+        msg = Message("m", 1, 0)
+        msg.add("x", np.ones(4, np.float32))
+        m.send_message(msg)
+        m.send_message(msg, is_resend=True)
+        assert m.resends == 1
+        # tcp-level accounting asserted directly on the counter hook
+        from fedml_tpu.core.comm.tcp import TcpCommManager
+        tcp = TcpCommManager.__new__(TcpCommManager)
+        tcp.bytes_sent = 0
+        tcp.resends = 0
+        tcp._metrics = logger
+        tcp._count_out(100)
+        tcp._count_out(100, is_resend=True)
+        assert tcp.bytes_sent == 200 and tcp.resends == 1
+        assert logger._wire_bytes == 200      # resent bytes hit the wire
+        assert logger._wire_raw_bytes == 100  # logical payload counted once
+
+
+class TestRecovery:
+    def test_server_killed_at_round_k_resumes_bitwise(self, tmp_path):
+        pol = RoundPolicy(deadline_s=5.0, quorum=0.4)
+        ref = run_tcp_fedavg(4, 4, pol, W0, join_timeout=45)
+        d = str(tmp_path / "rec")
+        rec1 = RoundRecovery(d)
+        run_tcp_fedavg(4, 2, pol, W0, recovery=rec1, join_timeout=45)
+        rec1.close()
+        rec2 = RoundRecovery(d)
+        srv = run_tcp_fedavg(4, 4, pol, W0, recovery=rec2, join_timeout=45)
+        rec2.close()
+        assert srv.counters["resumes"] == 1
+        assert len(srv.history) == 2  # only rounds 2..3 re-ran
+        for k in ref.params:
+            np.testing.assert_array_equal(ref.params[k], srv.params[k])
+
+    def test_sim_path_resume_bitwise(self, tmp_path):
+        """--checkpoint_dir + --resume on the FedAvg main: kill after
+        round 2, resume to 4 -- rounds 3..4 bitwise match the
+        uninterrupted run (the docs/RESILIENCE.md determinism contract)."""
+        import jax
+
+        from fedml_tpu.experiments import main_fedavg
+
+        tiny = ["--dataset", "synthetic", "--model", "lr", "--lr", "0.1",
+                "--client_num_in_total", "4", "--client_num_per_round", "2",
+                "--epochs", "1", "--batch_size", "8", "--n_train", "64",
+                "--n_test", "32", "--frequency_of_the_test", "100",
+                "--ci", "1", "--save_frequency", "1"]
+        full, _ = main_fedavg.main(
+            tiny + ["--comm_round", "4",
+                    "--checkpoint_dir", str(tmp_path / "a")])
+        main_fedavg.main(tiny + ["--comm_round", "2",
+                                 "--checkpoint_dir", str(tmp_path / "b")])
+        resumed, _ = main_fedavg.main(
+            tiny + ["--comm_round", "4", "--resume", "1",
+                    "--checkpoint_dir", str(tmp_path / "b")])
+        assert resumed.round_idx == 4
+        for a, b in zip(jax.tree.leaves(full.global_state),
+                        jax.tree.leaves(resumed.global_state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

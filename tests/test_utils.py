@@ -297,9 +297,18 @@ def test_compilation_cache_persists_entries(tmp_path, monkeypatch):
     entries = list(tmp_path.iterdir())
     assert entries, "no cache entries written"
     t1 = float(r1.stdout.split("COMPILE_S")[1].strip())
+
+    # this jaxlib tracks cache-entry access times in `*-atime` sidecar
+    # files that are REWRITTEN on every hit (LRU eviction bookkeeping);
+    # they are not cache entries and must not read as a miss below
+    def entry_mtimes():
+        return {p.name: p.stat().st_mtime_ns for p in tmp_path.iterdir()
+                if not p.name.endswith("-atime")}
+
+    assert entry_mtimes(), "only atime sidecars written -- no real entries"
     # snapshot entry mtimes/names: run 2 hitting the cache must not
     # compile (and so must not write) anything new
-    before = {p.name: p.stat().st_mtime_ns for p in tmp_path.iterdir()}
+    before = entry_mtimes()
     r2 = subprocess.run([sys.executable, "-c", prog], capture_output=True,
                         text=True, env=env, cwd=os.path.dirname(
                             os.path.dirname(os.path.abspath(__file__))))
@@ -308,7 +317,7 @@ def test_compilation_cache_persists_entries(tmp_path, monkeypatch):
     # assert the cache-hit MECHANISM, not wall-clock (both runs are
     # sub-second CPU compiles; t2 < t1 is flaky under load / warm page
     # cache): a hit means no new entry files appear on run 2
-    after = {p.name: p.stat().st_mtime_ns for p in tmp_path.iterdir()}
+    after = entry_mtimes()
     # compare mtimes too: a miss that deterministically REWRITES the same
     # entry filename must fail, not just a miss that adds a new file
     assert after == before, (
