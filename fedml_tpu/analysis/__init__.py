@@ -21,15 +21,31 @@ transport code that swallows errors. Two halves:
   counts jit (re)traces per federated round via ``jax.monitoring`` and
   arms ``jax.transfer_guard`` around the end-of-round sync, reporting
   ``retraces_per_round`` / guarded-transfer violations through the
-  metrics logger. Wired to ``--audit`` on the experiment mains.
+  metrics logger. Wired to ``--audit`` on the experiment mains. Plus
+  ``race_audit()`` (``--race_audit``), the concurrency sanitizer:
+  instrumented control-plane locks recording acquisition order and
+  held-while-blocking events -- the runtime halves of FL124/FL125.
+- :mod:`fedml_tpu.analysis.protocol` / :mod:`fedml_tpu.analysis.concurrency`
+  -- "fedcheck", the control-plane passes: FSM protocol verification
+  (FL120 sent-but-unhandled, FL121 missing peer-lost handler, FL122 dead
+  handler) and thread-safety rules (FL123 unguarded shared state, FL124
+  lock-order cycles, FL125 blocking under a state lock).
+- :mod:`fedml_tpu.analysis.locks` -- analysis-facing re-export of the
+  cooperative lock factories (implemented in the stdlib-only leaf
+  :mod:`fedml_tpu.core.locks`, so transports don't import the analysis
+  machinery): ``audited_lock`` / ``audited_rlock`` state locks,
+  ``io_lock`` send-serialization locks -- plain ``threading`` primitives
+  normally, instrumented inside ``race_audit()``.
 """
 
 from fedml_tpu.analysis.dataflow import (ProjectIndex, infer_donate_argnums,
                                          plan_donation_fixes)
 from fedml_tpu.analysis.linter import (Finding, RULES, lint_paths,
                                        lint_source)
-from fedml_tpu.analysis.runtime import RuntimeAuditor, audit, current_auditor
+from fedml_tpu.analysis.runtime import (RaceAuditor, RuntimeAuditor, audit,
+                                        current_auditor, race_audit)
 
 __all__ = ["Finding", "RULES", "lint_paths", "lint_source",
            "ProjectIndex", "infer_donate_argnums", "plan_donation_fixes",
-           "RuntimeAuditor", "audit", "current_auditor"]
+           "RuntimeAuditor", "audit", "current_auditor",
+           "RaceAuditor", "race_audit"]

@@ -25,7 +25,8 @@ import sys
 from fedml_tpu.analysis.linter import (RULES, _Aliases, apply_baseline,
                                        iter_python_files, lint_paths,
                                        load_baseline, render_json,
-                                       render_text, write_baseline)
+                                       render_sarif, render_text,
+                                       write_baseline)
 
 # anchored to the installed package, not the cwd: the `fedlint` console
 # script must find the shipped baseline from any directory
@@ -45,7 +46,14 @@ def main(argv=None):
     parser.add_argument("paths", nargs="*", default=None,
                         help="files or directories to lint "
                              "(default: fedml_tpu/)")
-    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default="text",
+                        help="text (human), json (the CI gate's report), "
+                             "or sarif 2.1.0 (PR annotation upload)")
+    parser.add_argument("--sarif-out", default=None, metavar="PATH",
+                        help="also write the findings as SARIF 2.1.0 to "
+                             "PATH (one lint run, two reports -- ci.sh "
+                             "uses this next to its JSON report)")
     parser.add_argument("--baseline", default=DEFAULT_BASELINE,
                         help="baseline JSON tolerating pre-existing "
                              "findings (default: %(default)s; pass '' to "
@@ -103,8 +111,14 @@ def main(argv=None):
         return 0
 
     new = apply_baseline(findings, load_baseline(args.baseline))
+    if args.sarif_out:
+        with open(args.sarif_out, "w", encoding="utf-8") as fh:
+            fh.write(render_sarif(findings))
+            fh.write("\n")
     if args.format == "json":
         print(render_json(findings))
+    elif args.format == "sarif":
+        print(render_sarif(findings))
     else:
         print(render_text(findings, show_baselined=args.show_baselined))
     return 1 if new else 0

@@ -1,0 +1,430 @@
+"""fedcheck concurrency pass: thread-safety rules for the control plane.
+
+The threaded half of the framework (transports, round controller, resilient
+FSMs) shares instance state between the *main* thread and *handler* threads
+(transport serve loops, deadline timers, registered message handlers). A
+missed lock there is a flaky chaos run, not a test failure. Everything this
+pass checks is decidable from one class's AST:
+
+**Thread classification.** A method is *handler-reachable* when it is a
+root -- its bound method ``self.m`` escapes as a call argument (handler
+registration, ``Thread(target=...)``, timer factories, controller
+callbacks: an escaped bound method may run on any thread), or it is a
+transport entry by protocol convention (``receive_message``,
+``handle_receive_message``) -- or when a root reaches it through
+``self.x()`` calls. Everything else is main-thread.
+
+**Lock model.** Lock *families* are instance attributes assigned from a
+lock constructor (``threading.Lock/RLock``, or the declared factories in
+``fedml_tpu.analysis.locks``: ``audited_lock``/``audited_rlock`` = state
+locks, ``io_lock`` = dedicated I/O serialization locks). A ``with`` over a
+family member guards its body; a method whose every internal call site
+holds a lock is analyzed as holding it too (the ``*_locked`` helper idiom
+-- applied to underscore-named, non-escaped methods only, since public
+methods may be entered externally without the lock). Classes that create
+no locks are out of scope: they have declared no concurrency contract for
+this pass to verify (benign racy flags on lock-free classes stay legal).
+
+Rules:
+
+- **FL123** -- an instance attribute that the class elsewhere guards with a
+  state lock is accessed without it on a path involving handler threads
+  (or, with no owning lock at all, is read-modified-written ``+=`` on a
+  handler-reachable path -- concurrent handlers lose updates).
+- **FL124** -- lock-order cycle: two (or more) lock families acquired in
+  nested ``with`` blocks in opposite orders somewhere in the class --
+  a deadlock waiting for the right interleaving.
+- **FL125** -- a blocking call (frame send/recv, ``sendall``, ``join``,
+  ``sleep``, ``send_message``, ``send_with_retry``...) while holding a
+  *state* lock: one wedged peer pins every thread that needs the lock.
+  Dedicated ``io_lock`` families are exempt -- serializing one pipe's
+  blocking writes is their purpose.
+"""
+
+from __future__ import annotations
+
+import ast
+
+#: Constructor names (last dotted segment) that create a lock, by kind.
+_STATE_CTORS = {"Lock", "RLock", "audited_lock", "audited_rlock"}
+_IO_CTORS = {"io_lock"}
+
+#: Attribute calls that block the calling thread (socket/file/thread
+#: waits and transport sends). Deliberately excludes ``get``/``put``/
+#: ``wait`` -- too many non-blocking dict/event idioms share the names.
+_BLOCKING_ATTRS = {"sendall", "recv", "recv_into", "accept", "connect",
+                   "join", "sleep", "send_message", "publish",
+                   "handle_receive_message", "loop_forever"}
+#: Bare-name calls that block (this repo's frame helpers + retry send).
+_BLOCKING_NAMES = {"_send_frame", "_recv_frame", "send_with_retry"}
+
+#: Methods that transports enter from their receive machinery, treated as
+#: handler-thread roots by protocol convention.
+_NAMED_ROOTS = {"receive_message", "handle_receive_message"}
+
+
+class _Access:
+    __slots__ = ("method", "attr", "kind", "held", "node")
+
+    def __init__(self, method, attr, kind, held, node):
+        self.method = method
+        self.attr = attr
+        self.kind = kind        # "load" | "store" | "aug"
+        self.held = held        # frozenset of lock family names
+        self.node = node
+
+
+def check_concurrency(tree, add):
+    """Run FL123/FL124/FL125 over every class in ``tree``; findings go to
+    ``add(node, code, message)`` (the module linter's collector)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            _ClassChecker(node, add).run()
+
+
+class _ClassChecker:
+    def __init__(self, cls, add):
+        self.cls = cls
+        self.add = add
+        self.methods = {m.name: m for m in cls.body
+                        if isinstance(m, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))}
+        self.families = {}        # attr name -> "state" | "io"
+        self.accesses = []        # [_Access]
+        self.blocking = []        # (method, label, held, node)
+        self.calls = []           # (caller, callee, held-at-site)
+        self.edges = []           # (held family, acquired family, method, node)
+        self.acquires = []        # every with-acquisition: (family, method, node)
+        self.escaped = set()      # methods whose bound form escapes
+        self._locals = {}         # per-method: local name -> family
+
+    # -- lock family discovery -------------------------------------------
+    def _collect_families(self):
+        for fn in self.methods.values():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign) \
+                        or not isinstance(node.value, ast.Call):
+                    continue
+                kind = _ctor_kind(node.value.func)
+                if kind is None:
+                    continue
+                for tgt in node.targets:
+                    attr = _self_attr(tgt)
+                    if attr is None and isinstance(tgt, ast.Subscript):
+                        attr = _self_attr(tgt.value)  # dict-of-locks
+                    if attr is not None:
+                        self.families[attr] = kind
+
+    def _state_families(self):
+        return {f for f, k in self.families.items() if k == "state"}
+
+    # -- per-method walk ---------------------------------------------------
+    def run(self):
+        self._collect_families()
+        if not self.families:
+            return  # no locks: no declared concurrency contract to check
+        for name, fn in self.methods.items():
+            self._locals = self._lock_aliases(fn)
+            self._visit_stmts(fn.body, name, frozenset())
+        self._apply_held_propagation()
+        self._check_fl123()
+        self._check_fl124()
+        self._check_fl125()
+
+    def _lock_aliases(self, fn):
+        """Local names bound (anywhere in the method) from a lock-family
+        expression: ``slock = self._send_locks.get(r)``,
+        ``slocks = dict(self._send_locks)``."""
+        out = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                fam = self._expr_family(node.value)
+                if fam is None:
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out[tgt.id] = fam
+        return out
+
+    def _expr_family(self, expr):
+        for node in ast.walk(expr):
+            attr = _self_attr(node)
+            if attr is not None and attr in self.families:
+                return attr
+            if isinstance(node, ast.Name) and node.id in self._locals:
+                return self._locals[node.id]
+        return None
+
+    def _visit_stmts(self, stmts, method, held):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested scopes run on unknowable threads: skip
+            if isinstance(stmt, ast.With):
+                new = held
+                for item in stmt.items:
+                    fam = self._expr_family(item.context_expr)
+                    self._scan_expr(item.context_expr, method, held)
+                    if fam is not None:
+                        self.acquires.append((fam, method, stmt))
+                        for h in new:
+                            if h != fam:
+                                self.edges.append((h, fam, method, stmt))
+                        new = new | {fam}
+                self._visit_stmts(stmt.body, method, new)
+                continue
+            if isinstance(stmt, ast.AugAssign):
+                attr = _self_attr(stmt.target)
+                if attr is not None and attr not in self.families:
+                    self.accesses.append(_Access(method, attr, "aug",
+                                                 held, stmt))
+                elif isinstance(stmt.target, ast.Subscript):
+                    self._scan_expr(stmt.target.value, method, held)
+                self._scan_expr(stmt.value, method, held)
+                continue
+            # headers evaluated at this statement's point
+            for h in _header_exprs(stmt):
+                self._scan_expr(h, method, held)
+            for attr_name in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr_name, None)
+                if isinstance(sub, list):
+                    self._visit_stmts(sub, method, held)
+            for handler in getattr(stmt, "handlers", ()):
+                self._visit_stmts(handler.body, method, held)
+
+    def _scan_expr(self, expr, method, held):
+        if expr is None:
+            return
+        consumed = set()  # attribute nodes handled by the Call branch
+
+        def visit(node):
+            if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                return  # deferred bodies run later, locks not held
+            if isinstance(node, ast.Call):
+                f = node.func
+                sattr = _self_attr(f)
+                if sattr is not None and sattr in self.methods:
+                    consumed.add(id(f))
+                    self.calls.append((method, sattr, held))
+                elif isinstance(f, ast.Attribute) \
+                        and f.attr in _BLOCKING_ATTRS:
+                    self.blocking.append((method, f.attr, held, node))
+                elif isinstance(f, ast.Name) and f.id in _BLOCKING_NAMES:
+                    self.blocking.append((method, f.id, held, node))
+            attr = _self_attr(node)
+            if attr is not None and id(node) not in consumed:
+                if attr in self.methods:
+                    self.escaped.add(attr)  # bound method escaping
+                elif attr not in self.families:
+                    kind = ("store" if isinstance(
+                        node.ctx, (ast.Store, ast.Del)) else "load")
+                    self.accesses.append(_Access(method, attr, kind,
+                                                 held, node))
+                return  # don't descend into `self`
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        visit(expr)
+
+    # -- reachability + lock-held propagation ------------------------------
+    def _roots(self):
+        return (self.escaped | (_NAMED_ROOTS & set(self.methods)))
+
+    def _reachable(self):
+        reach = set(self._roots())
+        frontier = list(reach)
+        graph = {}
+        for caller, callee, _held in self.calls:
+            graph.setdefault(caller, set()).add(callee)
+        while frontier:
+            m = frontier.pop()
+            for callee in graph.get(m, ()):
+                if callee not in reach:
+                    reach.add(callee)
+                    frontier.append(callee)
+        return reach
+
+    def _apply_held_propagation(self):
+        """The ``*_locked`` helper idiom: a private, non-escaped method
+        whose *every* internal call site holds lock L is analyzed as
+        holding L -- callers take the lock, the helper mutates."""
+        base = {m: frozenset() for m in self.methods}
+        sites = {}
+        for caller, callee, held in self.calls:
+            sites.setdefault(callee, []).append((caller, held))
+        for _ in range(len(self.methods)):
+            changed = False
+            for m in self.methods:
+                if not m.startswith("_") or m in self._roots() \
+                        or m == "__init__" or m not in sites:
+                    continue
+                eff = None
+                for caller, held in sites[m]:
+                    h = held | base.get(caller, frozenset())
+                    eff = h if eff is None else (eff & h)
+                eff = frozenset(eff or ())
+                if eff != base[m]:
+                    base[m] = eff
+                    changed = True
+            if not changed:
+                break
+        self._base_held = base
+        for a in self.accesses:
+            a.held = a.held | base.get(a.method, frozenset())
+        self.blocking = [(m, label, held | base.get(m, frozenset()), node)
+                         for (m, label, held, node) in self.blocking]
+        # propagated holds also create order edges: a helper acquiring F
+        # while its callers hold H
+        extra = []
+        for (fam, m, node) in self.acquires:
+            for h in base.get(m, ()):
+                if h != fam:
+                    extra.append((h, fam, m, node))
+        self.edges.extend(extra)
+
+    # -- rules -------------------------------------------------------------
+    def _check_fl123(self):
+        state = self._state_families()
+        reachable = self._reachable()
+        by_attr = {}
+        for a in self.accesses:
+            by_attr.setdefault(a.attr, []).append(a)
+        for attr in sorted(by_attr):
+            accs = by_attr[attr]
+            owned = set()
+            for a in accs:
+                owned |= (set(a.held) & state)
+            writes = [a for a in accs if a.kind in ("store", "aug")
+                      and a.method != "__init__"]
+            handler_write = any(a.method in reachable for a in writes)
+            stored_outside_init = bool(writes)
+            if owned:
+                for a in sorted(accs, key=lambda a: a.node.lineno):
+                    if a.method == "__init__" or set(a.held) & owned:
+                        continue
+                    involved = handler_write or a.method in reachable
+                    if not involved:
+                        continue
+                    if a.kind == "load" and not stored_outside_init:
+                        continue  # reference set once in __init__: stable
+                    lock = "/".join(f"self.{f}" for f in sorted(owned))
+                    self.add(a.node, "FL123",
+                             f"`self.{attr}` is guarded by `{lock}` "
+                             "elsewhere in this class but "
+                             f"{'written' if a.kind != 'load' else 'read'} "
+                             f"here in `{a.method}` without it -- handler "
+                             "threads race this access (data race / torn "
+                             "state)")
+                    break
+            else:
+                for a in sorted(accs, key=lambda a: a.node.lineno):
+                    if a.kind == "aug" and a.method in reachable \
+                            and a.method != "__init__" \
+                            and not (set(a.held) & state):
+                        self.add(a.node, "FL123",
+                                 f"read-modify-write of `self.{attr}` on "
+                                 f"the handler-thread path `{a.method}` "
+                                 "without a lock -- concurrent handler "
+                                 "threads lose updates; guard the counter "
+                                 "with a state lock")
+                        break
+
+    def _check_fl124(self):
+        nodes_for = {}
+        for (h, f, _m, node) in self.edges:
+            nodes_for.setdefault((h, f), node)
+        for cycle in find_lock_cycles((h, f) for (h, f, _m, _n)
+                                      in self.edges):
+            node = nodes_for[(cycle[-1], cycle[0])]
+            order = " -> ".join(f"self.{x}" for x in cycle + [cycle[0]])
+            self.add(node, "FL124",
+                     f"lock-order cycle: {order} -- these locks are "
+                     "acquired in opposite orders on different paths; "
+                     "the right thread interleaving deadlocks both")
+
+    def _check_fl125(self):
+        state = self._state_families()
+        for (method, label, held, node) in self.blocking:
+            held_state = sorted(set(held) & state)
+            if not held_state:
+                continue
+            locks = ", ".join(f"self.{f}" for f in held_state)
+            self.add(node, "FL125",
+                     f"blocking call `{label}` while holding state lock "
+                     f"{locks} -- one wedged peer (full send buffer, dead "
+                     "socket) pins every thread needing the lock. Release "
+                     "it first, or serialize the I/O with a dedicated "
+                     "`io_lock()` (fedml_tpu.analysis.locks)")
+
+
+def find_lock_cycles(edges):
+    """Unique cycles in a directed acquisition-order edge set, deduped by
+    node set; each returned as ``[n1, ..., nk]`` (closing edge
+    ``nk -> n1``). Shared by the static FL124 check and the runtime race
+    auditor (``analysis.runtime.RaceAuditor``), so the two halves can
+    never drift."""
+    graph = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+    out, seen = [], set()
+
+    def dfs(start, cur, path):
+        for nxt in sorted(graph.get(cur, ())):
+            if nxt == start:
+                key = frozenset(path)
+                if key not in seen:
+                    seen.add(key)
+                    out.append(list(path))
+            elif nxt not in path:
+                dfs(start, nxt, path + [nxt])
+
+    for start in sorted(graph):
+        dfs(start, start, [start])
+    return out
+
+
+def _ctor_kind(func):
+    name = func.attr if isinstance(func, ast.Attribute) else (
+        func.id if isinstance(func, ast.Name) else None)
+    if name in _STATE_CTORS:
+        return "state"
+    if name in _IO_CTORS:
+        return "io"
+    return None
+
+
+def _self_attr(node):
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _header_exprs(stmt):
+    """Expressions of a statement evaluated at its own sequence point
+    (compound bodies recurse separately)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter, stmt.target]
+    if isinstance(stmt, ast.Try):
+        return []
+    if isinstance(stmt, ast.Return):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, ast.Expr):
+        return [stmt.value]
+    if isinstance(stmt, ast.Assign):
+        return [stmt.value] + list(stmt.targets)
+    if isinstance(stmt, ast.AnnAssign):
+        return [e for e in (stmt.value, stmt.target) if e is not None]
+    if isinstance(stmt, ast.Delete):
+        return list(stmt.targets)
+    if isinstance(stmt, (ast.Assert,)):
+        return [stmt.test]
+    if isinstance(stmt, ast.Raise):
+        return [e for e in (stmt.exc, stmt.cause) if e is not None]
+    return []
+
+
+__all__ = ["check_concurrency", "find_lock_cycles"]

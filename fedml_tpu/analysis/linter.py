@@ -107,6 +107,53 @@ RULES = {
         "every trace, copied into every compiled executable, and doubles "
         "HBM against the runtime-passed copy. Pass large arrays as "
         "arguments instead."),
+    "FL113": (
+        "jit closure captures a host-loaded/converted array of "
+        "statically unknowable size",
+        "a jitted function closing over a `jnp.asarray(...)`/`np.load"
+        "(...)` result bakes a device-resident constant whose size the "
+        "linter cannot bound into the jaxpr -- checkpoint-sized data "
+        "silently becomes a per-executable constant. Pass it as an "
+        "argument (FL112's reasoning, without the size escape hatch)."),
+    "FL120": (
+        "message type sent but unhandled by any counterpart FSM",
+        "a `Message(TYPE, ...)` flowing into send_message/send_with_retry "
+        "whose TYPE no counterpart FSM registers a handler for is "
+        "silently logged-and-dropped by the receiving manager "
+        "(core/managers.py); the sender waits forever for a reply -- the "
+        "hung-round failure class of cross-device FL."),
+    "FL121": (
+        "FSM without a MSG_TYPE_PEER_LOST handler",
+        "DistributedManager fails fast when a transport reports a dead "
+        "peer and no MSG_TYPE_PEER_LOST handler is registered: the "
+        "receive loop stops and run() raises. An FSM that registers any "
+        "handler must decide its peer-death policy explicitly "
+        "(re-cohort, degrade, or shut down)."),
+    "FL122": (
+        "handler registered for a message type nothing sends",
+        "a registered handler whose type no counterpart FSM ever sends "
+        "is dead protocol state -- usually a renamed constant or a "
+        "deleted send path; the handler masks the protocol drift."),
+    "FL123": (
+        "cross-thread instance state accessed without its owning lock",
+        "an attribute guarded by a state lock elsewhere in the class is "
+        "accessed without it on a path handler threads reach (or a "
+        "counter is `+=`-mutated on a handler path with no lock at "
+        "all): a data race that surfaces as a flaky chaos run, not a "
+        "test failure."),
+    "FL124": (
+        "lock-order cycle across nested lock acquisitions",
+        "two lock families acquired in opposite nesting orders on "
+        "different paths deadlock under the right thread interleaving; "
+        "acquire in one global order or restructure so the second lock "
+        "is taken after the first is released."),
+    "FL125": (
+        "blocking call while holding a state lock",
+        "a frame send/recv, sendall, join, or sleep under a lock that "
+        "also guards shared state lets one wedged peer (full send "
+        "buffer, dead socket) pin every thread that needs the lock. "
+        "Serialize I/O with a dedicated io_lock() "
+        "(fedml_tpu.analysis.locks) and keep state locks non-blocking."),
 }
 
 #: FL112 only flags captures whose *static* element count is at least
@@ -119,9 +166,12 @@ FL112_MIN_ELEMENTS = 16384
 #: experiments/common.py.
 _FL107_PATHS = ("*/comm/*", "*transport*", "*codec*", "*compression*",
                 "*mqtt*", "*tcp*")
-#: FL108 skips user-facing CLIs, where print IS the interface.
+#: FL108 skips user-facing CLIs, where print IS the interface. The bench
+#: drivers (bench.py, __graft_entry__.py, scripts/) are CLIs too: their
+#: stdout is parsed by the measurement harness, so print is load-bearing.
 _FL108_EXCLUDED = ("*/experiments/*", "*prepare.py", "*/scripts/*",
-                   "*cli.py")
+                   "scripts/*", "*cli.py", "bench.py", "*/bench.py",
+                   "__graft_entry__.py", "*/__graft_entry__.py")
 
 _NP_MODULE_NAMES = {"numpy"}
 _JAX_MODULE_NAMES = {"jax"}
@@ -664,14 +714,16 @@ class _ModuleLinter:
         return isinstance(node, ast.Attribute) \
             and node.attr == "PartitionSpec"
 
-    def _resolve_spec_assignment(self, entry, near):
-        """One-hop name resolution for FL109: find the single
-        ``name = <expr>`` binding of ``entry`` in an enclosing scope of
-        ``near`` (innermost first) and return the assigned expression.
-        Returns None -- judge nothing -- when the name is a function
-        parameter (caller-supplied), is bound more than once or through
-        non-Assign forms (loop targets, tuple unpacking), or resolves to
-        another bare name (a second hop)."""
+    def _resolve_spec_assignment(self, entry, near, depth=0):
+        """Name resolution for FL109 through up to TWO single-binding
+        assignment hops: find the single ``name = <expr>`` binding of
+        ``entry`` in an enclosing scope of ``near`` (innermost first) and
+        return the assigned expression; a value that is itself a bare
+        name (``spec = a`` where ``a = P(...)``) resolves through one
+        more hop. Returns None -- judge nothing -- when the name is a
+        function parameter (caller-supplied), is bound more than once or
+        through non-Assign forms (loop targets, tuple unpacking), or the
+        chain runs deeper than two hops."""
         if not isinstance(entry, ast.Name):
             return None
         name = entry.id
@@ -692,7 +744,11 @@ class _ModuleLinter:
                           and isinstance(n.ctx, ast.Store) and n.id == name]
                 if len(assigns) == 1 and len(stores) == 1:
                     value = assigns[0]
-                    return None if isinstance(value, ast.Name) else value
+                    if isinstance(value, ast.Name):
+                        return (self._resolve_spec_assignment(
+                                    value, near, depth + 1)
+                                if depth + 1 < 2 else None)
+                    return value
                 if stores:  # rebound or bound through complex targets
                     return None
             scope = self._parents.get(id(scope))
@@ -794,6 +850,41 @@ class _ModuleLinter:
                          "constant; pass it as an argument so XLA "
                          "aliases one copy")
                 return
+            if size is None and self._is_unbounded_array_load(value):
+                self.add(site.site, "FL113",
+                         f"jitted function closes over `{name}`, built "
+                         "by a host load/conversion "
+                         "(jnp.asarray/np.load) whose size is "
+                         "statically unknowable -- the array becomes a "
+                         "per-executable jaxpr constant; pass it as an "
+                         "argument instead")
+                return
+
+    def _is_unbounded_array_load(self, node):
+        """FL113: a call materializing an array whose size the linter
+        cannot bound -- ``jnp.asarray``/``jnp.array`` over a non-literal,
+        or any ``np.load``/``np.loadtxt``/``np.fromfile``. Small literal
+        containers (``jnp.asarray([1, 2, 3])``) are bounded and exempt."""
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        if not (isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)):
+            return False
+        root = f.value.id
+        if root in self.aliases.np and f.attr in ("load", "loadtxt",
+                                                  "fromfile"):
+            return True
+        if root in self.aliases.jnp and f.attr in ("asarray", "array",
+                                                   "load"):
+            arg = node.args[0] if node.args else None
+            if isinstance(arg, ast.Constant):
+                return False  # scalar constant: trivially bounded
+            if isinstance(arg, (ast.List, ast.Tuple)) and all(
+                    isinstance(e, ast.Constant) for e in arg.elts):
+                return False  # literal table: bounded and idiomatic
+            return True
+        return False
 
     def _static_array_size(self, node):
         """Element count of a jnp/np array-constructor call with literal
@@ -880,19 +971,9 @@ class _ModuleLinter:
 
 # -- driver ---------------------------------------------------------------
 
-def _lint_module(path, src, tree, index, select=None, ignore=None):
-    """Per-module rules + (when ``index`` is given) the project-wide
-    FL110 dataflow pass, filtered through suppressions/select/ignore."""
-    per_line, per_file = _parse_suppressions(src)
-    linter = _ModuleLinter(path, src, tree)
-    linter.run()
-    if index is not None:
-        from fedml_tpu.analysis.dataflow import (ProjectIndex,
-                                                 check_use_after_donate)
-        check_use_after_donate(index, ProjectIndex.module_name(path), tree,
-                               linter.add)
+def _filter_findings(findings, per_line, per_file, select=None, ignore=None):
     out = []
-    for f in linter.findings:
+    for f in findings:
         if select and f.code not in select:
             continue
         if ignore and f.code in ignore:
@@ -900,7 +981,58 @@ def _lint_module(path, src, tree, index, select=None, ignore=None):
         if _suppressed(f, per_line, per_file):
             continue
         out.append(f)
+    return out
+
+
+def _lint_module(path, src, tree, index, select=None, ignore=None):
+    """Per-module rules (including the class-local concurrency pass) +
+    (when ``index`` is given) the project-wide FL110 dataflow pass,
+    filtered through suppressions/select/ignore."""
+    per_line, per_file = _parse_suppressions(src)
+    linter = _ModuleLinter(path, src, tree)
+    linter.run()
+    from fedml_tpu.analysis.concurrency import check_concurrency
+    check_concurrency(tree, linter.add)
+    if index is not None:
+        from fedml_tpu.analysis.dataflow import (ProjectIndex,
+                                                 check_use_after_donate)
+        check_use_after_donate(index, ProjectIndex.module_name(path), tree,
+                               linter.add)
+    out = _filter_findings(linter.findings, per_line, per_file,
+                           select=select, ignore=ignore)
     out.sort(key=lambda f: (f.line, f.col, f.code))
+    return out
+
+
+def _protocol_findings(pindex, mod_info, select=None, ignore=None):
+    """Run the project-wide protocol pass (FL120-FL122) and attach each
+    finding to its owning module, honoring that module's suppressions.
+    ``mod_info``: dotted module name -> (rel path, src)."""
+    from fedml_tpu.analysis.protocol import check_protocol
+    raw = []
+
+    def emit(module, node, code, message):
+        info = mod_info.get(module)
+        if info is None:
+            return
+        rel, src = info
+        lines = src.splitlines()
+        lineno = getattr(node, "lineno", 1)
+        text = lines[lineno - 1].strip() if 1 <= lineno <= len(lines) else ""
+        raw.append((module, Finding(
+            path=rel, line=lineno,
+            col=getattr(node, "col_offset", 0) + 1, code=code,
+            message=message, text=text)))
+
+    check_protocol(pindex, emit)
+    out = []
+    supp = {}
+    for module, f in raw:
+        if module not in supp:
+            supp[module] = _parse_suppressions(mod_info[module][1])
+        per_line, per_file = supp[module]
+        out.extend(_filter_findings([f], per_line, per_file,
+                                    select=select, ignore=ignore))
     return out
 
 
@@ -908,6 +1040,7 @@ def lint_source(src, path="<string>", select=None, ignore=None):
     """Lint one module's source (project-wide rules see only this one
     module). Returns non-suppressed findings."""
     from fedml_tpu.analysis.dataflow import ProjectIndex
+    from fedml_tpu.analysis.protocol import ProtocolIndex
     try:
         tree = ast.parse(src, filename=path)
     except SyntaxError as e:
@@ -915,8 +1048,15 @@ def lint_source(src, path="<string>", select=None, ignore=None):
                         code="FL100", message=f"syntax error: {e.msg}")]
     index = ProjectIndex()
     index.add_module(path, tree, _Aliases(tree))
-    return _lint_module(path, src, tree, index, select=select,
-                        ignore=ignore)
+    pindex = ProtocolIndex()
+    pindex.add_module(path, tree)
+    findings = _lint_module(path, src, tree, index, select=select,
+                            ignore=ignore)
+    findings += _protocol_findings(
+        pindex, {ProtocolIndex.module_name(path): (path, src)},
+        select=select, ignore=ignore)
+    findings.sort(key=lambda f: (f.line, f.col, f.code))
+    return findings
 
 
 def iter_python_files(paths):
@@ -934,12 +1074,17 @@ def iter_python_files(paths):
 
 def lint_paths(paths, select=None, ignore=None):
     """Two-pass project lint: pass 1 parses every file and builds the
-    cross-module jit symbol table (donation contracts travel through
-    builder returns and imports); pass 2 runs the rules per module with
-    that index in scope."""
+    cross-module symbol tables (jit/donation contracts travel through
+    builder returns and imports; protocol constants and FSM classes
+    through import edges); pass 2 runs the per-module rules with the jit
+    index in scope, then the project-wide protocol pass over the whole
+    fileset."""
     from fedml_tpu.analysis.dataflow import ProjectIndex
+    from fedml_tpu.analysis.protocol import ProtocolIndex
     index = ProjectIndex()
+    pindex = ProtocolIndex()
     modules, findings = [], []
+    mod_info = {}
     for path in iter_python_files(paths):
         with open(path, encoding="utf-8") as fh:
             src = fh.read()
@@ -952,10 +1097,14 @@ def lint_paths(paths, select=None, ignore=None):
                 code="FL100", message=f"syntax error: {e.msg}"))
             continue
         index.add_module(rel, tree, _Aliases(tree))
+        pindex.add_module(rel, tree)
+        mod_info[ProtocolIndex.module_name(rel)] = (rel, src)
         modules.append((rel, src, tree))
     for rel, src, tree in modules:
         findings.extend(_lint_module(rel, src, tree, index, select=select,
                                      ignore=ignore))
+    findings.extend(_protocol_findings(pindex, mod_info, select=select,
+                                       ignore=ignore))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
     return findings
 
@@ -1018,4 +1167,54 @@ def render_json(findings):
         "summary": {"total": len(findings),
                     "baselined": sum(1 for f in findings if f.baselined),
                     "new": sum(1 for f in findings if not f.baselined)},
+    }, indent=2)
+
+
+def render_sarif(findings):
+    """SARIF 2.1.0 report (one run), so CI can annotate findings on PRs.
+    Baselined findings carry a ``suppressions`` entry -- SARIF viewers
+    show them greyed out instead of failing the check."""
+    catalog = dict(RULES)
+    catalog.setdefault("FL100", (
+        "syntax error in a linted file",
+        "the file never parsed; nothing else was checked."))
+    rules = [{
+        "id": code,
+        "shortDescription": {"text": title},
+        "fullDescription": {"text": rationale},
+        "defaultConfiguration": {"level": "warning"},
+    } for code, (title, rationale) in sorted(catalog.items())]
+    rule_index = {r["id"]: i for i, r in enumerate(rules)}
+    results = []
+    for f in findings:
+        res = {
+            "ruleId": f.code,
+            "ruleIndex": rule_index.get(f.code, -1),
+            "level": "warning",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace(os.sep, "/")},
+                    "region": {"startLine": f.line,
+                               "startColumn": max(f.col, 1)},
+                },
+            }],
+        }
+        if f.baselined:
+            res["suppressions"] = [{
+                "kind": "external",
+                "justification": "accepted debt in fedlint_baseline.json",
+            }]
+        results.append(res)
+    return json.dumps({
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "fedlint",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
     }, indent=2)

@@ -1,0 +1,13 @@
+"""Analysis-facing alias for the cooperative lock factories.
+
+The implementation lives in :mod:`fedml_tpu.core.locks` -- a stdlib-only
+leaf, so the transports can create declared locks without importing the
+analysis machinery. This module re-exports the factories under the
+analysis namespace (the rule messages and docs reference them here), and
+:func:`fedml_tpu.analysis.runtime.race_audit` arms the instrumentation by
+setting ``fedml_tpu.core.locks._auditor``.
+"""
+
+from fedml_tpu.core.locks import audited_lock, audited_rlock, io_lock
+
+__all__ = ["audited_lock", "audited_rlock", "io_lock"]

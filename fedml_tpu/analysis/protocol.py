@@ -1,0 +1,430 @@
+"""fedcheck protocol pass: static verification of the message-passing FSMs.
+
+The distributed control plane is a set of ``ClientManager``/``ServerManager``
+subclasses exchanging typed :class:`~fedml_tpu.core.message.Message` frames.
+Its failure modes are protocol-level, not line-level: a type sent with no
+registered handler on the other side is silently dropped by the receiving
+manager (a ``logging.warning`` and a hung round -- the exact blocked-forever
+behavior Bonawitz et al., MLSys 2019 §3 identify as cross-device FL's
+dominant failure class), and a missing ``MSG_TYPE_PEER_LOST`` handler turns
+every mid-round peer death into a hard ``RuntimeError`` out of
+``DistributedManager.run``. All of it is decidable from the AST:
+
+1. **Extraction** (pass 1, :class:`ProtocolIndex`): for every FSM subclass,
+   the set of *handled* message types (``register_message_receive_handler``
+   calls, resolving name-bound constants through module-level assignments
+   and import edges) and the set of *sent* types (``Message(TYPE, ...)``
+   constructions flowing into ``send_message``/``send_with_retry``).
+2. **Pairing** (pass 2, :func:`check_protocol`): server FSMs are paired
+   with client FSMs by role (which base class they descend from); a type
+   sent by one role must be handled by some FSM of the counterpart role.
+
+Rules:
+
+- **FL120** -- a type is sent but no counterpart FSM registers a handler
+  for it: the receiving manager logs-and-drops, the sender waits forever.
+- **FL121** -- a concrete FSM registers handlers but none for
+  ``MSG_TYPE_PEER_LOST``: ``core/managers.py`` fail-fasts at runtime when
+  a peer dies (the receive loop stops and ``run()`` raises).
+- **FL122** -- a handler is registered for a type nothing sends: dead
+  protocol state (usually a renamed constant or a deleted send path).
+
+Unresolvable types (computed strings, caller-supplied parameters) judge
+nothing, and transport-reserved types (``__``-prefixed: peer-lost,
+goodbye, stop) are synthesized by the transports, not sent by FSMs, so
+they are exempt from FL120/FL122.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+#: Known FSM root classes (``fedml_tpu/core/managers.py``) and their roles.
+#: Matched by *name* so single-module analysis (tests, snippets) works even
+#: when the managers module is outside the linted fileset.
+FSM_ROOTS = {
+    "ServerManager": "server",
+    "ClientManager": "client",
+    "DistributedManager": "both",
+}
+
+PEER_LOST_NAME = "MSG_TYPE_PEER_LOST"
+PEER_LOST_VALUE = "__peer_lost__"
+
+#: Transport-internal frame types: synthesized/consumed by the transports
+#: themselves, never part of an FSM's send set.
+_RESERVED_PREFIX = "__"
+
+_SEND_FUNCS = {"send_message", "send_with_retry"}
+_REGISTER = "register_message_receive_handler"
+
+
+class _TypeRef:
+    """One message-type reference: the syntactic name (if any), the
+    resolved string value (if resolvable), and the node to report at."""
+
+    __slots__ = ("name", "value", "node")
+
+    def __init__(self, name, value, node):
+        self.name = name
+        self.value = value
+        self.node = node
+
+
+class _FsmClass:
+    """Protocol surface of one class: bases, handled and sent types."""
+
+    def __init__(self, module, node):
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.bases = [_base_name(b) for b in node.bases]
+        self.handled = []  # [_TypeRef]
+        self.sent = []     # [_TypeRef]
+        self.registers_any = False
+
+
+def _base_name(node):
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _type_expr_ref(expr, node):
+    """A message-type expression -> (name, literal value) pair; computed
+    expressions yield (None, None) and judge nothing."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return _TypeRef(None, expr.value, node)
+    if isinstance(expr, ast.Name):
+        return _TypeRef(expr.id, None, node)
+    if isinstance(expr, ast.Attribute):  # Cls.MSG_X style constants
+        return _TypeRef(expr.attr, None, node)
+    return _TypeRef(None, None, node)
+
+
+class _ModuleProtocol:
+    """Per-module extraction: string constants, imports, FSM classes."""
+
+    def __init__(self, module, tree):
+        self.module = module
+        self.tree = tree
+        #: module-level ``NAME = "literal"`` bindings (single assignment)
+        self.constants = {}
+        #: local name -> (source module, original name)
+        self.imports = {}
+        self.classes = {}  # class name -> _FsmClass
+        self._collect_constants(tree)
+        self._collect_imports(tree)
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                self.classes[node.name] = self._extract_class(node)
+
+    def _collect_constants(self, tree):
+        counts = {}
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                name = stmt.targets[0].id
+                counts[name] = counts.get(name, 0) + 1
+                if isinstance(stmt.value, ast.Constant) \
+                        and isinstance(stmt.value.value, str):
+                    self.constants[name] = stmt.value.value
+        for name, n in counts.items():  # rebound names are ambiguous
+            if n > 1:
+                self.constants.pop(name, None)
+
+    def _collect_imports(self, tree):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    self.imports[a.asname or a.name] = (node.module, a.name)
+
+    def _extract_class(self, node):
+        fsm = _FsmClass(self.module, node)
+        class_sends = False
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            fname = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if fname == _REGISTER and sub.args:
+                fsm.registers_any = True
+                fsm.handled.append(_type_expr_ref(sub.args[0], sub))
+            elif fname in _SEND_FUNCS:
+                class_sends = True
+        for meth in node.body:
+            if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fsm.sent.extend(_sent_types(meth, class_sends))
+        return fsm
+
+
+def _sent_types(func, class_sends):
+    """``Message(TYPE, ...)`` constructions in ``func`` that the class
+    sends. The flow judgment is class-granular, not expression-granular:
+    messages routinely escape the building method (``_open_round``
+    returns the sync batch, ``_send_syncs`` delivers it), so any
+    construction inside a class that invokes ``send_message``/
+    ``send_with_retry`` *somewhere* counts as sent -- a missed send
+    would be an FL120/FL122 false verdict. A class with no send call at
+    all contributes nothing."""
+    if not class_sends:
+        return []
+    sent = []
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if name == "Message" and node.args:
+            sent.append(_type_expr_ref(node.args[0], node))
+    return sent
+
+
+class ProtocolIndex:
+    """Cross-module constant + FSM-class resolution (protocol pass 1)."""
+
+    def __init__(self):
+        self.modules = {}  # dotted module name -> _ModuleProtocol
+
+    @staticmethod
+    def module_name(path):
+        rel = path.replace(os.sep, "/")
+        if rel.endswith(".py"):
+            rel = rel[:-3]
+        return rel.strip("/").replace("/", ".")
+
+    def add_module(self, path, tree):
+        mod = self.module_name(path)
+        self.modules[mod] = _ModuleProtocol(mod, tree)
+        return self.modules[mod]
+
+    def _candidates(self, src_mod):
+        """Import-target module candidates: exact dotted name, or any
+        indexed module whose dotted name ends with it (relative layouts,
+        tmp dirs)."""
+        return [src_mod] + [m for m in self.modules
+                            if m == src_mod or m.endswith("." + src_mod)]
+
+    def resolve_const(self, module, name, seen=None):
+        """String value of ``name`` in ``module``, following import edges.
+        None when out of static reach."""
+        seen = set() if seen is None else seen
+        if (module, name) in seen:
+            return None
+        seen.add((module, name))
+        info = self.modules.get(module)
+        if info is None:
+            return None
+        if name in info.constants:
+            return info.constants[name]
+        if name in info.imports:
+            src_mod, src_name = info.imports[name]
+            for cand in self._candidates(src_mod):
+                value = self.resolve_const(cand, src_name, seen)
+                if value is not None:
+                    return value
+        return None
+
+    def resolve_class(self, module, name, seen=None):
+        """(-> (_FsmClass, defining module) or (None, None)), following
+        import edges."""
+        seen = set() if seen is None else seen
+        if (module, name) in seen:
+            return None, None
+        seen.add((module, name))
+        info = self.modules.get(module)
+        if info is None:
+            return None, None
+        if name in info.classes:
+            return info.classes[name], module
+        if name in info.imports:
+            src_mod, src_name = info.imports[name]
+            for cand in self._candidates(src_mod):
+                cls, mod = self.resolve_class(cand, src_name, seen)
+                if cls is not None:
+                    return cls, mod
+        return None, None
+
+    def fsm_role(self, module, class_name, seen=None):
+        """'server' / 'client' / 'both' when the class descends from an
+        FSM root (transitively, across modules), else None."""
+        seen = set() if seen is None else seen
+        if (module, class_name) in seen:
+            return None
+        seen.add((module, class_name))
+        if class_name in FSM_ROOTS:
+            # the roots themselves are abstract; but a base NAMED like a
+            # root makes the subclass an FSM of that role
+            return FSM_ROOTS[class_name]
+        cls, mod = self.resolve_class(module, class_name)
+        if cls is None:
+            return None
+        roles = set()
+        for base in cls.bases:
+            if base is None:
+                continue
+            if base in FSM_ROOTS:
+                roles.add(FSM_ROOTS[base])
+                continue
+            r = self.fsm_role(mod, base, seen)
+            if r is not None:
+                roles.add(r)
+        if not roles:
+            return None
+        if roles == {"both"}:
+            return "both"
+        roles.discard("both")
+        return roles.pop() if len(roles) == 1 else "both"
+
+    def ancestors(self, module, class_name, seen=None):
+        """FSM ancestor classes inside the indexed fileset (for inherited
+        handler registrations)."""
+        seen = set() if seen is None else seen
+        out = []
+        cls, mod = self.resolve_class(module, class_name)
+        if cls is None or (mod, class_name) in seen:
+            return out
+        seen.add((mod, class_name))
+        for base in cls.bases:
+            if base is None or base in FSM_ROOTS:
+                continue
+            bcls, bmod = self.resolve_class(mod, base)
+            if bcls is not None and (bmod, bcls.name) not in seen:
+                out.append((bcls, bmod))
+                out.extend(self.ancestors(bmod, bcls.name, seen))
+
+        return out
+
+
+def _resolved(index, module, ref):
+    """Concrete string value of a _TypeRef, or None."""
+    if ref.value is not None:
+        return ref.value
+    if ref.name is not None:
+        return index.resolve_const(module, ref.name)
+    return None
+
+
+def _is_peer_lost(index, module, ref):
+    """PEER_LOST is credited by value OR by name: the constant's defining
+    module may be outside the linted fileset (single-file runs)."""
+    return (ref.name == PEER_LOST_NAME
+            or _resolved(index, module, ref) == PEER_LOST_VALUE)
+
+
+def check_protocol(index, emit):
+    """Protocol pass 2 over every module in ``index``.
+
+    ``emit(module, node, code, message)`` receives each finding, attached
+    to the module that owns the offending node.
+    """
+    # collect concrete FSMs with their roles and effective (own +
+    # inherited) handled sets
+    fsms = []  # (cls, module, role, handled_refs, registers_any)
+    for mod, info in sorted(index.modules.items()):
+        for cls in info.classes.values():
+            role = None
+            for base in cls.bases:
+                if base is None:
+                    continue
+                if base in FSM_ROOTS:
+                    role = _merge_role(role, FSM_ROOTS[base])
+                else:
+                    role = _merge_role(role, index.fsm_role(mod, base))
+            if role is None:
+                continue
+            handled = list(cls.handled)
+            registers = cls.registers_any
+            for acls, amod in index.ancestors(mod, cls.name):
+                handled.extend(acls.handled)
+                registers = registers or acls.registers_any
+            fsms.append((cls, mod, role, handled, registers))
+
+    # resolve each FSM's type sets ONCE and memo them per role: the
+    # counterpart queries below would otherwise re-run the import-edge
+    # constant resolution O(F^2) times per lint
+    handled_by_role, sent_by_role = {}, {}
+    for cls, mod, r, handled, _reg in fsms:
+        hs = handled_by_role.setdefault(r, set())
+        for ref in handled:
+            v = _resolved(index, mod, ref)
+            if v is not None:
+                hs.add(v)
+        ss = sent_by_role.setdefault(r, set())
+        for ref in cls.sent:
+            v = _resolved(index, mod, ref)
+            if v is not None:
+                ss.add(v)
+
+    _WANT = {"server": ("client", "both"),
+             "client": ("server", "both"),
+             "both": ("server", "client", "both")}
+
+    def counterpart_handled(role):
+        return set().union(*(handled_by_role.get(r, set())
+                             for r in _WANT[role]))
+
+    def counterpart_sent(role):
+        return set().union(*(sent_by_role.get(r, set())
+                             for r in _WANT[role]))
+
+    for cls, mod, role, handled, registers in fsms:
+        # FL121: a concrete FSM (registers at least one handler) without a
+        # peer-lost handler fails fast at runtime on any mid-round death
+        if registers and not any(_is_peer_lost(index, mod, ref)
+                                 for ref in handled):
+            emit(mod, cls.node, "FL121",
+                 f"FSM `{cls.name}` registers message handlers but none "
+                 f"for {PEER_LOST_NAME}: a peer dying mid-round stops the "
+                 "receive loop and DistributedManager.run() raises "
+                 "(core/managers.py fail-fast). Register a handler to "
+                 "re-cohort or shut down deliberately")
+        # FL120: sent types the counterpart role never handles
+        seen_sent = set()
+        peer_handles = counterpart_handled(role)
+        for ref in cls.sent:
+            v = _resolved(index, mod, ref)
+            if v is None or v.startswith(_RESERVED_PREFIX) or v in seen_sent:
+                continue
+            seen_sent.add(v)
+            if v not in peer_handles:
+                emit(mod, ref.node, "FL120",
+                     f"`{cls.name}` sends message type '{v}' but no "
+                     "counterpart FSM registers a handler for it -- the "
+                     "receiving manager logs-and-drops the frame and the "
+                     "round hangs waiting for a reply")
+        # FL122: handled types the counterpart role never sends
+        seen_handled = set()
+        peer_sends = counterpart_sent(role)
+        for ref in handled:
+            if ref not in cls.handled:
+                continue  # inherited registrations report at the ancestor
+            v = _resolved(index, mod, ref)
+            if (v is None or v.startswith(_RESERVED_PREFIX)
+                    or _is_peer_lost(index, mod, ref) or v in seen_handled):
+                continue
+            seen_handled.add(v)
+            if v not in peer_sends:
+                emit(mod, ref.node, "FL122",
+                     f"`{cls.name}` registers a handler for '{v}' but no "
+                     "counterpart FSM ever sends that type -- dead "
+                     "protocol state (renamed constant or deleted send "
+                     "path?)")
+
+
+def _merge_role(a, b):
+    if b is None:
+        return a
+    if a is None or a == b:
+        return b
+    return "both"
+
+
+__all__ = ["ProtocolIndex", "check_protocol", "FSM_ROOTS",
+           "PEER_LOST_NAME", "PEER_LOST_VALUE"]

@@ -1,4 +1,4 @@
-"""Runtime retrace/transfer auditor.
+"""Runtime retrace/transfer auditor + the concurrency race sanitizer.
 
 What the linter cannot see statically -- an argument whose shape changes
 every round, a cache key that silently includes a Python scalar -- shows up
@@ -17,12 +17,28 @@ violation there means the aggregated state contains host-resident leaves
 not raised -- the audit reports, the run continues. (On the CPU backend
 device buffers are host-visible, so device->host violations largely cannot
 trip there; the counter is exercised for real on TPU.)
+
+The second half is the **race sanitizer** (:func:`race_audit`,
+``--race_audit`` on the resilience-wired mains): the runtime analog of the
+static concurrency rules FL124/FL125. Inside the context, the control
+plane's cooperative lock factories (``fedml_tpu.analysis.locks``) return
+*instrumented* locks that record, per thread, the order in which lock
+creation sites are nested (lock-order cycles == FL124's runtime shape) and
+whether any *state* lock is held when execution reaches a blocking
+chokepoint (the TCP frame send/recv helpers are patched for the audit's
+lifetime; ``io_lock`` families are exempt by declared purpose -- FL125's
+runtime shape). The chaos smoke in ``scripts/ci.sh`` runs the TCP
+fault-injection scenario under this audit and asserts both violation
+lists stay empty.
 """
 
 from __future__ import annotations
 
 import contextlib
 import logging
+import os
+import threading
+import traceback
 
 #: jax.monitoring event names (stable strings from jax._src.dispatch;
 #: hardcoded so the auditor never imports private modules at import time).
@@ -184,6 +200,180 @@ def audit(metrics_logger=None, enabled=True, transfer_guard="device_to_host"):
             metrics_logger(report)
 
 
+# -- race sanitizer -------------------------------------------------------
+
+class _AuditedLock:
+    """Instrumented lock handed out by the ``analysis.locks`` factories
+    while a :func:`race_audit` is active. Semantics are exactly the
+    wrapped ``threading`` primitive's; acquisition/release additionally
+    maintain the auditor's per-thread held stack."""
+
+    __slots__ = ("_inner", "_auditor", "kind", "site")
+
+    def __init__(self, auditor, kind, reentrant, site):
+        self._inner = threading.RLock() if reentrant else threading.Lock()
+        self._auditor = auditor
+        self.kind = kind
+        self.site = site
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._auditor._acquired(self)
+        return ok
+
+    def release(self):
+        self._auditor._released(self)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __getattr__(self, name):
+        # exact surface parity with the wrapped primitive: e.g.
+        # ``.locked()`` exists on Lock always, on RLock only from 3.12 --
+        # delegating (instead of defining it here) keeps hasattr() and
+        # AttributeError behavior identical inside and outside an audit
+        if name == "_inner":  # not yet bound (unpickling-style paths)
+            raise AttributeError(name)
+        return getattr(self._inner, name)
+
+
+def _creation_site():
+    """file:line of the lock's creation, skipping the factory frames --
+    the stable identity lock-order edges aggregate on (per-peer send
+    locks are many instances of ONE site)."""
+    own = ("locks.py", "runtime.py")
+    for frame in reversed(traceback.extract_stack()[:-1]):
+        base = os.path.basename(frame.filename)
+        if base not in own:
+            return f"{base}:{frame.lineno}"
+    return "<unknown>"
+
+
+class RaceAuditor:
+    """Records lock-acquisition order and held-while-blocking events for
+    every lock created through the cooperative factories while active."""
+
+    def __init__(self):
+        self._tls = threading.local()
+        self._mu = threading.Lock()  # deliberately uninstrumented
+        self._active = True
+        self.locks_created = 0
+        self.acquisitions = 0
+        self.order_edges = {}         # (site_a, site_b) -> count
+        self.held_while_blocking = []  # (label, (lock sites...), thread)
+
+    # -- factory hook (fedml_tpu.analysis.locks) --------------------------
+    def make_lock(self, kind, reentrant):
+        with self._mu:
+            self.locks_created += 1
+        return _AuditedLock(self, kind, reentrant, _creation_site())
+
+    def _held(self):
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _acquired(self, lock):
+        held = self._held()
+        if self._active:
+            with self._mu:
+                self.acquisitions += 1
+                for h in held:
+                    if h.site != lock.site:
+                        key = (h.site, lock.site)
+                        self.order_edges[key] = \
+                            self.order_edges.get(key, 0) + 1
+        held.append(lock)
+
+    def _released(self, lock):
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+    # -- chokepoints -------------------------------------------------------
+    def blocking(self, label):
+        """Called by the patched blocking chokepoints: any *state* lock
+        held here is a held-while-blocking violation (io locks exist to
+        be held across exactly this)."""
+        if not self._active:
+            return
+        held = [l for l in self._held() if l.kind == "state"]
+        if held:
+            event = (label, tuple(sorted({l.site for l in held})),
+                     threading.current_thread().name)
+            with self._mu:
+                self.held_while_blocking.append(event)
+            logging.warning("race audit: %s while holding state lock(s) "
+                            "%s on %s", *event)
+
+    # -- reporting ---------------------------------------------------------
+    def lock_order_cycles(self):
+        """Site-level cycles in the observed acquisition-order graph
+        (same detector as the static FL124 pass)."""
+        from fedml_tpu.analysis.concurrency import find_lock_cycles
+        return [cycle + [cycle[0]]
+                for cycle in find_lock_cycles(self.order_edges)]
+
+    def report(self):
+        return {
+            "race/locks_created": self.locks_created,
+            "race/acquisitions": self.acquisitions,
+            "race/order_edges": sorted(
+                f"{a} -> {b}" for (a, b) in self.order_edges),
+            "race/lock_order_cycles": self.lock_order_cycles(),
+            "race/held_while_blocking": list(self.held_while_blocking),
+        }
+
+
+@contextlib.contextmanager
+def race_audit(enabled=True, metrics_logger=None):
+    """Arm the race sanitizer: locks created through
+    ``fedml_tpu.analysis.locks`` inside this context are instrumented,
+    and the TCP frame helpers are patched to report blocking points.
+    Yields the :class:`RaceAuditor` (or None when disabled, so
+    ``--race_audit`` wires straight through); pushes the report to
+    ``metrics_logger`` on exit."""
+    if not enabled:
+        yield None
+        return
+    from fedml_tpu.core import locks as _locks
+    from fedml_tpu.core.comm import tcp as _tcp
+    auditor = RaceAuditor()
+    prev = _locks._auditor
+    _locks._auditor = auditor
+    orig_send, orig_recv = _tcp._send_frame, _tcp._recv_frame
+
+    def _send(sock, payload):
+        auditor.blocking("tcp._send_frame")
+        return orig_send(sock, payload)
+
+    def _recv(sock):
+        auditor.blocking("tcp._recv_frame")
+        return orig_recv(sock)
+
+    _tcp._send_frame, _tcp._recv_frame = _send, _recv
+    try:
+        yield auditor
+    finally:
+        _locks._auditor = prev
+        _tcp._send_frame, _tcp._recv_frame = orig_send, orig_recv
+        auditor._active = False  # long-lived managers stop recording
+        report = auditor.report()
+        logging.info("race audit: %s", report)
+        if metrics_logger is not None:
+            metrics_logger(report)
+
+
 def _unregister(callback):
     """Best-effort listener removal: jax only exposes clear-all publicly,
     so reach for the testing hook and fall back to leaving the (inert)
@@ -199,4 +389,5 @@ def _unregister(callback):
 
 
 __all__ = ["RuntimeAuditor", "audit", "current_auditor",
+           "RaceAuditor", "race_audit",
            "TRACE_EVENT", "COMPILE_EVENT"]

@@ -35,6 +35,7 @@ import socket
 import struct
 import threading
 
+from fedml_tpu.core.locks import audited_lock, io_lock
 from fedml_tpu.compression.codec import message_from_wire
 from fedml_tpu.core.comm.base import (BaseCommunicationManager,
                                       MSG_TYPE_PEER_LOST)
@@ -126,11 +127,17 @@ class TcpCommManager(BaseCommunicationManager):
         self._metrics = metrics_logger
         self._observers = []
         self._running = False
-        # _lock guards peer membership (and the client's single pipe);
-        # per-peer _send_locks serialize writes per connection so one
+        # _lock guards peer membership + the _lost_notified dedup set;
+        # per-peer _send_locks (and the client's single _send_lock)
+        # serialize the blocking frame writes per connection so one
         # stalled peer (full OS send buffer) can only wedge sends TO that
-        # peer, never the membership lock or the whole hub
-        self._lock = threading.Lock()
+        # peer, never the membership lock or the whole hub. The split is
+        # load-bearing: a frame write under _lock would let one wedged
+        # pipe block peer-lost dispatch and membership changes (fedcheck
+        # FL125); _ctr_lock keeps the wire counters exact when several
+        # serve threads count concurrently (FL123 lost-update hazard).
+        self._lock = audited_lock()
+        self._ctr_lock = audited_lock()
         self._send_locks = {}
         self._lost_notified = set()  # see _notify_peer_lost
         self._loop_active = False  # client receive loop running?
@@ -158,7 +165,7 @@ class TcpCommManager(BaseCommunicationManager):
                 conn.settimeout(None)
                 _enable_keepalive(conn)
                 self._peers[peer_rank] = conn
-                self._send_locks[peer_rank] = threading.Lock()
+                self._send_locks[peer_rank] = io_lock()
         else:
             # retry the dial until the server is up (launch order between
             # hosts is not coordinated) or the timeout elapses
@@ -176,6 +183,7 @@ class TcpCommManager(BaseCommunicationManager):
             _send_frame(self._sock, json.dumps({"rank": self.rank}).encode())
             self._sock.settimeout(None)  # see server side: idle != dead
             _enable_keepalive(self._sock)
+            self._send_lock = io_lock()  # serializes pipe writes (see _lock)
 
     # -- BaseCommunicationManager ----------------------------------------
     def add_observer(self, observer):
@@ -185,12 +193,19 @@ class TcpCommManager(BaseCommunicationManager):
         self._observers.remove(observer)
 
     def _count_out(self, nbytes, is_resend=False):
-        self.bytes_sent += nbytes
-        if is_resend:
-            self.resends += 1
+        # several serve threads relay (and the FSM sends) concurrently:
+        # unguarded `+=` on the shared counters loses updates
+        with self._ctr_lock:
+            self.bytes_sent += nbytes
+            if is_resend:
+                self.resends += 1
         if self._metrics is not None:
             self._metrics.count_wire(nbytes,
                                      raw_bytes=0 if is_resend else nbytes)
+
+    def _count_in(self, nbytes):
+        with self._ctr_lock:
+            self.bytes_received += nbytes
 
     def send_message(self, msg: Message, is_resend=False):
         receiver = int(msg.get_receiver_id())
@@ -226,8 +241,10 @@ class TcpCommManager(BaseCommunicationManager):
             # Mirror the server branch's failure semantics: a dead server
             # mid-send must dispatch PEER_LOST (sends can fail before the
             # receive loop has ever started) and raise a typed error.
+            # _send_lock, not _lock: a wedged pipe write must never block
+            # _notify_peer_lost / membership state behind it (FL125)
             try:
-                with self._lock:
+                with self._send_lock:
                     _send_frame(self._sock, payload)
             except OSError as e:
                 self._notify_peer_lost(0)
@@ -272,7 +289,7 @@ class TcpCommManager(BaseCommunicationManager):
                         # closing with unread inbound would RST and could
                         # destroy the GOODBYE still queued at the server
                         continue
-                    self.bytes_received += len(frame)
+                    self._count_in(len(frame))
                     msg = message_from_wire(frame)
                     if msg.get_type() == MSG_TYPE_PEER_LOST:
                         logging.warning("tcp client: dropping in-band "
@@ -302,7 +319,7 @@ class TcpCommManager(BaseCommunicationManager):
                                   "%s", peer_rank)
                 self._drop_peer(peer_rank, lost=True)
                 return
-            self.bytes_received += len(frame)
+            self._count_in(len(frame))
             try:
                 msg = message_from_wire(frame)
             except (ValueError, KeyError, IndexError, TypeError,
@@ -461,12 +478,23 @@ class TcpCommManager(BaseCommunicationManager):
             # a crash (EOF alone now means MSG_TYPE_PEER_LOST there).
             # SHUT_WR (not close) so inbound can still be drained -- an
             # immediate close with unread inbound data would RST and
-            # could destroy the queued GOODBYE server-side.
-            try:
-                with self._lock:
+            # could destroy the queued GOODBYE server-side. Bounded
+            # acquire, mirroring the server's STOP wave: a handler
+            # wedged mid-send (server alive but not reading) must not
+            # block shutdown forever -- on timeout we skip the GOODBYE
+            # (the server will see a PEER_LOST-grade EOF, which is
+            # honest: this pipe IS wedged) and the shutdown/hard-close
+            # below still wakes the stuck sendall.
+            if self._send_lock.acquire(timeout=2.0):
+                try:
                     _send_frame(self._sock,
                                 Message(MSG_TYPE_GOODBYE, self.rank, 0)
                                 .to_json().encode())
+                except OSError:
+                    pass
+                finally:
+                    self._send_lock.release()
+            try:
                 self._sock.shutdown(socket.SHUT_WR)
             except OSError:
                 pass

@@ -1,0 +1,65 @@
+"""Cooperative lock factories: the control plane's TSAN-style annotations.
+
+The threaded control plane (``core/comm/*``, ``resilience/*``, the metrics
+wire counters) creates its locks through these factories instead of bare
+``threading.Lock()``. Two things are bought with that one level of
+indirection:
+
+- **Declared intent**: a lock is either a *state* lock (guards instance
+  attributes; must never be held across a blocking call -- fedcheck rule
+  FL125) or a dedicated *I/O serialization* lock (``io_lock``; exists
+  precisely to be held across one peer's blocking socket write, so a
+  stalled peer serializes only its own pipe). The static concurrency pass
+  (``fedml_tpu.analysis.concurrency``) reads the constructor name to
+  classify lock families, and the runtime race auditor applies the same
+  exemption.
+- **Instrumentation hook**: inside ``fedml_tpu.analysis.runtime.
+  race_audit()`` these factories return *audited* locks that record
+  acquisition order (for lock-order-cycle detection, the runtime half of
+  FL124) and held-while-blocking events (the runtime half of FL125).
+  Outside an audit they return plain ``threading`` primitives -- zero
+  overhead, zero behavior change.
+
+This module is a leaf (stdlib only) so the transports can depend on it
+without pulling the analysis machinery in; ``fedml_tpu.analysis.locks``
+re-exports it as the analysis-facing surface.
+"""
+
+from __future__ import annotations
+
+import threading
+
+#: Armed by ``fedml_tpu.analysis.runtime.race_audit``; when set, the
+#: factories route through ``_auditor.make_lock`` so every lock created
+#: inside the audited region is instrumented.
+_auditor = None
+
+
+def _make(kind, reentrant):
+    if _auditor is None:
+        return threading.RLock() if reentrant else threading.Lock()
+    return _auditor.make_lock(kind=kind, reentrant=reentrant)
+
+
+def audited_lock():
+    """A *state* lock: guards instance attributes; FL125 forbids holding
+    it across blocking calls (socket writes, sends, joins)."""
+    return _make("state", reentrant=False)
+
+
+def audited_rlock():
+    """Reentrant *state* lock (e.g. the resilient server's round-turnover
+    lock, whose peer-lost chain may re-enter the abandon path)."""
+    return _make("state", reentrant=True)
+
+
+def io_lock():
+    """A dedicated I/O serialization lock: its *purpose* is to be held
+    across one blocking write so concurrent writers to the same pipe
+    interleave whole frames. Exempt from held-while-blocking checks
+    (static FL125 and the runtime sanitizer); still participates in
+    lock-order tracking."""
+    return _make("io", reentrant=False)
+
+
+__all__ = ["audited_lock", "audited_rlock", "io_lock"]

@@ -185,6 +185,19 @@ def audit_scope(args, logger, wired=True):
     return audit(metrics_logger=logger, enabled=enabled)
 
 
+def race_audit_scope(args, logger):
+    """``--race_audit`` context: arms the concurrency race sanitizer
+    (``fedml_tpu.analysis.runtime.race_audit``). Locks the control plane
+    creates inside the context are instrumented; the simulation path
+    creates few (the vmapped rounds are single-threaded), so a zero
+    report there is honest -- the TCP/chaos drivers are where the
+    sanitizer bites (see the ci.sh chaos smoke)."""
+    from fedml_tpu.analysis.runtime import race_audit
+
+    return race_audit(enabled=bool(getattr(args, "race_audit", 0)),
+                      metrics_logger=logger)
+
+
 def make_mesh(args):
     if not getattr(args, "mesh", 0):
         return None
@@ -294,8 +307,9 @@ def run_fedavg_family(api, args, logger):
                       data_rng=api_._data_rng)
 
     with profile_trace(args.profile_dir, enabled=args.profile_dir is not None):
-        with audit_scope(args, logger):
-            api.train(on_round=on_round)
+        with race_audit_scope(args, logger):
+            with audit_scope(args, logger):
+                api.train(on_round=on_round)
     if ckpt is not None:
         ckpt.close()
     return api.global_state

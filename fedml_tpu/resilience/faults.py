@@ -38,6 +38,7 @@ from typing import Optional
 
 import numpy as np
 
+from fedml_tpu.core.locks import audited_lock
 from fedml_tpu.core.comm.base import (BaseCommunicationManager,
                                       MSG_TYPE_PEER_LOST)
 from fedml_tpu.core.message import Message
@@ -149,7 +150,7 @@ class FaultyCommManager(BaseCommunicationManager):
         self._send_index = 0
         self._held = None  # reorder buffer (at most one message)
         self._dead = False
-        self._lock = threading.Lock()  # kill() may race the sender thread
+        self._lock = audited_lock()  # kill() may race the sender thread
 
     # -- fault application -------------------------------------------------
     def send_message(self, msg: Message, **kw):

@@ -29,6 +29,7 @@ from typing import Optional
 
 import numpy as np
 
+from fedml_tpu.core.locks import audited_rlock
 from fedml_tpu.core.comm.base import MSG_TYPE_PEER_LOST
 from fedml_tpu.core.managers import ClientManager, ServerManager
 from fedml_tpu.core.message import Message
@@ -62,6 +63,13 @@ def add_resilience_args(parser):
              "the report deadline, drawn from a seeded stream keyed on "
              "(seed, round, attempt, client) -- reproducible chaos for the "
              "vmapped rounds")
+    parser.add_argument(
+        "--race_audit", type=int, default=0,
+        help="arm the concurrency race sanitizer "
+             "(fedml_tpu.analysis.runtime.race_audit): control-plane "
+             "locks record acquisition order and held-while-blocking "
+             "events; the report (race/lock_order_cycles, "
+             "race/held_while_blocking, ...) goes to the metrics sink")
     return parser
 
 
@@ -262,7 +270,7 @@ class ResilientFedAvgServer(ServerManager):
         # sending thread, and that chain may re-enter a turnover callback
         # (depth bounded by max_round_retries -- the abandon path is the
         # only recursive one, since zero reports can never meet quorum).
-        self._advance_lock = threading.RLock()
+        self._advance_lock = audited_rlock()
 
     # -- FSM surface -------------------------------------------------------
     def register_message_receive_handlers(self):
@@ -272,19 +280,34 @@ class ResilientFedAvgServer(ServerManager):
                                               self._on_peer_lost)
 
     def start(self):
-        """Kick off round 0 (or the checkpointed round on resume)."""
-        if self.recovery is not None:
-            saved = self.recovery.restore_latest()
-            if saved is not None:
-                self.params = {k: np.asarray(v)
-                               for k, v in saved["global_state"].items()}
-                self.round_idx = int(saved["round_idx"])
-                self.counters["resumes"] += 1
-        if self.round_idx >= self.rounds:
+        """Kick off round 0 (or the checkpointed round on resume).
+
+        The restore runs UNDER ``_advance_lock``: ``run_tcp_fedavg``
+        starts client threads before the server FSM, so a racing send
+        failure can dispatch PEER_LOST (and drive a turnover) while the
+        restore is still rewriting ``params``/``round_idx`` -- writing
+        them unlocked races those handler threads (fedcheck FL123)."""
+        syncs = []
+        with self._advance_lock:
+            if self.recovery is not None:
+                saved = self.recovery.restore_latest()
+                if saved is not None:
+                    self.params = {k: np.asarray(v)
+                                   for k, v in saved["global_state"].items()}
+                    self.round_idx = int(saved["round_idx"])
+                    self.counters["resumes"] += 1
+            done = self.round_idx >= self.rounds
+            if not done:
+                syncs = self._open_round()
+            done = done or self.failed is not None
+        # finish() OUTSIDE the lock: it reaches the transport's STOP wave
+        # (blocking per-peer socket writes) and must not pin the turnover
+        # lock every handler needs -- the race sanitizer's
+        # held-while-blocking check catches this cross-class chain that
+        # the class-local static FL125 cannot see
+        if done:
             self.finish()
             return
-        with self._advance_lock:
-            syncs = self._open_round()
         self._send_syncs(syncs)
 
     def _open_round(self):
@@ -352,6 +375,7 @@ class ResilientFedAvgServer(ServerManager):
 
     # -- round turnover (serve/timer threads) ------------------------------
     def _on_round_complete(self, reports, outcome):
+        syncs = []
         with self._advance_lock:
             self.params, _total = aggregate_reports(reports)
             self.history.append(dict(self.params))
@@ -365,13 +389,17 @@ class ResilientFedAvgServer(ServerManager):
                                          last=done)
             self.round_idx += 1
             self.attempt = 0
-            if self.round_idx >= self.rounds:
-                self.finish()
-                return
-            syncs = self._open_round()
+            done = self.round_idx >= self.rounds
+            if not done:
+                syncs = self._open_round()
+            done = done or self.failed is not None
+        if done:                    # see start(): no STOP wave under the
+            self.finish()           # turnover lock
+            return
         self._send_syncs(syncs)
 
     def _on_round_abandoned(self, reports):
+        syncs = []
         with self._advance_lock:
             self.counters["rounds_abandoned"] += 1
             logging.warning("round %d attempt %d abandoned with %d reports",
@@ -380,8 +408,12 @@ class ResilientFedAvgServer(ServerManager):
             if self.attempt > self.round_policy.max_round_retries:
                 self._fail(f"round {self.round_idx} abandoned "
                            f"{self.attempt} times")
-                return
-            syncs = self._open_round()
+            else:
+                syncs = self._open_round()
+            done = self.failed is not None
+        if done:  # see start(): finish() outside the lock
+            self.finish()
+            return
         self._send_syncs(syncs)
 
     def _log_round(self, n_reports, degraded):
@@ -395,10 +427,12 @@ class ResilientFedAvgServer(ServerManager):
         self.metrics_logger(rec)
 
     def _fail(self, reason):
+        """Mark the run failed and stop the controller. Runs UNDER
+        ``_advance_lock``; the lock-exiting caller performs the actual
+        ``finish()`` (transport STOP wave = blocking writes) outside."""
         self.failed = reason
         logging.error("resilient server giving up: %s", reason)
         self._controller.cancel()
-        self.finish()
 
     def finish(self):
         self._controller.cancel()

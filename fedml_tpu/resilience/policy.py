@@ -35,6 +35,7 @@ from typing import Optional
 
 import numpy as np
 
+from fedml_tpu.core.locks import audited_lock
 from fedml_tpu.core.comm.base import MSG_TYPE_PEER_LOST
 from fedml_tpu.core.message import Message
 
@@ -164,7 +165,7 @@ class RoundController:
         self._on_complete = on_complete
         self._on_abandoned = on_abandoned
         self._timer_factory = timer_factory
-        self._lock = threading.Lock()
+        self._lock = audited_lock()
         self._timer = None
         self._round = None
         self._attempt = None
@@ -253,13 +254,17 @@ class RoundController:
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
-        return outcome, dict(self._reports)
+        # the decision tuple carries its own generation: _fire runs
+        # OUTSIDE the lock (turnover callbacks may re-enter begin), so by
+        # the time it logs, self._round may already belong to the NEXT
+        # attempt -- reading it there is a data race (fedcheck FL123)
+        return (outcome, dict(self._reports), self._round, self._attempt,
+                self._target)
 
     def _fire(self, decision):
-        outcome, reports = decision
+        outcome, reports, round_idx, attempt, target = decision
         logging.info("round %s attempt %s: %s with %d/%d reports",
-                     self._round, self._attempt, outcome, len(reports),
-                     self._target)
+                     round_idx, attempt, outcome, len(reports), target)
         if outcome == ROUND_ABANDONED:
             self._on_abandoned(reports)
         else:

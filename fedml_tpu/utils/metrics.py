@@ -19,6 +19,8 @@ import logging
 import os
 import time
 
+from fedml_tpu.core.locks import audited_lock
+
 
 class MetricsLogger:
     """Callable metrics sink: ``logger(dict)`` or ``logger.log(dict)``.
@@ -39,6 +41,7 @@ class MetricsLogger:
         self._summary = {}
         self._wire_bytes = 0
         self._wire_raw_bytes = 0
+        self._wire_lock = audited_lock()
         if run_dir is not None:
             os.makedirs(run_dir, exist_ok=True)
             self._jsonl = open(os.path.join(run_dir, "metrics.jsonl"), "a")
@@ -60,22 +63,27 @@ class MetricsLogger:
 
     def count_wire(self, encoded_bytes, raw_bytes=0):
         """Accumulate on-wire payload bytes (and, optionally, what the same
-        payload would cost uncompressed) toward the next logged record."""
-        self._wire_bytes += int(encoded_bytes)
-        self._wire_raw_bytes += int(raw_bytes)
+        payload would cost uncompressed) toward the next logged record.
+        The TCP hub feeds this from several serve threads concurrently, so
+        the counters are lock-guarded (unguarded ``+=`` loses updates --
+        fedcheck FL123's hazard, one call deeper than the transport)."""
+        with self._wire_lock:
+            self._wire_bytes += int(encoded_bytes)
+            self._wire_raw_bytes += int(raw_bytes)
 
     def log(self, metrics: dict):
         record = _jsonable(metrics)
-        if self._wire_bytes and "bytes_on_wire" not in record:
-            record["bytes_on_wire"] = self._wire_bytes
-            if self._wire_raw_bytes:
-                record["compression_ratio"] = round(
-                    self._wire_raw_bytes / self._wire_bytes, 3)
-            # reset only when consumed: a record that carries its own
-            # bytes_on_wire must not silently discard transport-fed counts
-            # -- they attach to the next record without the field
-            self._wire_bytes = 0
-            self._wire_raw_bytes = 0
+        with self._wire_lock:
+            if self._wire_bytes and "bytes_on_wire" not in record:
+                record["bytes_on_wire"] = self._wire_bytes
+                if self._wire_raw_bytes:
+                    record["compression_ratio"] = round(
+                        self._wire_raw_bytes / self._wire_bytes, 3)
+                # reset only when consumed: a record that carries its own
+                # bytes_on_wire must not silently discard transport-fed
+                # counts -- they attach to the next record without the field
+                self._wire_bytes = 0
+                self._wire_raw_bytes = 0
         logging.info("%s", record)
         if self._jsonl is not None:
             self._jsonl.write(json.dumps({"_ts": time.time(), **record}) + "\n")

@@ -8,11 +8,16 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== fedlint gate (JAX/FL static analysis, fedml_tpu/analysis;"
-echo "   fails on findings not in fedml_tpu/analysis/fedlint_baseline.json,"
-echo "   on ANY remaining baseline debt, and on a non-idempotent --fix) =="
+echo "== fedlint gate (JAX/FL static analysis + fedcheck protocol/"
+echo "   concurrency passes, over the package AND the bench/driver"
+echo "   scripts; fails on findings not in fedlint_baseline.json, on ANY"
+echo "   remaining baseline debt, and on a non-idempotent --fix) =="
 mkdir -p bench_results
-if ! python -m fedml_tpu.analysis fedml_tpu/ --format json \
+LINT_SCOPE="fedml_tpu/ bench.py __graft_entry__.py scripts/"
+# one lint run, two reports: JSON (the gate's input) on stdout, SARIF
+# 2.1.0 (PR annotation upload) via --sarif-out
+if ! python -m fedml_tpu.analysis $LINT_SCOPE --format json \
+        --sarif-out bench_results/fedlint_report.sarif \
         > bench_results/fedlint_report.json; then
     # fail LOUD: echo the findings into the CI log, don't make the
     # maintainer reproduce locally to learn which rule fired
@@ -31,10 +36,12 @@ assert rep["summary"]["baselined"] == 0, (
     "baseline debt must stay at zero", rep["summary"])
 bl = json.load(open("fedml_tpu/analysis/fedlint_baseline.json"))
 assert bl["findings"] == [], "fedlint_baseline.json must stay empty"
-print("fedlint gate: 0 findings, baseline empty")
+sarif = json.load(open("bench_results/fedlint_report.sarif"))
+assert sarif["version"] == "2.1.0" and sarif["runs"][0]["results"] == []
+print("fedlint gate: 0 findings, baseline empty, sarif written")
 EOF
 echo "-- fedlint --fix idempotence (clean tree => empty diff) --"
-python -m fedml_tpu.analysis fedml_tpu/ --fix --diff
+python -m fedml_tpu.analysis $LINT_SCOPE --fix --diff
 
 echo "== fast test tier (engine / core / utils / native / data-extra / online;"
 echo "   includes the federated==centralized + wave/lane==flat equivalence asserts) =="
@@ -67,15 +74,19 @@ print("CI CLI smoke + runtime audit: OK", report)
 EOF
 
 echo "== chaos smoke (fedml_tpu.resilience): 3-round TCP FedAvg with one"
-echo "   injected client kill and one stall past the deadline -- must"
-echo "   complete DEGRADED (no hang; bounded by timeout), and the final"
+echo "   injected client kill and one stall past the deadline, run under"
+echo "   the --race-audit sanitizer (instrumented control-plane locks) --"
+echo "   must complete DEGRADED (no hang; bounded by timeout), the final"
 echo "   model must equal the reporting-subset weighted average exactly"
-echo "   (A/B vs a no-fault run over the same subsets). fedlint must stay"
-echo "   at zero findings on the resilience package =="
+echo "   (A/B vs a no-fault run over the same subsets), and the race"
+echo "   audit must report ZERO lock-order cycles and ZERO"
+echo "   held-while-blocking events. fedlint must stay at zero findings"
+echo "   on the resilience package =="
 python -m fedml_tpu.analysis fedml_tpu/resilience/ > /dev/null \
     && echo "fedlint on fedml_tpu/resilience/: 0 findings"
 timeout -k 10 180 python - <<'EOF'
 import numpy as np
+from fedml_tpu.analysis.runtime import race_audit
 from fedml_tpu.resilience import (FaultPlan, FaultRule, RoundPolicy,
                                   run_tcp_fedavg)
 
@@ -86,11 +97,16 @@ plan = FaultPlan(seed=7, rules=(
     FaultRule("kill", rank=3, msg_type="res_report", nth=2),
     FaultRule("stall", rank=2, msg_type="res_report", nth=1, delay_s=4.0),
 ))
-srv = run_tcp_fedavg(4, 3, RoundPolicy(deadline_s=1.0, quorum=0.3), w0,
-                     fault_plan=plan, join_timeout=90)
+with race_audit() as ra:
+    srv = run_tcp_fedavg(4, 3, RoundPolicy(deadline_s=1.0, quorum=0.3), w0,
+                         fault_plan=plan, join_timeout=90)
 assert srv.failed is None and len(srv.history) == 3, (
     srv.failed, len(srv.history))
 assert srv.counters["rounds_degraded"] >= 1, srv.counters
+race = ra.report()
+assert race["race/locks_created"] > 0, race  # the factories were live
+assert race["race/lock_order_cycles"] == [], race
+assert race["race/held_while_blocking"] == [], race
 subsets = srv.reporting_log
 ref = run_tcp_fedavg(4, 3, RoundPolicy(deadline_s=10.0, quorum=0.3), w0,
                      cohort_override=lambda r, a: subsets[r],
@@ -98,8 +114,10 @@ ref = run_tcp_fedavg(4, 3, RoundPolicy(deadline_s=10.0, quorum=0.3), w0,
 for got, want in zip(srv.history, ref.history):
     for k in got:
         assert (got[k] == want[k]).all(), k
-print("chaos smoke: degraded completion + exact subset average OK",
-      {"reporting": subsets, **srv.counters})
+print("chaos smoke: degraded completion + exact subset average + clean "
+      "race audit OK",
+      {"reporting": subsets,
+       "race_acquisitions": race["race/acquisitions"], **srv.counters})
 EOF
 
 echo "ci.sh: all green"

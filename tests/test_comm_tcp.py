@@ -279,3 +279,93 @@ def test_client_initiated_stop_no_spurious_peer_lost():
     for rank, crec in client_recs.items():
         ctypes_ = [m[0] for m in crec.messages]
         assert MSG_TYPE_PEER_LOST not in ctypes_, (rank, ctypes_)
+
+
+class TestConcurrencyFixes:
+    """Regression tests for the fedcheck (FL123/FL125) findings fixed in
+    this transport: exact wire counters under concurrent counting, and
+    the state-lock / send-lock split on the client pipe."""
+
+    def _skeleton(self, metrics=None):
+        # counter surface only (no sockets), mirroring the manager's
+        # real attribute setup
+        m = TcpCommManager.__new__(TcpCommManager)
+        m.bytes_sent = 0
+        m.bytes_received = 0
+        m.resends = 0
+        m._ctr_lock = threading.Lock()
+        m._metrics = metrics
+        return m
+
+    def test_wire_counters_exact_under_concurrent_counting(self):
+        # pre-fix: unguarded `+=` from several serve threads loses
+        # updates; the counters must be exact, they feed the
+        # compression-ratio accounting. The MetricsLogger downstream of
+        # _count_out shares the hazard one call deeper (count_wire's
+        # `+=`), so its totals must be exact too.
+        from fedml_tpu.utils.metrics import MetricsLogger
+        logger = MetricsLogger()
+        m = self._skeleton(metrics=logger)
+        n_threads, per_thread = 8, 2000
+
+        def hammer():
+            for _ in range(per_thread):
+                m._count_out(3, is_resend=True)
+                m._count_in(5)
+
+        threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total = n_threads * per_thread
+        assert m.bytes_sent == 3 * total
+        assert m.bytes_received == 5 * total
+        assert m.resends == total
+        assert logger._wire_bytes == 3 * total      # count_wire exact
+        assert logger._wire_raw_bytes == 0          # all resends
+
+    def test_client_pipe_write_does_not_hold_state_lock(self):
+        # pre-fix the client serialized pipe writes under self._lock (the
+        # membership/peer-lost state lock): a wedged sendall would block
+        # _notify_peer_lost forever. The pipe now has a dedicated
+        # io_lock; holding it (= a wedged write) must not stop peer-lost
+        # dispatch.
+        from fedml_tpu.core.comm.tcp import MSG_TYPE_PEER_LOST
+        port = _free_port()
+        world = 2
+        rec = Recorder()
+        client_box = {}
+        ready = threading.Event()
+
+        def client():
+            m = TcpCommManager("localhost", port, 1, world, timeout=30.0)
+            m.add_observer(rec)
+            client_box["m"] = m
+            ready.set()
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        server = TcpCommManager("localhost", port, 0, world, timeout=30.0)
+        assert ready.wait(20)
+        m = client_box["m"]
+        assert m._send_lock is not m._lock  # the split exists
+        acquired = m._send_lock.acquire(timeout=5)
+        assert acquired  # simulate a wedged in-flight pipe write
+        try:
+            done = threading.Event()
+
+            def notify():
+                m._notify_peer_lost(0)
+                done.set()
+
+            nt = threading.Thread(target=notify, daemon=True)
+            nt.start()
+            # peer-lost dispatch needs only the state lock: must complete
+            # while the send lock stays held
+            assert done.wait(5), "_notify_peer_lost blocked on a pipe write"
+        finally:
+            m._send_lock.release()
+        assert [mm[0] for mm in rec.messages] == [MSG_TYPE_PEER_LOST]
+        m.stop_receive_message()
+        server.close()

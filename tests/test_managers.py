@@ -1,0 +1,111 @@
+"""core/managers.py fail-fast paths: the runtime behaviors the fedcheck
+protocol pass (FL120-FL122) statically verifies against.
+
+- an unhandled message type is logged-and-dropped (the FL120 failure
+  mode's receiving half);
+- MSG_TYPE_PEER_LOST with no registered handler stops the receive loop
+  and ``run()`` raises (what FL121 makes FSMs decide explicitly);
+- re-registering a type overwrites the previous handler (last wins).
+"""
+
+import logging
+
+import pytest
+
+from fedml_tpu.core.comm.base import MSG_TYPE_PEER_LOST
+from fedml_tpu.core.comm.local import LocalCommNetwork
+from fedml_tpu.core.managers import ClientManager, DistributedManager
+from fedml_tpu.core.message import Message
+
+
+class _Fsm(ClientManager):
+    """Concrete FSM with a configurable handler table."""
+
+    def __init__(self, comm, handlers=None, rank=0, size=2):
+        super().__init__(None, comm, rank=rank, size=size)
+        self._handlers = handlers or {}
+
+    def register_message_receive_handlers(self):
+        for msg_type, fn in self._handlers.items():
+            self.register_message_receive_handler(msg_type, fn)
+
+
+def _manager(handlers=None, world=2, rank=0):
+    net = LocalCommNetwork(world)
+    return _Fsm(net.manager(rank), handlers=handlers, rank=rank,
+                size=world), net
+
+
+class TestNoHandlerPath:
+    def test_unhandled_type_warns_and_drops(self, caplog):
+        mgr, _net = _manager()
+        msg = Message("mystery", 1, 0)
+        with caplog.at_level(logging.WARNING):
+            mgr.receive_message("mystery", msg)  # must not raise
+        assert any("no handler" in r.getMessage()
+                   and "mystery" in r.getMessage()
+                   for r in caplog.records)
+
+    def test_unhandled_type_does_not_stop_the_loop(self):
+        seen = []
+        mgr, net = _manager({"known": lambda m: (seen.append(m.get_type()),
+                                                 mgr.finish())})
+        net.mailboxes[0].put(Message("mystery", 1, 0))
+        net.mailboxes[0].put(Message("known", 1, 0))
+        mgr.run()  # drains both; the unknown one is dropped, not fatal
+        assert seen == ["known"]
+
+
+class TestPeerLostFailFast:
+    def test_run_raises_without_peer_lost_handler(self):
+        mgr, net = _manager({"known": lambda m: None})
+        net.mailboxes[0].put(Message(MSG_TYPE_PEER_LOST, 1, 0))
+        with pytest.raises(RuntimeError, match="peer rank 1 died"):
+            mgr.run()
+
+    def test_fail_fast_reports_the_lost_rank(self):
+        mgr, net = _manager(world=4)
+        net.mailboxes[0].put(Message(MSG_TYPE_PEER_LOST, 3, 0))
+        with pytest.raises(RuntimeError, match="peer rank 3"):
+            mgr.run()
+        assert mgr._lost_peer == 3
+
+    def test_registered_peer_lost_handler_preempts_fail_fast(self):
+        lost = []
+
+        def on_lost(m):
+            lost.append(m.get_sender_id())
+            mgr.finish()
+
+        mgr, net = _manager({MSG_TYPE_PEER_LOST: on_lost})
+        net.mailboxes[0].put(Message(MSG_TYPE_PEER_LOST, 1, 0))
+        mgr.run()  # no raise: the handler owns the policy
+        assert lost == [1]
+
+    def test_receive_message_defers_the_raise_to_run(self):
+        # the transport's serve thread calls receive_message; raising
+        # THERE would die inside the transport -- the raise must come
+        # from run() after the loop unwinds
+        mgr, _net = _manager()
+        mgr.receive_message(MSG_TYPE_PEER_LOST,
+                            Message(MSG_TYPE_PEER_LOST, 1, 0))
+        assert mgr._lost_peer == 1  # recorded, not raised
+
+
+class TestRegistrationSemantics:
+    def test_double_registration_last_wins(self):
+        calls = []
+        mgr, _net = _manager()
+        mgr.register_message_receive_handler("t", lambda m: calls.append(1))
+        mgr.register_message_receive_handler("t", lambda m: calls.append(2))
+        mgr.receive_message("t", Message("t", 1, 0))
+        assert calls == [2]
+
+    def test_handler_keys_are_stringified(self):
+        # registration coerces types to str: registering 7 and receiving
+        # "7" (a JSON round-trip) must still dispatch
+        calls = []
+        mgr, _net = _manager()
+        mgr.register_message_receive_handler(7, lambda m: calls.append(7))
+        mgr.receive_message("7", Message(7, 1, 0))
+        assert calls == [7]

@@ -4,6 +4,7 @@ reporting subset (never NaN/zero-biased); below-quorum rounds abandon and
 re-run; retry/backoff gives up after the cap and raises MSG_TYPE_PEER_LOST;
 a killed-and-restarted server resumes bitwise (docs/RESILIENCE.md)."""
 
+import threading
 import time
 import types
 
@@ -248,6 +249,30 @@ class TestRoundController:
         assert not ctl.report(5, 0, 1, 1, "old-attempt")
         assert ctl.counters["late_reports"] == 2
 
+    def test_decision_carries_its_own_generation(self, caplog):
+        # regression (fedcheck FL123): _fire runs OUTSIDE the lock, so
+        # another thread can open the NEXT attempt between the decision
+        # and the log line -- the decision tuple must carry its own
+        # (round, attempt, target), never re-read controller state.
+        # Deterministic interleaving: decide round 5, open round 6, THEN
+        # fire the round-5 decision.
+        from fedml_tpu.resilience.policy import ROUND_COMPLETE
+        ctl, done = self._controller(RoundPolicy(deadline_s=0.0))
+        ctl.begin(5, 2, [1], target=1)
+        with ctl._lock:
+            ctl._reports[1] = (1.0, "p")
+            decision = ctl._decide_locked(ROUND_COMPLETE)
+        ctl.begin(6, 0, [1, 2], target=2)  # the racing next attempt
+        import logging as _logging
+        with caplog.at_level(_logging.INFO):
+            ctl._fire(decision)
+        fired = [r.getMessage() for r in caplog.records
+                 if "complete" in r.getMessage()]
+        assert fired, caplog.records
+        # pre-fix this read self._round and logged "round 6 attempt 0"
+        assert "round 5 attempt 2" in fired[0]
+        assert done == [(ROUND_COMPLETE, {1: (1.0, "p")})]
+
 
 class TestAggregateReports:
     def test_renormalizes_over_reporting_subset(self):
@@ -396,6 +421,26 @@ class TestTcpChaos:
             for k in got:
                 np.testing.assert_array_equal(got[k], want[k])
 
+    def test_chaos_run_clean_under_race_audit(self):
+        # the runtime concurrency sanitizer armed over a faulted TCP run:
+        # instrumented control-plane locks must observe no lock-order
+        # cycle and no state lock held across a blocking frame write.
+        # (This caught a real one: finish() used to run the transport's
+        # STOP wave while holding the server's round-turnover lock.)
+        from fedml_tpu.analysis import race_audit
+        plan = FaultPlan(seed=11, rules=(
+            FaultRule("kill", rank=2, msg_type="res_report", nth=2),))
+        with race_audit() as ra:
+            srv = run_tcp_fedavg(
+                3, 2, RoundPolicy(deadline_s=2.0, quorum=0.4), W0,
+                fault_plan=plan, join_timeout=60)
+        assert srv.failed is None and len(srv.history) == 2
+        rep = ra.report()
+        assert rep["race/locks_created"] > 0        # factories were live
+        assert rep["race/acquisitions"] > 0
+        assert rep["race/lock_order_cycles"] == []
+        assert rep["race/held_while_blocking"] == []
+
     def test_no_fault_run_is_clean(self):
         srv = run_tcp_fedavg(3, 2, RoundPolicy(deadline_s=5.0, quorum=0.5),
                              W0, join_timeout=45)
@@ -436,6 +481,7 @@ class TestTcpChaos:
         tcp = TcpCommManager.__new__(TcpCommManager)
         tcp.bytes_sent = 0
         tcp.resends = 0
+        tcp._ctr_lock = threading.Lock()  # counters are lock-guarded now
         tcp._metrics = logger
         tcp._count_out(100)
         tcp._count_out(100, is_resend=True)
