@@ -195,27 +195,40 @@ def measure(args, epochs, client_chunk, wave_mode):
     rounds = 1 if args.smoke else args.rounds
     times, metrics, samples = [], None, []
     err = None
+    from fedml_tpu.observability.tracing import Tracer, set_tracer
     from fedml_tpu.utils.profiling import profile_trace
-    with profile_trace(args.profile_dir,
-                       enabled=args.profile_dir is not None):
-        for _ in range(rounds):
-            try:
-                t0 = time.time()
-                metrics = api.train_one_round()
-                times.append(time.time() - t0)
-                samples.append(float(np.asarray(
-                    api._last_metrics["count"]).sum()))
-            except Exception:
-                err = traceback.format_exc(limit=3)
-                break
+    # fedtrace spans over the MEASURED rounds only (warmup excluded):
+    # per-phase attribution for the perf trajectory -- which of
+    # cohort-select / broadcast / local-train (dispatch) / aggregate
+    # (device wait) / report moves when a round gets faster
+    tracer = Tracer()
+    prev_tracer = set_tracer(tracer)
+    try:
+        with profile_trace(args.profile_dir,
+                           enabled=args.profile_dir is not None):
+            for _ in range(rounds):
+                try:
+                    t0 = time.time()
+                    metrics = api.train_one_round()
+                    times.append(time.time() - t0)
+                    samples.append(float(np.asarray(
+                        api._last_metrics["count"]).sum()))
+                except Exception:
+                    err = traceback.format_exc(limit=3)
+                    break
+    finally:
+        set_tracer(prev_tracer)
     if not times:
         raise RuntimeError(err or "no measured rounds")
+    phase_s = {name: round(float(np.median(durs)), 4)
+               for name, durs in sorted(tracer.durations_by_name().items())}
     return {
         "round_s": float(np.median(times)),
         "times": times,
         "compile_s": compile_s,
         "samples_per_round": float(np.mean(samples)),
         "train_acc": float(metrics["Train/Acc"]),
+        "phase_s": phase_s,
         "partial_error": err,
     }
 
@@ -483,6 +496,11 @@ def main():
         "mfu": round(achieved / peak, 4),
         "assumed_peak_tflops": peak / 1e12,
         "device": str(device),
+        # median seconds per span name over the measured rounds
+        # (fedml_tpu.observability fedtrace); "aggregate" is the
+        # end-of-round device wait -- the honest compute attribution,
+        # since dispatch is async
+        "phase_timings_s": meas["phase_s"],
     }
     # report ANY deviation from the requested first rung (including a
     # chunk-only degrade, which keeps the workload flagship-comparable but

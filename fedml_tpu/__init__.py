@@ -15,6 +15,8 @@ Layers (mirroring reference layers, see SURVEY.md section 1):
   - ``fedml_tpu.algorithms`` -- L3: FL algorithms on the common round engine.
   - ``fedml_tpu.parallel``   -- mesh construction + the SPMD round engine.
   - ``fedml_tpu.experiments``-- L4: argparse-compatible entry points.
+  - ``fedml_tpu.observability`` -- fedtrace: round tracing, metrics
+                                 registry, control-plane flight recorder.
 """
 
 __version__ = "0.1.0"

@@ -16,6 +16,7 @@ import jax
 import numpy as np
 
 from fedml_tpu.core.trainer import TrainSpec
+from fedml_tpu.observability.tracing import get_tracer
 from fedml_tpu.utils.profiling import end_of_round_sync
 from fedml_tpu.parallel.engine import (
     ClientUpdateConfig, LaneRunner, ShardedLaneRunner, WaveRunner,
@@ -241,9 +242,12 @@ class FedAvgAPI:
         subset (``fedml_tpu.resilience.SimResilience.sample``)."""
         if self.resilience is None:
             self._last_res_record = None
-            return client_sampling(round_idx,
-                                   len(self.train_data_local_dict),
-                                   self.args.client_num_per_round)
+            with get_tracer().span("cohort-select", round=int(round_idx)):
+                return client_sampling(round_idx,
+                                       len(self.train_data_local_dict),
+                                       self.args.client_num_per_round)
+        # SimResilience.sample opens its own cohort-select span (carrying
+        # the per-attempt selected/reporting attrs)
         client_indexes, record = self.resilience.sample(
             round_idx, len(self.train_data_local_dict),
             self.args.client_num_per_round)
@@ -257,17 +261,32 @@ class FedAvgAPI:
         if all(len(d["y"]) == 0 for d in datasets):
             raise ValueError(
                 f"round {round_idx}: every sampled client has an empty shard")
-        packed = pack_cohort(datasets, self.args.batch_size, self.args.epochs,
-                             rng=self._data_rng)
-        if self.mesh is not None:
-            # multi-host: every process packed the identical cohort (same
-            # seeded RNG stream); each contributes its local shards
-            from fedml_tpu.parallel.multihost import global_cohort
-            packed = global_cohort(self.mesh, packed)
+        # "broadcast" in the sim: packing + placing the cohort's data is
+        # the host->device half of what a distributed round sends out
+        with get_tracer().span("broadcast", clients=len(client_indexes)):
+            packed = pack_cohort(datasets, self.args.batch_size,
+                                 self.args.epochs, rng=self._data_rng)
+            if self.mesh is not None:
+                # multi-host: every process packed the identical cohort
+                # (same seeded RNG stream); each contributes local shards
+                from fedml_tpu.parallel.multihost import global_cohort
+                packed = global_cohort(self.mesh, packed)
         return client_indexes, packed
 
     def train_one_round(self):
+        # span model (docs/OBSERVABILITY.md): the jitted round fn is
+        # dispatched asynchronously, so "local-train" measures dispatch
+        # (plus any inline host compute) and the device time lands in
+        # "aggregate" -- the end-of-round sync is where the host actually
+        # waits for the round's outputs (exactly the FL114 lesson)
+        tracer = get_tracer()
         t0 = time.time()
+        with tracer.span("round", round=int(self.round_idx)):
+            train_metrics = self._traced_round_body(tracer, t0)
+        self.round_idx += 1
+        return train_metrics
+
+    def _traced_round_body(self, tracer, t0):
         self.rng, round_rng = jax.random.split(self.rng)
         if self.device_data is not None:
             import jax.numpy as jnp
@@ -277,56 +296,69 @@ class FedAvgAPI:
             if sum(ns) == 0:
                 raise ValueError(f"round {self.round_idx}: every sampled "
                                  f"client has an empty shard")
-            sched = pack_schedule(ns, self.args.batch_size, self.args.epochs,
-                                  rng=self._data_rng)
+            with tracer.span("broadcast", clients=len(client_indexes)):
+                sched = pack_schedule(ns, self.args.batch_size,
+                                      self.args.epochs, rng=self._data_rng)
             mode = int(getattr(self.args, "wave_mode", 1))
             if self.sharded_lane_runner is not None:
-                (self.global_state, self.server_state,
-                 info) = self.sharded_lane_runner.run_round(
-                    self.global_state, self.server_state, self.device_data,
-                    client_indexes, sched, round_rng)
+                with tracer.span("local-train", mode="sharded-lanes"):
+                    (self.global_state, self.server_state,
+                     info) = self.sharded_lane_runner.run_round(
+                        self.global_state, self.server_state,
+                        self.device_data, client_indexes, sched, round_rng)
             elif mode in (2, 3):
                 runner = (self.packed_lane_runner
                           if mode == 3 and self.packed_lane_runner is not None
                           else self.lane_runner)
-                (self.global_state, self.server_state,
-                 info) = runner.run_round(
-                    self.global_state, self.server_state, self.device_data,
-                    client_indexes, sched, round_rng)
+                with tracer.span("local-train",
+                                 mode="mxu-lanes" if runner is
+                                 self.packed_lane_runner else "lanes"):
+                    (self.global_state, self.server_state,
+                     info) = runner.run_round(
+                        self.global_state, self.server_state,
+                        self.device_data, client_indexes, sched, round_rng)
             elif mode == 1:
-                (self.global_state, self.server_state,
-                 info) = self.wave_runner.run_round(
-                    self.global_state, self.server_state, self.device_data,
-                    client_indexes, sched, round_rng)
+                with tracer.span("local-train", mode="waves"):
+                    (self.global_state, self.server_state,
+                     info) = self.wave_runner.run_round(
+                        self.global_state, self.server_state,
+                        self.device_data, client_indexes, sched, round_rng)
             else:
-                sel = jnp.asarray(np.asarray(client_indexes, np.int32))
-                dd = {"x": self.device_data["x"][sel],
-                      "y": self.device_data["y"][sel]}
-                sched = {k: jnp.asarray(v) for k, v in sched.items()}
-                (self.global_state, self.server_state,
-                 info) = self.indexed_round_fn(
-                    self.global_state, self.server_state, dd, sched, round_rng)
+                with tracer.span("local-train", mode="flat"):
+                    sel = jnp.asarray(np.asarray(client_indexes, np.int32))
+                    dd = {"x": self.device_data["x"][sel],
+                          "y": self.device_data["y"][sel]}
+                    sched = {k: jnp.asarray(v) for k, v in sched.items()}
+                    (self.global_state, self.server_state,
+                     info) = self.indexed_round_fn(
+                        self.global_state, self.server_state, dd, sched,
+                        round_rng)
         elif self.compressed_round_fn is not None:
             import jax.numpy as jnp
             client_indexes, packed = self._cohort(self.round_idx)
-            sel = jnp.asarray(np.asarray(client_indexes, np.int32))
-            cohort_res = jax.tree.map(lambda x: x[sel], self._ef_residuals)
-            (self.global_state, self.server_state, new_res,
-             info) = self.compressed_round_fn(
-                self.global_state, self.server_state, packed, cohort_res,
-                round_rng)
-            self._ef_residuals = jax.tree.map(
-                lambda full, upd: full.at[sel].set(upd),
-                self._ef_residuals, new_res)
+            with tracer.span("local-train", mode="compressed"):
+                sel = jnp.asarray(np.asarray(client_indexes, np.int32))
+                cohort_res = jax.tree.map(lambda x: x[sel],
+                                          self._ef_residuals)
+                (self.global_state, self.server_state, new_res,
+                 info) = self.compressed_round_fn(
+                    self.global_state, self.server_state, packed, cohort_res,
+                    round_rng)
+                self._ef_residuals = jax.tree.map(
+                    lambda full, upd: full.at[sel].set(upd),
+                    self._ef_residuals, new_res)
             self._last_cohort_size = len(client_indexes)
         else:
             _, packed = self._cohort(self.round_idx)
-            self.global_state, self.server_state, info = self.round_fn(
-                self.global_state, self.server_state, packed, round_rng)
-        end_of_round_sync(self.global_state)
+            with tracer.span("local-train", mode="packed"):
+                self.global_state, self.server_state, info = self.round_fn(
+                    self.global_state, self.server_state, packed, round_rng)
+        with tracer.span("aggregate"):
+            end_of_round_sync(self.global_state)
         dt = time.time() - t0
-        from fedml_tpu.parallel.multihost import gather_metrics
-        m = gather_metrics(info["metrics"])
+        with tracer.span("report"):
+            from fedml_tpu.parallel.multihost import gather_metrics
+            m = gather_metrics(info["metrics"])
         self._last_metrics = m  # full summed-metrics pytree for subclasses
         train_metrics = {
             "round": self.round_idx,
@@ -347,7 +379,7 @@ class FedAvgAPI:
             # count_wire is the transports' path and would double-report
             train_metrics["bytes_on_wire"] = wire
             train_metrics["compression_ratio"] = round(raw / wire, 3)
-        self.round_idx += 1
+        # round_idx advances in train_one_round (after the round span ends)
         return train_metrics
 
     def _packed_global_eval(self):
@@ -414,9 +446,14 @@ class FedAvgAPI:
             if self.round_idx % freq == 0 or last:
                 # eval runs between round syncs: book its (first-time)
                 # compile as off-round so the auditor never charges it to
-                # the next round's retrace bucket
-                with off_round_work():
-                    metrics.update(self.evaluate_global())
+                # the next round's retrace bucket. The span carries the
+                # TRAINED round (round_idx already advanced) so it joins
+                # the same round as the metrics record it lands in.
+                with get_tracer().span(
+                        "eval", round=int(metrics.get("round",
+                                                      self.round_idx - 1))):
+                    with off_round_work():
+                        metrics.update(self.evaluate_global())
             self.metrics_logger(metrics)
             self.history.append(metrics)
             if on_round is not None:

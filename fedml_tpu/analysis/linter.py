@@ -115,6 +115,16 @@ RULES = {
         "linter cannot bound into the jaxpr -- checkpoint-sized data "
         "silently becomes a per-executable constant. Pass it as an "
         "argument (FL112's reasoning, without the size escape hatch)."),
+    "FL114": (
+        "wall-clock timing around jitted work without a device sync",
+        "jax dispatch is asynchronous: a `time.time()`/`perf_counter` "
+        "delta measured around a jitted call returns when the work is "
+        "*enqueued*, not done -- the timing can be 10-1000x too small "
+        "and silently lies in benchmarks and metrics. Call "
+        "`jax.block_until_ready(...)` (or the round loops' "
+        "`end_of_round_sync`) inside the measured region; value fetches "
+        "(`float(...)`, `.item()`, `np.asarray`) also count -- reading "
+        "a value blocks on the work producing it."),
     "FL120": (
         "message type sent but unhandled by any counterpart FSM",
         "a `Message(TYPE, ...)` flowing into send_message/send_with_retry "
@@ -159,6 +169,33 @@ RULES = {
 #: FL112 only flags captures whose *static* element count is at least
 #: this (64 KiB of f32): closing over small constant tables is idiomatic.
 FL112_MIN_ELEMENTS = 16384
+
+#: FL114: clock sources whose deltas measure wall time, and the sync
+#: calls whose presence in the measured region makes such deltas honest.
+#: Value fetches (float()/.item()/np.asarray/device_get/tolist) count:
+#: reading a value blocks on the work producing it, so the idiom
+#: ``float(jitted(x))`` inside the region is a real synchronization.
+_WALLCLOCK_ATTRS = ("time", "perf_counter", "monotonic")
+_SYNC_CALL_NAMES = ("block_until_ready", "end_of_round_sync",
+                    "sync_and_mark_round", "item", "asarray", "array",
+                    "device_get", "tolist")
+_SYNC_BUILTIN_NAMES = ("float", "int")
+
+
+def _time_aliases(tree):
+    """Local names bound to the ``time`` module and to its from-imported
+    clock functions (``from time import perf_counter`` style)."""
+    mods, funcs = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    mods.add(a.asname or "time")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name in _WALLCLOCK_ATTRS:
+                    funcs.add(a.asname or a.name)
+    return mods, funcs
 
 #: FL107 only applies to transport/codec paths (broad handlers elsewhere
 #: are a judgement call; on the wire they corrupt rounds silently).
@@ -546,6 +583,7 @@ class _ModuleLinter:
             self._check_jit_captures(site, parents)
             jitted_spans.append(site.func)
         self._check_module_wide(jitted_spans)
+        self._check_wallclock_timing(sites)
         return self.findings
 
     # FL101 / FL102 / FL105: body of a traced function
@@ -967,6 +1005,111 @@ class _ModuleLinter:
         root, attr = _call_root_name(node.func)
         return root in _LOG_CALL_NAMES or attr in (
             "warning", "error", "exception", "info", "debug", "warn")
+
+    # FL114: wall-clock deltas around jitted calls without a sync
+    def _check_wallclock_timing(self, sites):
+        """Linear scan per statement suite: ``t0 = time.time()`` opens a
+        measured region; a later ``time.time() - t0`` (same suite) closes
+        it. If the region calls a module-known jitted callable and never
+        blocks (``jax.block_until_ready`` / ``x.block_until_ready()`` /
+        ``end_of_round_sync``), the delta measures async dispatch, not
+        device work. Start and delta in different suites are conservatively
+        skipped (static reach ends at the suite boundary)."""
+        jit_names = set()
+        for s in sites:
+            if isinstance(s.func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                jit_names.add(s.func.name)
+        for node in ast.walk(self.tree):
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and _jit_call_info(node.value, self.aliases) is not None):
+                for t in node.targets:  # f = jax.jit(...) / self.f = ...
+                    if isinstance(t, ast.Name):
+                        jit_names.add(t.id)
+                    elif isinstance(t, ast.Attribute):
+                        jit_names.add(t.attr)
+        if not jit_names:
+            return
+        tmods, tfuncs = _time_aliases(self.tree)
+
+        def is_time_call(n):
+            if not isinstance(n, ast.Call) or n.args:
+                return False
+            f = n.func
+            if isinstance(f, ast.Attribute):
+                return (f.attr in _WALLCLOCK_ATTRS
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id in tmods)
+            return isinstance(f, ast.Name) and f.id in tfuncs
+
+        def region_calls(stmts, names):
+            for stmt in stmts:
+                for n in ast.walk(stmt):
+                    if isinstance(n, ast.Call):
+                        f = n.func
+                        if isinstance(f, ast.Name) and f.id in names:
+                            return True
+                        if isinstance(f, ast.Attribute) and f.attr in names:
+                            return True
+            return False
+
+        def region_syncs(stmts):
+            if region_calls(stmts, _SYNC_CALL_NAMES):
+                return True
+            for stmt in stmts:
+                for n in ast.walk(stmt):
+                    # float(x)/int(x) on a non-literal: a value fetch that
+                    # blocks on the producing computation
+                    if (isinstance(n, ast.Call)
+                            and isinstance(n.func, ast.Name)
+                            and n.func.id in _SYNC_BUILTIN_NAMES
+                            and n.args
+                            and not isinstance(n.args[0], ast.Constant)):
+                        return True
+            return False
+
+        def shallow_exprs(stmt):
+            # the statement's own expressions only: nested suites get
+            # their own scan (and their own start vars -- an inner
+            # reassignment must not match an outer start)
+            todo = [stmt]
+            while todo:
+                n = todo.pop()
+                for c in ast.iter_child_nodes(n):
+                    if isinstance(c, ast.stmt):
+                        continue
+                    todo.append(c)
+                    yield c
+
+        for node in ast.walk(self.tree):
+            for fld in ("body", "orelse", "finalbody"):
+                suite = getattr(node, fld, None)
+                if (not isinstance(suite, list) or not suite
+                        or not isinstance(suite[0], ast.stmt)):
+                    continue
+                starts = {}
+                for i, stmt in enumerate(suite):
+                    if (isinstance(stmt, ast.Assign)
+                            and len(stmt.targets) == 1
+                            and isinstance(stmt.targets[0], ast.Name)
+                            and is_time_call(stmt.value)):
+                        starts[stmt.targets[0].id] = i
+                        continue
+                    for sub in shallow_exprs(stmt):
+                        if (isinstance(sub, ast.BinOp)
+                                and isinstance(sub.op, ast.Sub)
+                                and is_time_call(sub.left)
+                                and isinstance(sub.right, ast.Name)
+                                and sub.right.id in starts):
+                            region = suite[starts[sub.right.id] + 1:i + 1]
+                            if (region_calls(region, jit_names)
+                                    and not region_syncs(region)):
+                                self.add(sub, "FL114",
+                                         "wall-clock delta around jitted "
+                                         "call(s) with no block_until_"
+                                         "ready/end_of_round_sync in the "
+                                         "measured region -- async "
+                                         "dispatch makes this timing lie")
 
 
 # -- driver ---------------------------------------------------------------

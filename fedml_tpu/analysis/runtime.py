@@ -198,6 +198,7 @@ def audit(metrics_logger=None, enabled=True, transfer_guard="device_to_host"):
         logging.info("runtime audit: %s", report)
         if metrics_logger is not None:
             metrics_logger(report)
+        _report_to_registry(report)
 
 
 # -- race sanitizer -------------------------------------------------------
@@ -313,6 +314,11 @@ class RaceAuditor:
                      threading.current_thread().name)
             with self._mu:
                 self.held_while_blocking.append(event)
+            from fedml_tpu.observability.flightrec import get_flight_recorder
+            fr = get_flight_recorder()
+            if fr is not None:  # lock-audit events belong in the black box
+                fr.record("held_while_blocking", label=event[0],
+                          locks=list(event[1]), thread_name=event[2])
             logging.warning("race audit: %s while holding state lock(s) "
                             "%s on %s", *event)
 
@@ -372,6 +378,26 @@ def race_audit(enabled=True, metrics_logger=None):
         logging.info("race audit: %s", report)
         if metrics_logger is not None:
             metrics_logger(report)
+        _report_to_registry(report)
+
+
+def _report_to_registry(report):
+    """Mirror an auditor report's scalar totals into the unified metrics
+    registry (fedml_tpu.observability) when one is enabled, so audit
+    results land in metrics.prom next to the wire/round counters."""
+    from fedml_tpu.observability.registry import get_registry
+    reg = get_registry()
+    if reg is None:
+        return
+    for key, val in report.items():
+        if isinstance(val, bool) or not isinstance(val, (int, float)):
+            if isinstance(val, list):
+                reg.set_gauge("audit_events",
+                              len(val), help="auditor event-list lengths",
+                              event=key.split("/", 1)[-1])
+            continue
+        name = "audit_" + key.split("/", 1)[-1]
+        reg.set_gauge(name, val, help="runtime auditor total")
 
 
 def _unregister(callback):

@@ -64,9 +64,13 @@ def make_compressed_sim_round(spec, cfg, compressor: Compressor,
         rngs = jax.random.split(jax.random.fold_in(rng, 1), C)
         server_rng = jax.random.fold_in(rng, 2)
         crngs = jax.random.split(jax.random.fold_in(rng, 3), C)
-        local_states, aux, metrics = jax.vmap(
-            client_update, in_axes=(None, 0, 0))(global_state, cohort_data,
-                                                 rngs)
+        # named_scope: phase labels in the lowered HLO so jax.profiler
+        # traces (and fedtrace's profile_dir runs) segment the round's
+        # device time by lifecycle phase -- no host cost, bitwise inert
+        with jax.named_scope("local-train"):
+            local_states, aux, metrics = jax.vmap(
+                client_update, in_axes=(None, 0, 0))(global_state,
+                                                     cohort_data, rngs)
 
         ef = ErrorFeedback(compressor)
 
@@ -79,13 +83,16 @@ def make_compressed_sim_round(spec, cfg, compressor: Compressor,
             recon["params"] = pytree.tree_add(global_state["params"], dec)
             return recon, new_residual
 
-        recon_states, new_residuals = jax.vmap(compress_one)(
-            local_states, residuals, crngs)
-        payloads = jax.vmap(payload_fn, in_axes=(0, None, 0))(
-            recon_states, global_state, aux)
-        avg_payload = pytree.tree_weighted_mean(payloads, aux["n"])
-        new_global, new_server_state = server_fn(
-            global_state, avg_payload, server_state, server_rng)
+        with jax.named_scope("ef-compress"):
+            recon_states, new_residuals = jax.vmap(compress_one)(
+                local_states, residuals, crngs)
+        with jax.named_scope("aggregate"):
+            payloads = jax.vmap(payload_fn, in_axes=(0, None, 0))(
+                recon_states, global_state, aux)
+            avg_payload = pytree.tree_weighted_mean(payloads, aux["n"])
+        with jax.named_scope("server-update"):
+            new_global, new_server_state = server_fn(
+                global_state, avg_payload, server_state, server_rng)
         return (new_global, new_server_state, new_residuals,
                 {"aux": aux, "metrics": metrics})
 

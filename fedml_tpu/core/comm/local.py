@@ -20,6 +20,8 @@ import threading
 from fedml_tpu.core.comm.base import (BaseCommunicationManager,
                                       MSG_TYPE_PEER_LOST)
 from fedml_tpu.core.message import Message
+from fedml_tpu.observability.flightrec import get_flight_recorder
+from fedml_tpu.observability.registry import get_registry
 
 
 class LocalCommNetwork:
@@ -46,6 +48,10 @@ class LocalCommNetwork:
         rank's mailbox -- the in-process analog of the TCP transport's
         EOF-without-GOODBYE synthesis, used by ``LocalCommManager.abort``
         (crash simulation, ``fedml_tpu.resilience.faults``)."""
+        fr = get_flight_recorder()
+        if fr is not None:
+            fr.record("peer_lost", peer=rank, transport="local")
+            fr.dump("peer_lost", extra={"peer": rank})
         for other in range(self.world_size):
             if other == rank:
                 continue
@@ -77,12 +83,29 @@ class LocalCommManager(BaseCommunicationManager):
         receiver = msg.get_receiver_id()
         if is_resend:
             self.resends += 1
+        nbytes = 0
         if self.network.serialize:
             payload = msg.to_bytes()
-            self.bytes_sent += len(payload)
+            nbytes = len(payload)
+            self.bytes_sent += nbytes
             self.network.mailboxes[receiver].put(payload)
         else:
             self.network.mailboxes[receiver].put(msg)
+        fr = get_flight_recorder()
+        if fr is not None:
+            fr.record("send", type=msg.get_type(), src=self.rank,
+                      dst=receiver, bytes=nbytes, transport="local",
+                      resend=bool(is_resend))
+        reg = get_registry()
+        if reg is not None:
+            if nbytes:
+                reg.inc("comm_bytes_total", nbytes,
+                        help="control-plane payload bytes by direction",
+                        transport="local", direction="sent")
+            if is_resend:
+                reg.inc("comm_resends_total",
+                        help="frames re-sent by the retry layer",
+                        transport="local")
 
     def handle_receive_message(self):
         """Blocking receive loop dispatching to observers until stopped."""
@@ -92,9 +115,21 @@ class LocalCommManager(BaseCommunicationManager):
             msg = box.get()
             if msg is _STOP:
                 break
+            nbytes = 0
             if isinstance(msg, (bytes, bytearray)):
-                self.bytes_received += len(msg)
+                nbytes = len(msg)
+                self.bytes_received += nbytes
                 msg = Message.from_bytes(msg)
+            fr = get_flight_recorder()
+            if fr is not None:
+                fr.record("recv", type=msg.get_type(),
+                          src=msg.get_sender_id(), dst=self.rank,
+                          bytes=nbytes, transport="local")
+            reg = get_registry()
+            if reg is not None and nbytes:
+                reg.inc("comm_bytes_total", nbytes,
+                        help="control-plane payload bytes by direction",
+                        transport="local", direction="received")
             for obs in self._observers:
                 obs.receive_message(msg.get_type(), msg)
 

@@ -26,6 +26,8 @@ from __future__ import annotations
 
 from fedml_tpu.core.comm.base import BaseCommunicationManager
 from fedml_tpu.core.message import Message
+from fedml_tpu.observability.flightrec import get_flight_recorder
+from fedml_tpu.observability.registry import get_registry
 
 try:  # pragma: no cover - optional dependency
     import paho.mqtt.client as mqtt
@@ -78,6 +80,16 @@ class MqttCommManager(BaseCommunicationManager):
             payload = payload.encode("utf-8")
         self.bytes_received += len(payload)
         m = Message.from_bytes(payload)  # binary or legacy-JSON sniff
+        fr = get_flight_recorder()
+        if fr is not None:
+            fr.record("recv", type=m.get_type(), src=m.get_sender_id(),
+                      dst=self.client_id, bytes=len(payload),
+                      transport="mqtt")
+        reg = get_registry()
+        if reg is not None:
+            reg.inc("comm_bytes_total", len(payload),
+                    help="control-plane payload bytes by direction",
+                    transport="mqtt", direction="received")
         for obs in self._observers:
             obs.receive_message(m.get_type(), m)
 
@@ -91,6 +103,20 @@ class MqttCommManager(BaseCommunicationManager):
         self.bytes_sent += len(payload)
         if is_resend:
             self.resends += 1
+        fr = get_flight_recorder()
+        if fr is not None:
+            fr.record("send", type=msg.get_type(), src=self.client_id,
+                      dst=receiver, bytes=len(payload), transport="mqtt",
+                      resend=bool(is_resend))
+        reg = get_registry()
+        if reg is not None:
+            reg.inc("comm_bytes_total", len(payload),
+                    help="control-plane payload bytes by direction",
+                    transport="mqtt", direction="sent")
+            if is_resend:
+                reg.inc("comm_resends_total",
+                        help="frames re-sent by the retry layer",
+                        transport="mqtt")
         self._client.publish(topic, payload=payload)
 
     def add_observer(self, observer):

@@ -36,6 +36,8 @@ import struct
 import threading
 
 from fedml_tpu.core.locks import audited_lock, io_lock
+from fedml_tpu.observability.flightrec import get_flight_recorder
+from fedml_tpu.observability.registry import get_registry
 from fedml_tpu.compression.codec import message_from_wire
 from fedml_tpu.core.comm.base import (BaseCommunicationManager,
                                       MSG_TYPE_PEER_LOST)
@@ -202,10 +204,24 @@ class TcpCommManager(BaseCommunicationManager):
         if self._metrics is not None:
             self._metrics.count_wire(nbytes,
                                      raw_bytes=0 if is_resend else nbytes)
+        reg = get_registry()
+        if reg is not None:
+            reg.inc("comm_bytes_total", nbytes,
+                    help="control-plane payload bytes by direction",
+                    transport="tcp", direction="sent")
+            if is_resend:
+                reg.inc("comm_resends_total",
+                        help="frames re-sent by the retry layer",
+                        transport="tcp")
 
     def _count_in(self, nbytes):
         with self._ctr_lock:
             self.bytes_received += nbytes
+        reg = get_registry()
+        if reg is not None:
+            reg.inc("comm_bytes_total", nbytes,
+                    help="control-plane payload bytes by direction",
+                    transport="tcp", direction="received")
 
     def send_message(self, msg: Message, is_resend=False):
         receiver = int(msg.get_receiver_id())
@@ -216,6 +232,13 @@ class TcpCommManager(BaseCommunicationManager):
             return
         payload = msg.to_bytes() if self._binary else msg.to_json().encode()
         self._count_out(len(payload), is_resend=is_resend)
+        fr = get_flight_recorder()
+        if fr is not None:
+            # recorded BEFORE the write: a send that wedges (and triggers
+            # the dump) must already be in the ring
+            fr.record("send", type=msg.get_type(), src=self.rank,
+                      dst=receiver, bytes=len(payload), transport="tcp",
+                      resend=bool(is_resend))
         if self.rank == 0:
             with self._lock:
                 dest = self._peers.get(receiver)
@@ -291,6 +314,11 @@ class TcpCommManager(BaseCommunicationManager):
                         continue
                     self._count_in(len(frame))
                     msg = message_from_wire(frame)
+                    fr = get_flight_recorder()
+                    if fr is not None:
+                        fr.record("recv", type=msg.get_type(),
+                                  src=msg.get_sender_id(), dst=self.rank,
+                                  bytes=len(frame), transport="tcp")
                     if msg.get_type() == MSG_TYPE_PEER_LOST:
                         logging.warning("tcp client: dropping in-band "
                                         "reserved %s frame",
@@ -333,6 +361,10 @@ class TcpCommManager(BaseCommunicationManager):
                                   "%s", peer_rank)
                 self._drop_peer(peer_rank, lost=True)
                 return
+            fr = get_flight_recorder()
+            if fr is not None:
+                fr.record("recv", type=msg.get_type(), src=peer_rank,
+                          dst=self.rank, bytes=len(frame), transport="tcp")
             if msg.get_type() == MSG_TYPE_GOODBYE:
                 # clean hang-up: unroute WITHOUT a peer-lost dispatch
                 self._drop_peer(peer_rank, lost=False)
@@ -422,6 +454,14 @@ class TcpCommManager(BaseCommunicationManager):
             if peer_rank in self._lost_notified:
                 return
             self._lost_notified.add(peer_rank)
+        fr = get_flight_recorder()
+        if fr is not None:
+            # post-mortem artifact: the ring as of the moment of death
+            # (the per-peer dedup above bounds this to one dump per peer)
+            fr.record("peer_lost", peer=peer_rank, observer=self.rank,
+                      transport="tcp")
+            fr.dump("peer_lost", extra={"peer": peer_rank,
+                                        "observer": self.rank})
         lost = Message(MSG_TYPE_PEER_LOST, peer_rank, self.rank)
         for obs in list(self._observers):
             obs.receive_message(MSG_TYPE_PEER_LOST, lost)

@@ -12,6 +12,8 @@ import logging
 
 from fedml_tpu.core.comm.base import MSG_TYPE_PEER_LOST, Observer
 from fedml_tpu.core.message import Message
+from fedml_tpu.observability.flightrec import get_flight_recorder
+from fedml_tpu.observability.tracing import get_tracer
 
 
 class DistributedManager(Observer):
@@ -46,13 +48,34 @@ class DistributedManager(Observer):
                 # once handle_receive_message unwinds (an exception here
                 # would die inside the transport's serve thread instead)
                 self._lost_peer = msg_params.get_sender_id()
+                fr = get_flight_recorder()
+                if fr is not None:
+                    fr.record("fail_fast", rank=self.rank,
+                              lost_peer=self._lost_peer)
                 self.finish()
                 return
+            fr = get_flight_recorder()
+            if fr is not None:
+                fr.record("no_handler", rank=self.rank, type=str(msg_type))
             logging.warning("rank %d: no handler for message type %s", self.rank, msg_type)
             return
-        handler(msg_params)
+        # cross-rank span stitching (fedml_tpu.observability.tracing): a
+        # sender-injected trace context becomes this thread's current
+        # parent for the handler's own spans; no-op tracer extracts None
+        tracer = get_tracer()
+        ctx = tracer.extract(msg_params) if tracer.enabled else None
+        if ctx is not None:
+            with tracer.remote_context(ctx):
+                handler(msg_params)
+        else:
+            handler(msg_params)
 
     def send_message(self, message: Message):
+        tracer = get_tracer()
+        if tracer.enabled:
+            # carry the sender's current span context in the envelope's
+            # __trace__ control field (JSON header of the binary codec)
+            tracer.inject(message)
         self.com_manager.send_message(message)
 
     def register_message_receive_handlers(self) -> None:

@@ -119,6 +119,10 @@ def add_base_args(parser: argparse.ArgumentParser):
     # recovery half
     from fedml_tpu.resilience.integration import add_resilience_args
     add_resilience_args(p)
+    # observability knobs (fedml_tpu.observability): span tracing, trace
+    # export dir, control-plane flight recorder
+    from fedml_tpu.observability import add_observability_args
+    add_observability_args(p)
     # synthetic-dataset size overrides (CI / bench knobs; ignored by
     # file-backed loaders)
     p.add_argument("--n_train", type=int, default=None)
@@ -183,6 +187,29 @@ def audit_scope(args, logger, wired=True):
             "the flag")
         enabled = False
     return audit(metrics_logger=logger, enabled=enabled)
+
+
+def observability_scope(args, logger):
+    """``--trace/--flightrec`` context for the experiment mains: arms the
+    fedtrace switchboard (``fedml_tpu.observability.enable``) with the
+    run's metrics sink. Exports ``trace.json``/``spans.jsonl`` to
+    ``--trace_dir`` (default ``--run_dir``), flight-recorder dumps and
+    ``metrics.prom`` to ``--run_dir`` (else the trace dir); a run with
+    both flags off gets the no-op tracer and zero observability code on
+    the hot paths."""
+    from fedml_tpu.observability import enable
+
+    trace = bool(getattr(args, "trace", 0))
+    flightrec = bool(getattr(args, "flightrec", 0))
+    run_dir = getattr(args, "run_dir", None)
+    trace_dir = getattr(args, "trace_dir", None) or run_dir
+    if trace and trace_dir is None:
+        trace_dir = "."
+        logging.warning("--trace without --trace_dir/--run_dir: exporting "
+                        "trace.json/spans.jsonl to the working directory")
+    return enable(trace=trace, trace_dir=trace_dir,
+                  flightrec=flightrec, flightrec_dir=run_dir or trace_dir,
+                  metrics_logger=logger)
 
 
 def race_audit_scope(args, logger):
@@ -306,10 +333,12 @@ def run_fedavg_family(api, args, logger):
                           getattr(api_, "checkpoint_metric", "Test/Acc")),
                       data_rng=api_._data_rng)
 
-    with profile_trace(args.profile_dir, enabled=args.profile_dir is not None):
-        with race_audit_scope(args, logger):
-            with audit_scope(args, logger):
-                api.train(on_round=on_round)
+    with observability_scope(args, logger):
+        with profile_trace(args.profile_dir,
+                           enabled=args.profile_dir is not None):
+            with race_audit_scope(args, logger):
+                with audit_scope(args, logger):
+                    api.train(on_round=on_round)
     if ckpt is not None:
         ckpt.close()
     return api.global_state

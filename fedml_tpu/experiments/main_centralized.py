@@ -22,8 +22,9 @@ def main(argv=None):
 
     from fedml_tpu.algorithms.centralized import CentralizedTrainer
     trainer = CentralizedTrainer(dataset, spec, args, metrics_logger=logger)
-    with common.audit_scope(args, logger):
-        state = trainer.train()
+    with common.observability_scope(args, logger):
+        with common.audit_scope(args, logger):
+            state = trainer.train()
     logger.close()
     return trainer, state
 

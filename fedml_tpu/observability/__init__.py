@@ -1,0 +1,200 @@
+"""fedtrace: round tracing, a unified metrics registry, a flight recorder.
+
+The observability layer the scale-out arc reports against (see
+docs/OBSERVABILITY.md). Three composable pieces, one switchboard:
+
+- :mod:`~fedml_tpu.observability.tracing`: Dapper-style spans over the
+  round lifecycle, propagated across ranks in the message envelope's
+  ``__trace__`` control field; Chrome-trace + JSONL export.
+- :mod:`~fedml_tpu.observability.registry`: counters/gauges/histograms
+  with labels; per-round snapshots into ``metrics.jsonl`` records and a
+  Prometheus text dump at exit.
+- :mod:`~fedml_tpu.observability.flightrec`: a bounded ring of
+  control-plane events dumped to ``flightrec_<reason>.jsonl`` on
+  PEER_LOST, abandoned rounds, and unhandled crashes.
+- :mod:`~fedml_tpu.observability.jaxmon`: per-round compile count +
+  duration via ``jax.monitoring``.
+
+Everything defaults OFF: the module-level tracer is a no-op, the registry
+and recorder globals are None, and every instrumentation point in the
+engine/transports/FSMs guards on that -- a run without ``--trace`` /
+``--flightrec`` executes no observability code beyond one global read per
+event and produces bit-identical results. :func:`enable` flips the
+switchboard for a scope and writes the artifacts on exit.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import sys
+import threading
+
+from fedml_tpu.observability.flightrec import (FlightRecorder,
+                                               get_flight_recorder,
+                                               set_flight_recorder)
+from fedml_tpu.observability.registry import (MetricsRegistry, get_registry,
+                                              set_registry)
+from fedml_tpu.observability.tracing import (NOOP_TRACER, NoopTracer, Span,
+                                             SpanContext, TRACE_KEY, Tracer,
+                                             get_tracer, set_tracer)
+
+
+def add_observability_args(parser):
+    """``--trace/--trace_dir/--flightrec`` for the experiment mains
+    (wired through ``experiments/common.add_base_args``)."""
+    parser.add_argument(
+        "--trace", type=int, default=0,
+        help="structured span tracing of the round lifecycle "
+             "(fedml_tpu.observability): cohort-select/broadcast/"
+             "local-train/report/aggregate/eval spans, stitched across "
+             "ranks via trace ids in the message envelope; exports "
+             "trace.json (Perfetto/chrome://tracing) + spans.jsonl to "
+             "--trace_dir and arms the per-round compile-event watcher")
+    parser.add_argument(
+        "--trace_dir", type=str, default=None,
+        help="span export directory (default: --run_dir, else '.')")
+    parser.add_argument(
+        "--flightrec", type=int, default=0,
+        help="control-plane flight recorder: bounded ring of "
+             "send/recv/decision/retry events, dumped to "
+             "flightrec_<reason>.jsonl on PEER_LOST, abandoned rounds, "
+             "and unhandled crashes")
+    return parser
+
+
+@contextlib.contextmanager
+def enable(trace=False, trace_dir=None, flightrec=False, flightrec_dir=None,
+           registry=True, compile_events=None, metrics_logger=None,
+           flight_capacity=4096):
+    """Arm the observability switchboard for a scope.
+
+    Yields an object with ``tracer`` / ``registry`` / ``recorder`` /
+    ``compile_watcher`` attributes (None for the pieces left off). On
+    exit: exports ``trace.json`` + ``spans.jsonl`` into ``trace_dir``,
+    dumps the registry to ``metrics.prom`` (in ``flightrec_dir`` or
+    ``trace_dir`` when either is set), pushes the compile report to
+    ``metrics_logger``, and restores the previous globals (scopes nest).
+
+    ``compile_events`` defaults to ``trace`` -- the watcher needs jax, so
+    a flight-recorder-only scope stays jax-free.
+    """
+    state = _Scope()
+    prev_tracer = prev_reg = prev_fr = None
+    hooks = None
+    if compile_events is None:
+        compile_events = bool(trace)
+    # the compile watcher is the ONLY fallible setup step (it imports
+    # jax and registers a monitoring listener): arm it FIRST, before any
+    # global is installed, so a setup failure cannot leak a tracer/
+    # registry/recorder (or chained excepthooks) past this function --
+    # everything below is plain-Python construction that cannot raise
+    if compile_events:
+        from fedml_tpu.observability.jaxmon import watch_compiles
+        state._watch_cm = watch_compiles()
+        state.compile_watcher = state._watch_cm.__enter__()
+    if trace:
+        state.tracer = Tracer()
+        prev_tracer = set_tracer(state.tracer)
+    if registry and (trace or flightrec):
+        state.registry = MetricsRegistry()
+        prev_reg = set_registry(state.registry)
+    if flightrec:
+        state.recorder = FlightRecorder(
+            out_dir=flightrec_dir or trace_dir or ".",
+            capacity=flight_capacity)
+        prev_fr = set_flight_recorder(state.recorder)
+        hooks = _install_crash_hooks(state.recorder)
+    try:
+        yield state
+    finally:
+        if state.compile_watcher is not None:
+            state._watch_cm.__exit__(None, None, None)
+            report = state.compile_watcher.report()
+            logging.info("compile watch: %s", report)
+            if metrics_logger is not None:
+                metrics_logger(report)
+        if state.recorder is not None:
+            _uninstall_crash_hooks(hooks)
+            set_flight_recorder(prev_fr)
+        if state.registry is not None:
+            set_registry(prev_reg)
+            out_dir = flightrec_dir or trace_dir
+            if out_dir is not None:
+                os.makedirs(out_dir, exist_ok=True)
+                state.prom_path = state.registry.dump_prometheus(
+                    os.path.join(out_dir, "metrics.prom"))
+        if state.tracer is not None:
+            set_tracer(prev_tracer)
+            if trace_dir is not None:
+                os.makedirs(trace_dir, exist_ok=True)
+                state.chrome_path = state.tracer.export_chrome(
+                    os.path.join(trace_dir, "trace.json"))
+                state.spans_path = state.tracer.export_jsonl(
+                    os.path.join(trace_dir, "spans.jsonl"))
+                logging.info(
+                    "fedtrace: %d spans -> %s (open in Perfetto / "
+                    "chrome://tracing)", len(state.tracer.finished_spans()),
+                    state.chrome_path)
+
+
+class _Scope:
+    """What :func:`enable` yields; also records artifact paths on exit."""
+
+    def __init__(self):
+        self.tracer = None
+        self.registry = None
+        self.recorder = None
+        self.compile_watcher = None
+        self.chrome_path = None
+        self.spans_path = None
+        self.prom_path = None
+        self._watch_cm = None
+
+
+def _install_crash_hooks(recorder):
+    """Chain sys/threading excepthooks: an unhandled crash dumps the ring
+    before the interpreter's default handling runs."""
+    prev_sys = sys.excepthook
+    prev_thr = threading.excepthook
+
+    def on_crash(exc_type, exc, tb):
+        try:
+            recorder.record("crash", error=f"{exc_type.__name__}: {exc}")
+            recorder.dump("crash", extra={"error": repr(exc)})
+        except OSError:  # the disk is gone too: still run default handling
+            pass
+        prev_sys(exc_type, exc, tb)
+
+    def on_thread_crash(args):
+        try:
+            recorder.record("crash", thread_name=getattr(
+                args.thread, "name", "?"),
+                error=f"{args.exc_type.__name__}: {args.exc_value}")
+            recorder.dump("crash", extra={"error": repr(args.exc_value)})
+        except OSError:
+            pass
+        prev_thr(args)
+
+    sys.excepthook = on_crash
+    threading.excepthook = on_thread_crash
+    return (prev_sys, prev_thr, on_crash, on_thread_crash)
+
+
+def _uninstall_crash_hooks(hooks):
+    if hooks is None:
+        return
+    prev_sys, prev_thr, on_crash, on_thread_crash = hooks
+    # only unwind our own frame: someone may have chained on top of us
+    if sys.excepthook is on_crash:
+        sys.excepthook = prev_sys
+    if threading.excepthook is on_thread_crash:
+        threading.excepthook = prev_thr
+
+
+__all__ = ["Tracer", "NoopTracer", "NOOP_TRACER", "Span", "SpanContext",
+           "TRACE_KEY", "get_tracer", "set_tracer",
+           "MetricsRegistry", "get_registry", "set_registry",
+           "FlightRecorder", "get_flight_recorder", "set_flight_recorder",
+           "add_observability_args", "enable"]

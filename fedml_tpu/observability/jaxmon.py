@@ -1,0 +1,137 @@
+"""Compile-event watcher: the first honest measurement of compile latency.
+
+ROADMAP names 155-193 s per-config compiles as a cost center, but until
+now nothing *measured* them per round -- the runtime auditor counts trace
+events for its retrace gate, while durations were eyeballed from logs.
+This listener subscribes to ``jax.monitoring``'s duration events
+(jaxpr trace + backend compile) and buckets **count and wall seconds per
+federated round** at the same ``end_of_round_sync`` interception point
+the auditor uses, feeding:
+
+- the metrics registry (``jax_compiles_total``, ``jax_traces_total``
+  counters; ``jax_compile_seconds`` histogram) when one is enabled;
+- per-round lists in :meth:`CompileWatcher.report` (mirrored into the
+  final metrics record by the ``enable()`` scope).
+
+Unlike the auditor this is pure measurement -- no transfer guard, no
+gates -- so it can stay on for every traced run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+#: jax.monitoring event names (same stable strings the runtime auditor
+#: pins; see fedml_tpu.analysis.runtime).
+TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_current = None
+
+
+def current_watcher():
+    return _current
+
+
+class CompileWatcher:
+    """Counts jax trace/compile events and their durations, bucketed per
+    round by :meth:`mark_round` (wired through ``end_of_round_sync``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._active = False
+        self._compiles = 0
+        self._compile_s = 0.0
+        self._traces = 0
+        self.rounds = 0
+        self.compiles_per_round = []
+        self.compile_seconds_per_round = []
+        self.traces_per_round = []
+        self.total_compiles = 0
+        self.total_compile_seconds = 0.0
+        self.total_traces = 0
+
+    def _on_event(self, event, duration_secs, **kwargs):
+        if not self._active:
+            return
+        from fedml_tpu.observability.registry import get_registry
+        reg = get_registry()
+        with self._lock:
+            if event == COMPILE_EVENT:
+                self._compiles += 1
+                self._compile_s += float(duration_secs)
+                self.total_compiles += 1
+                self.total_compile_seconds += float(duration_secs)
+            elif event == TRACE_EVENT:
+                self._traces += 1
+                self.total_traces += 1
+            else:
+                return
+        if reg is not None:
+            if event == COMPILE_EVENT:
+                reg.inc("jax_compiles_total",
+                        help="XLA backend compiles observed")
+                reg.observe("jax_compile_seconds", float(duration_secs),
+                            help="XLA backend compile wall seconds")
+            else:
+                reg.inc("jax_traces_total",
+                        help="jaxpr traces observed")
+
+    def mark_round(self):
+        """Close the current round's bucket (round 0 holds warm-up)."""
+        with self._lock:
+            self.compiles_per_round.append(self._compiles)
+            self.compile_seconds_per_round.append(round(self._compile_s, 4))
+            self.traces_per_round.append(self._traces)
+            self._compiles = 0
+            self._compile_s = 0.0
+            self._traces = 0
+            self.rounds += 1
+
+    def report(self):
+        with self._lock:
+            return {
+                "compile/rounds": self.rounds,
+                "compile/compiles_per_round": list(self.compiles_per_round),
+                "compile/seconds_per_round":
+                    list(self.compile_seconds_per_round),
+                "compile/traces_per_round": list(self.traces_per_round),
+                "compile/total_compiles": self.total_compiles,
+                "compile/total_seconds":
+                    round(self.total_compile_seconds, 4),
+                "compile/total_traces": self.total_traces,
+            }
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        from jax import monitoring
+        self._active = True
+        monitoring.register_event_duration_secs_listener(self._on_event)
+        return self
+
+    def stop(self):
+        self._active = False
+        # jax only exposes clear-all publicly; reuse the auditor's
+        # best-effort dereg (leaving the inert listener on API drift)
+        from fedml_tpu.analysis.runtime import _unregister
+        _unregister(self._on_event)
+
+
+@contextlib.contextmanager
+def watch_compiles():
+    """Arm a :class:`CompileWatcher` for the block; yields it. The round
+    loops' ``end_of_round_sync`` calls :meth:`CompileWatcher.mark_round`
+    on the current watcher, so per-round buckets need no extra wiring."""
+    global _current
+    watcher = CompileWatcher().start()
+    prev, _current = _current, watcher
+    try:
+        yield watcher
+    finally:
+        _current = prev
+        watcher.stop()
+
+
+__all__ = ["CompileWatcher", "watch_compiles", "current_watcher",
+           "TRACE_EVENT", "COMPILE_EVENT"]

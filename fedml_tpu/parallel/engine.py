@@ -39,6 +39,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from fedml_tpu.core import pytree
 from fedml_tpu.core.sharding import shard_map
 from fedml_tpu.core.trainer import TrainSpec
+from fedml_tpu.observability.tracing import get_tracer
 from fedml_tpu.parallel.mesh import CLIENT_AXIS, zero_pad_leading
 
 
@@ -382,18 +383,24 @@ class WaveRunner:
                 w_rngs = np.concatenate([w_rngs, w_rngs[:1].repeat(pad, 0)])
             ws = {"idx": jnp.asarray(w_idx), "mask": jnp.asarray(w_mask),
                   "n": jnp.asarray(w_n)}
-            pay_sum, w_sum, metrics_sum, aux = self._wave_fn(
-                global_state, device_data["x"], device_data["y"],
-                jnp.asarray(w_ids), ws, jnp.int32(trip), jnp.asarray(w_rngs))
+            # span measures dispatch (async): device time for the whole
+            # round lands in the caller's end-of-round sync
+            with get_tracer().span("wave", clients=int(k), trip=trip):
+                pay_sum, w_sum, metrics_sum, aux = self._wave_fn(
+                    global_state, device_data["x"], device_data["y"],
+                    jnp.asarray(w_ids), ws, jnp.int32(trip),
+                    jnp.asarray(w_rngs))
             part = (pay_sum, w_sum, metrics_sum)
             acc = part if acc is None else self._add_fn(acc, part)
             wave_aux.append(aux)
             wave_pos.append(pos)
 
         pay_sum, w_sum, metrics_sum = acc
-        new_global, new_server_state = self._finish_fn(
-            global_state, server_state, pay_sum, w_sum,
-            self._payload_dtypes(global_state), jax.random.fold_in(rng, 2))
+        with get_tracer().span("server-update"):
+            new_global, new_server_state = self._finish_fn(
+                global_state, server_state, pay_sum, w_sum,
+                self._payload_dtypes(global_state),
+                jax.random.fold_in(rng, 2))
 
         # gather per-client aux back into cohort order (host, post-dispatch)
         aux_out = {"n": np.zeros(C, np.float32),
@@ -739,10 +746,13 @@ class LaneRunner:
                                     jnp.asarray(lanes["slot"]),
                                     jnp.asarray(lanes["local_step"]))
         rows = jnp.asarray(np.asarray(ids, np.int32))
-        new_global, new_server, metrics = self._round_fn(
-            global_state, server_state, device_data["x"], device_data["y"],
-            rows, lane_arrays, step_keys, trip,
-            self._payload_dtypes(global_state), jax.random.fold_in(rng, 2))
+        with get_tracer().span("lanes", clients=int(C),
+                               n_lanes=int(self.n_lanes), trip=int(trip)):
+            new_global, new_server, metrics = self._round_fn(
+                global_state, server_state, device_data["x"],
+                device_data["y"], rows, lane_arrays, step_keys, trip,
+                self._payload_dtypes(global_state),
+                jax.random.fold_in(rng, 2))
         steps_pc = (np.asarray(sched["mask"]).sum(axis=2) > 0).sum(axis=1)
         aux = {"n": np.asarray(sched["n"], np.float32),
                "steps": steps_pc.astype(np.int64)}
@@ -917,10 +927,13 @@ class ShardedLaneRunner:
         rows_all = jnp.asarray(np.stack(row_stack))
         trip = jnp.int32(max(max(trips), 1))
 
-        new_global, new_server, metrics = self._round_fn(
-            global_state, server_state, device_data["x"], device_data["y"],
-            rows_all, lanes_all, keys_all, trip,
-            self._payload_dtypes(global_state), jax.random.fold_in(rng, 2))
+        with get_tracer().span("sharded-lanes", clients=int(C),
+                               shards=int(D), trip=int(max(max(trips), 1))):
+            new_global, new_server, metrics = self._round_fn(
+                global_state, server_state, device_data["x"],
+                device_data["y"], rows_all, lanes_all, keys_all, trip,
+                self._payload_dtypes(global_state),
+                jax.random.fold_in(rng, 2))
         steps_pc = (mask.sum(axis=2) > 0).sum(axis=1)
         aux = {"n": np.asarray(sched["n"], np.float32),
                "steps": steps_pc.astype(np.int64)}

@@ -33,6 +33,7 @@ from fedml_tpu.core.locks import audited_rlock
 from fedml_tpu.core.comm.base import MSG_TYPE_PEER_LOST
 from fedml_tpu.core.managers import ClientManager, ServerManager
 from fedml_tpu.core.message import Message
+from fedml_tpu.observability.tracing import get_tracer
 from fedml_tpu.resilience.policy import (
     ROUND_DEGRADED, RetryPolicy, RoundController, RoundPolicy,
     aggregate_reports, send_with_retry)
@@ -105,6 +106,16 @@ class SimResilience:
         return cls(policy, straggler_p=sp,
                    seed=int(getattr(args, "seed", 0)))
 
+    def sample(self, round_idx, client_num_in_total, client_num_per_round):
+        """Returns ``(reporting_client_ids, round_record_dict)``."""
+        with get_tracer().span("cohort-select", round=int(round_idx)) as sp:
+            reporting, record = self._sample(
+                round_idx, client_num_in_total, client_num_per_round)
+            sp.set(selected=record["res/selected"],
+                   reporting=record["res/reporting"],
+                   attempts=record["res/attempts"])
+            return reporting, record
+
     def misses_deadline(self, round_idx, attempt, client_id) -> bool:
         if self._miss_fn is not None:
             return bool(self._miss_fn(round_idx, attempt, client_id))
@@ -115,8 +126,7 @@ class SimResilience:
             (self.seed, int(round_idx), int(attempt), int(client_id)))
         return bool(rng.random() < self.straggler_p)
 
-    def sample(self, round_idx, client_num_in_total, client_num_per_round):
-        """Returns ``(reporting_client_ids, round_record_dict)``."""
+    def _sample(self, round_idx, client_num_in_total, client_num_per_round):
         from fedml_tpu.algorithms.fedavg import client_sampling
 
         target = min(client_num_per_round, client_num_in_total)
@@ -190,23 +200,32 @@ class ResilientFedAvgClient(ClientManager):
                                               self._on_server_lost)
 
     def _on_sync(self, msg):
-        params, n = self.local_train_fn(msg.get("params"),
-                                        int(msg.get("round")), self.rank)
-        out = Message(MSG_C2S_REPORT, self.rank, 0)
-        out.add("params", params)
-        out.add("num_samples", float(n))
-        out.add("round", int(msg.get("round")))
-        out.add("attempt", int(msg.get("attempt")))
-        try:
-            if self.retry_policy is not None:
-                send_with_retry(self.com_manager, out, self.retry_policy,
-                                counters=self.counters)
-            else:
-                self.send_message(out)
-        except (ConnectionError, OSError):
-            # server gone mid-report; the peer-lost path ends the loop
-            logging.warning("rank %d: report send failed (server lost?)",
-                            self.rank)
+        # spans parent under the server's round span: the SYNC message
+        # carries its context (__trace__), and the manager dispatch loop
+        # made it this thread's current parent before calling us
+        tracer = get_tracer()
+        rnd = int(msg.get("round"))
+        with tracer.span("local-train", rank=self.rank, round=rnd):
+            params, n = self.local_train_fn(msg.get("params"), rnd,
+                                            self.rank)
+        with tracer.span("report", rank=self.rank, round=rnd):
+            out = Message(MSG_C2S_REPORT, self.rank, 0)
+            out.add("params", params)
+            out.add("num_samples", float(n))
+            out.add("round", rnd)
+            out.add("attempt", int(msg.get("attempt")))
+            tracer.inject(out)  # stitch the server's report handling here
+            try:
+                if self.retry_policy is not None:
+                    send_with_retry(self.com_manager, out,
+                                    self.retry_policy,
+                                    counters=self.counters)
+                else:
+                    self.send_message(out)
+            except (ConnectionError, OSError):
+                # server gone mid-report; the peer-lost path ends the loop
+                logging.warning("rank %d: report send failed (server "
+                                "lost?)", self.rank)
 
     def _on_server_lost(self, msg):
         # sender is the LOST rank: only rank 0 dying concerns a client.
@@ -262,6 +281,10 @@ class ResilientFedAvgServer(ServerManager):
                          "clients_dropped": 0, "retries": 0, "resumes": 0}
         self._controller = RoundController(
             round_policy, self._on_round_complete, self._on_round_abandoned)
+        # one detached span per round attempt (begun at _open_round on the
+        # turnover thread, ended at the decision on a serve/timer thread);
+        # its context rides every SYNC so client spans stitch under it
+        self._round_span = None
         # serializes round turnover and guards `alive`. Sync sends happen
         # OUTSIDE this lock (_open_round returns them, _send_syncs
         # delivers) so a blocking write to a wedged peer can never pin
@@ -287,7 +310,7 @@ class ResilientFedAvgServer(ServerManager):
         failure can dispatch PEER_LOST (and drive a turnover) while the
         restore is still rewriting ``params``/``round_idx`` -- writing
         them unlocked races those handler threads (fedcheck FL123)."""
-        syncs = []
+        syncs, span = [], None
         with self._advance_lock:
             if self.recovery is not None:
                 saved = self.recovery.restore_latest()
@@ -299,6 +322,7 @@ class ResilientFedAvgServer(ServerManager):
             done = self.round_idx >= self.rounds
             if not done:
                 syncs = self._open_round()
+                span = self._round_span
             done = done or self.failed is not None
         # finish() OUTSIDE the lock: it reaches the transport's STOP wave
         # (blocking per-peer socket writes) and must not pin the turnover
@@ -308,7 +332,7 @@ class ResilientFedAvgServer(ServerManager):
         if done:
             self.finish()
             return
-        self._send_syncs(syncs)
+        self._send_syncs(syncs, span)
 
     def _open_round(self):
         """Open the next round attempt: sample the cohort and arm the
@@ -330,32 +354,50 @@ class ResilientFedAvgServer(ServerManager):
                                    self.round_policy.select_count(
                                        target, len(alive)))
         self._controller.begin(self.round_idx, self.attempt, cohort, target)
+        tracer = get_tracer()
+        self._round_span = tracer.start_span(
+            "round", root=True, rank=0, round=self.round_idx,
+            attempt=self.attempt, cohort=len(cohort), target=target)
         syncs = []
         for r in cohort:
             m = Message(MSG_S2C_SYNC, 0, r)
             m.add("params", self.params)
             m.add("round", self.round_idx)
             m.add("attempt", self.attempt)
+            tracer.inject(m, self._round_span.context)
             syncs.append((r, m))
         return syncs
 
-    def _send_syncs(self, syncs):
+    def _send_syncs(self, syncs, span=None):
         """Deliver the opened round's syncs (no locks held). A send that
         outlives its round attempt (deadline fired mid-delivery and a new
         attempt opened) is harmless: the message carries its (round,
-        attempt) tag and stale reports land in the late counter."""
-        for _r, m in syncs:
-            try:
-                send_with_retry(self.com_manager, m, self.retry_policy,
-                                counters=self.counters)
-            except (ConnectionError, OSError):
-                pass  # peer-lost dispatch already told the controller
+        attempt) tag and stale reports land in the late counter. ``span``
+        is the caller's under-lock snapshot of the round span
+        (``self._round_span`` mutates under ``_advance_lock``; reading it
+        here would race the turnover threads -- fedcheck FL123)."""
+        if not syncs:
+            return
+        with get_tracer().span(
+                "broadcast", parent=None if span is None else span.context,
+                n=len(syncs)):
+            for _r, m in syncs:
+                try:
+                    send_with_retry(self.com_manager, m, self.retry_policy,
+                                    counters=self.counters)
+                except (ConnectionError, OSError):
+                    pass  # peer-lost dispatch already told the controller
 
     def _on_report(self, msg):
-        self._controller.report(
-            msg.get("round"), msg.get("attempt"), msg.get_sender_id(),
-            msg.get("num_samples"),
-            {k: np.asarray(v) for k, v in msg.get("params").items()})
+        # parents under the client's "report" span (context injected into
+        # the report message, adopted by the manager dispatch loop)
+        with get_tracer().span("report-recv",
+                               rank=int(msg.get_sender_id()),
+                               round=int(msg.get("round"))):
+            self._controller.report(
+                msg.get("round"), msg.get("attempt"), msg.get_sender_id(),
+                msg.get("num_samples"),
+                {k: np.asarray(v) for k, v in msg.get("params").items()})
 
     def _on_peer_lost(self, msg):
         rank = int(msg.get_sender_id())
@@ -375,9 +417,17 @@ class ResilientFedAvgServer(ServerManager):
 
     # -- round turnover (serve/timer threads) ------------------------------
     def _on_round_complete(self, reports, outcome):
-        syncs = []
+        syncs, span = [], None
+        tracer = get_tracer()
         with self._advance_lock:
-            self.params, _total = aggregate_reports(reports)
+            rspan = self._round_span
+            with tracer.span(
+                    "aggregate",
+                    parent=None if rspan is None else rspan.context,
+                    reports=len(reports)):
+                self.params, _total = aggregate_reports(reports)
+            if rspan is not None:
+                rspan.set(outcome=outcome, reports=len(reports)).end()
             self.history.append(dict(self.params))
             self.reporting_log.append(sorted(reports))
             degraded = outcome == ROUND_DEGRADED
@@ -392,15 +442,19 @@ class ResilientFedAvgServer(ServerManager):
             done = self.round_idx >= self.rounds
             if not done:
                 syncs = self._open_round()
+                span = self._round_span
             done = done or self.failed is not None
         if done:                    # see start(): no STOP wave under the
             self.finish()           # turnover lock
             return
-        self._send_syncs(syncs)
+        self._send_syncs(syncs, span)
 
     def _on_round_abandoned(self, reports):
-        syncs = []
+        syncs, span = [], None
         with self._advance_lock:
+            rspan = self._round_span
+            if rspan is not None:
+                rspan.set(outcome="abandoned", reports=len(reports)).end()
             self.counters["rounds_abandoned"] += 1
             logging.warning("round %d attempt %d abandoned with %d reports",
                             self.round_idx, self.attempt, len(reports))
@@ -410,11 +464,12 @@ class ResilientFedAvgServer(ServerManager):
                            f"{self.attempt} times")
             else:
                 syncs = self._open_round()
+                span = self._round_span
             done = self.failed is not None
         if done:  # see start(): finish() outside the lock
             self.finish()
             return
-        self._send_syncs(syncs)
+        self._send_syncs(syncs, span)
 
     def _log_round(self, n_reports, degraded):
         if self.metrics_logger is None:
@@ -431,6 +486,10 @@ class ResilientFedAvgServer(ServerManager):
         ``_advance_lock``; the lock-exiting caller performs the actual
         ``finish()`` (transport STOP wave = blocking writes) outside."""
         self.failed = reason
+        if self._round_span is not None:
+            # an attempt left open by an unrecoverable stop still records
+            # (Span.end is idempotent: a decided round already ended it)
+            self._round_span.set(outcome="failed").end()
         logging.error("resilient server giving up: %s", reason)
         self._controller.cancel()
 

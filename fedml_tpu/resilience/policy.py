@@ -38,6 +38,8 @@ import numpy as np
 from fedml_tpu.core.locks import audited_lock
 from fedml_tpu.core.comm.base import MSG_TYPE_PEER_LOST
 from fedml_tpu.core.message import Message
+from fedml_tpu.observability.flightrec import get_flight_recorder
+from fedml_tpu.observability.registry import get_registry
 
 
 class PeerUnreachableError(ConnectionError):
@@ -102,14 +104,27 @@ def send_with_retry(comm, msg: Message, policy: RetryPolicy,
                 f"{last}") from last
         if counters is not None:
             counters["retries"] = counters.get("retries", 0) + 1
+        fr = get_flight_recorder()
+        if fr is not None:
+            fr.record("retry", dst=int(msg.get_receiver_id()),
+                      type=msg.get_type(), attempt=attempt,
+                      backoff_s=policy.delay(attempt - 1))
+        reg = get_registry()
+        if reg is not None:
+            reg.inc("fed_send_retries_total",
+                    help="control-plane send retries (backoff layer)")
         sleep(policy.delay(attempt - 1))
 
 
 def _dispatch_peer_lost(comm, receiver):
     notify = getattr(comm, "_notify_peer_lost", None)
     if notify is not None:  # transport-native path dedups per peer
-        notify(receiver)
+        notify(receiver)  # (tcp also flight-records + dumps there)
         return
+    fr = get_flight_recorder()
+    if fr is not None:
+        fr.record("peer_lost", peer=receiver, transport="retry-layer")
+        fr.dump("peer_lost", extra={"peer": receiver})
     lost = Message(MSG_TYPE_PEER_LOST, receiver, getattr(comm, "rank", 0))
     for obs in list(getattr(comm, "_observers", [])):
         obs.receive_message(MSG_TYPE_PEER_LOST, lost)
@@ -265,6 +280,19 @@ class RoundController:
         outcome, reports, round_idx, attempt, target = decision
         logging.info("round %s attempt %s: %s with %d/%d reports",
                      round_idx, attempt, outcome, len(reports), target)
+        fr = get_flight_recorder()
+        if fr is not None:
+            fr.record("round_decision", outcome=outcome, round=round_idx,
+                      attempt=attempt, reports=len(reports), target=target)
+            if outcome == ROUND_ABANDONED:
+                fr.dump("abandoned_round",
+                        extra={"round": round_idx, "attempt": attempt,
+                               "reports": len(reports), "target": target})
+        reg = get_registry()
+        if reg is not None:
+            reg.inc("fed_round_attempts_total",
+                    help="round-attempt decisions by outcome",
+                    outcome=outcome)
         if outcome == ROUND_ABANDONED:
             self._on_abandoned(reports)
         else:

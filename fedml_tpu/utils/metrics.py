@@ -20,6 +20,7 @@ import os
 import time
 
 from fedml_tpu.core.locks import audited_lock
+from fedml_tpu.observability.registry import get_registry
 
 
 class MetricsLogger:
@@ -31,7 +32,8 @@ class MetricsLogger:
     ``bytes_received`` counters via :meth:`count_wire` and the accumulated
     totals attach to the next ``log()`` record that does not already carry
     a ``bytes_on_wire`` field (then reset -- i.e. per-round counters when
-    the round loop logs once per round).
+    the round loop logs once per round); any residual still pending at
+    :meth:`close` is flushed as a final ``wire_flush_at_close`` record.
     """
 
     def __init__(self, run_dir=None, enable_wandb=False, project="fedml_tpu",
@@ -84,6 +86,12 @@ class MetricsLogger:
                 # counts -- they attach to the next record without the field
                 self._wire_bytes = 0
                 self._wire_raw_bytes = 0
+        registry = get_registry()
+        if registry is not None:
+            # per-round visibility for the unified metrics registry
+            # (fedml_tpu.observability): every series that moved since the
+            # last record rides this one under an ``m/`` prefix
+            registry.snapshot_into(record)
         logging.info("%s", record)
         if self._jsonl is not None:
             self._jsonl.write(json.dumps({"_ts": time.time(), **record}) + "\n")
@@ -101,6 +109,13 @@ class MetricsLogger:
         return dict(self._summary)
 
     def close(self):
+        # count_wire attaches to the NEXT record -- which never comes when
+        # the run ends here. Flush the residual as one final record so
+        # accumulated wire bytes are never silently dropped at shutdown.
+        with self._wire_lock:
+            residual = self._wire_bytes
+        if residual:
+            self.log({"event": "wire_flush_at_close"})
         if self._jsonl is not None:
             self._jsonl.close()
             self._jsonl = None

@@ -44,14 +44,21 @@ def end_of_round_sync(state):
     through here rather than calling ``jax.block_until_ready`` ad hoc --
     it is the one interception point the runtime auditor
     (``fedml_tpu.analysis.runtime.audit``) uses to bucket (re)trace counts
-    per round and arm the transfer guard. Returns ``state``."""
+    per round and arm the transfer guard, and the compile-event watcher
+    (``fedml_tpu.observability.jaxmon``) uses to bucket compile count +
+    duration per round. Returns ``state``."""
     from fedml_tpu.analysis.runtime import current_auditor
+    from fedml_tpu.observability.jaxmon import current_watcher
 
     auditor = current_auditor()
     if auditor is not None:
-        return auditor.sync_and_mark_round(state)
-    import jax
-    jax.block_until_ready(state)
+        state = auditor.sync_and_mark_round(state)
+    else:
+        import jax
+        jax.block_until_ready(state)
+    watcher = current_watcher()
+    if watcher is not None:
+        watcher.mark_round()
     return state
 
 

@@ -75,18 +75,25 @@ EOF
 
 echo "== chaos smoke (fedml_tpu.resilience): 3-round TCP FedAvg with one"
 echo "   injected client kill and one stall past the deadline, run under"
-echo "   the --race-audit sanitizer (instrumented control-plane locks) --"
-echo "   must complete DEGRADED (no hang; bounded by timeout), the final"
-echo "   model must equal the reporting-subset weighted average exactly"
-echo "   (A/B vs a no-fault run over the same subsets), and the race"
-echo "   audit must report ZERO lock-order cycles and ZERO"
-echo "   held-while-blocking events. fedlint must stay at zero findings"
-echo "   on the resilience package =="
-python -m fedml_tpu.analysis fedml_tpu/resilience/ > /dev/null \
-    && echo "fedlint on fedml_tpu/resilience/: 0 findings"
+echo "   the --race-audit sanitizer (instrumented control-plane locks)"
+echo "   AND fedtrace (--trace --flightrec equivalent) -- must complete"
+echo "   DEGRADED (no hang; bounded by timeout), the final model must"
+echo "   equal the reporting-subset weighted average exactly (A/B vs a"
+echo "   no-fault run over the same subsets), the race audit must report"
+echo "   ZERO lock-order cycles and ZERO held-while-blocking events, the"
+echo "   Chrome trace must parse with balanced B/E events, the kill must"
+echo "   produce exactly one flight-recorder dump holding its PEER_LOST"
+echo "   event, and metrics.prom must match the exposition grammar."
+echo "   fedlint must stay at zero findings on the resilience +"
+echo "   observability packages =="
+python -m fedml_tpu.analysis fedml_tpu/resilience/ fedml_tpu/observability/ \
+    > /dev/null \
+    && echo "fedlint on resilience/ + observability/: 0 findings"
 timeout -k 10 180 python - <<'EOF'
+import json, re, tempfile
 import numpy as np
 from fedml_tpu.analysis.runtime import race_audit
+from fedml_tpu.observability import enable
 from fedml_tpu.resilience import (FaultPlan, FaultRule, RoundPolicy,
                                   run_tcp_fedavg)
 
@@ -97,9 +104,13 @@ plan = FaultPlan(seed=7, rules=(
     FaultRule("kill", rank=3, msg_type="res_report", nth=2),
     FaultRule("stall", rank=2, msg_type="res_report", nth=1, delay_s=4.0),
 ))
-with race_audit() as ra:
-    srv = run_tcp_fedavg(4, 3, RoundPolicy(deadline_s=1.0, quorum=0.3), w0,
-                         fault_plan=plan, join_timeout=90)
+d = tempfile.mkdtemp(prefix="fedtrace_smoke_")
+with enable(trace=True, trace_dir=d, flightrec=True, flightrec_dir=d,
+            compile_events=False) as obs:
+    with race_audit() as ra:
+        srv = run_tcp_fedavg(4, 3, RoundPolicy(deadline_s=1.0, quorum=0.3),
+                             w0, fault_plan=plan, join_timeout=90)
+    spans = obs.tracer.finished_spans()
 assert srv.failed is None and len(srv.history) == 3, (
     srv.failed, len(srv.history))
 assert srv.counters["rounds_degraded"] >= 1, srv.counters
@@ -107,6 +118,46 @@ race = ra.report()
 assert race["race/locks_created"] > 0, race  # the factories were live
 assert race["race/lock_order_cycles"] == [], race
 assert race["race/held_while_blocking"] == [], race
+
+# fedtrace: the Chrome trace parses as JSON with balanced B/E events,
+# and client local-train spans stitch under server round spans
+doc = json.load(open(obs.chrome_path))
+evs = doc["traceEvents"]
+nb = sum(1 for e in evs if e.get("ph") == "B")
+ne = sum(1 for e in evs if e.get("ph") == "E")
+assert nb == ne > 0, (nb, ne)
+rounds = {s.span_id: s for s in spans if s.name == "round"}
+lts = [s for s in spans if s.name == "local-train"]
+assert lts and all(s.parent_id in rounds and
+                   s.trace_id == rounds[s.parent_id].trace_id
+                   for s in lts), "cross-rank span stitching broken"
+
+# flight recorder: the kill produced exactly ONE dump TRIGGERED by rank
+# 3's PEER_LOST -- identified by the dump_info trailer, since the ring's
+# retained events (incl. the kill) also appear in any later dump (e.g.
+# the stalled client observing teardown). The kill dump must hold the
+# peer_lost event plus surrounding traffic.
+kill_dumps = []
+for p in obs.recorder.dumps:
+    events = [json.loads(l) for l in open(p)]
+    info = [e for e in events if e["kind"] == "dump_info"]
+    if info and info[-1].get("peer") == 3:
+        kill_dumps.append(events)
+assert len(kill_dumps) == 1, obs.recorder.dumps
+assert any(e["kind"] == "peer_lost" and e.get("peer") == 3
+           for e in kill_dumps[0])
+
+# metrics.prom: every line matches the exposition grammar
+prom_line = re.compile(
+    r"^(# HELP [a-zA-Z_:][a-zA-Z0-9_:]* .*"
+    r"|# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (counter|gauge|histogram)"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? "
+    r"(-?[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?|[+-]Inf|NaN))$")
+prom = open(obs.prom_path).read()
+for line in prom.strip().split("\n"):
+    assert prom_line.match(line), line
+assert "comm_bytes_total" in prom
+
 subsets = srv.reporting_log
 ref = run_tcp_fedavg(4, 3, RoundPolicy(deadline_s=10.0, quorum=0.3), w0,
                      cohort_override=lambda r, a: subsets[r],
@@ -115,8 +166,8 @@ for got, want in zip(srv.history, ref.history):
     for k in got:
         assert (got[k] == want[k]).all(), k
 print("chaos smoke: degraded completion + exact subset average + clean "
-      "race audit OK",
-      {"reporting": subsets,
+      "race audit + stitched trace + one PEER_LOST dump + valid prom OK",
+      {"reporting": subsets, "spans": len(spans),
        "race_acquisitions": race["race/acquisitions"], **srv.counters})
 EOF
 

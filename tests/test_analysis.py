@@ -1247,6 +1247,83 @@ class TestFl113Captures:
         assert codes(src) == ["FL112"]
 
 
+class TestFl114WallclockTiming:
+    JIT = ("import time\n"
+           "import jax\n"
+           "@jax.jit\n"
+           "def step(x):\n"
+           "    return x * 2\n")
+
+    def test_fl114_unsynced_delta_around_jitted_call(self):
+        src = self.JIT + (
+            "def measure(x):\n"
+            "    t0 = time.time()\n"
+            "    y = step(x)\n"
+            "    dt = time.time() - t0\n"
+            "    return y, dt\n")
+        assert codes(src) == ["FL114"]
+
+    def test_fl114_wrap_form_and_from_import_perf_counter(self):
+        src = (
+            "from time import perf_counter\n"
+            "import jax\n"
+            "f = jax.jit(lambda x: x + 1)\n"
+            "def measure(x):\n"
+            "    t0 = perf_counter()\n"
+            "    y = f(x)\n"
+            "    return perf_counter() - t0\n")
+        assert codes(src) == ["FL114"]
+
+    def test_fl114_negative_block_until_ready(self):
+        src = self.JIT + (
+            "def measure(x):\n"
+            "    t0 = time.time()\n"
+            "    y = jax.block_until_ready(step(x))\n"
+            "    return time.time() - t0\n")
+        assert codes(src) == []
+
+    def test_fl114_negative_end_of_round_sync(self):
+        src = self.JIT + (
+            "from fedml_tpu.utils.profiling import end_of_round_sync\n"
+            "def measure(x):\n"
+            "    t0 = time.time()\n"
+            "    y = step(x)\n"
+            "    end_of_round_sync(y)\n"
+            "    return time.time() - t0\n")
+        assert codes(src) == []
+
+    def test_fl114_negative_value_fetch_is_a_sync(self):
+        # float(...) blocks on the producing computation: the measured
+        # timing is honest (the bench scripts' value-fetch idiom)
+        src = self.JIT + (
+            "def measure(x):\n"
+            "    t0 = time.perf_counter()\n"
+            "    loss = float(step(x))\n"
+            "    return time.perf_counter() - t0\n")
+        assert codes(src) == []
+
+    def test_fl114_negative_no_jitted_call_in_region(self):
+        src = self.JIT + (
+            "def measure(x):\n"
+            "    t0 = time.time()\n"
+            "    y = host_work(x)\n"
+            "    return time.time() - t0\n")
+        assert codes(src) == []
+
+    def test_fl114_inner_reassignment_reports_exactly_once(self):
+        # the loop re-times with its own t0: the unsynced inner delta is
+        # ONE finding (from the loop suite's scan) -- the outer, stale t0
+        # must not double-report it through the nested suite
+        src = self.JIT + (
+            "def measure(x):\n"
+            "    t0 = time.time()\n"
+            "    for _ in range(3):\n"
+            "        t0 = time.perf_counter()\n"
+            "        y = step(x)\n"
+            "        dt_in = time.perf_counter() - t0\n")
+        assert codes(src) == ["FL114"]
+
+
 class TestSarif:
     SRC = TestBaseline.SRC
 

@@ -1,0 +1,360 @@
+"""fedtrace: span tracing, metrics registry, flight recorder.
+
+Unit coverage for each piece plus the two integration contracts from the
+PR's acceptance criteria: (1) a TCP chaos run with tracing on yields ONE
+Chrome-trace file whose client-rank spans stitch under their round's
+server span via propagated trace ids, and one flight-recorder dump for
+the killed peer; (2) the same scenario with observability disabled is
+bitwise identical to an uninstrumented run.
+"""
+
+import json
+import os
+import re
+import threading
+
+import numpy as np
+import pytest
+
+from fedml_tpu.core.message import Message
+from fedml_tpu.observability import (FlightRecorder, MetricsRegistry,
+                                     NOOP_TRACER, TRACE_KEY, Tracer, enable,
+                                     get_flight_recorder, get_registry,
+                                     get_tracer)
+from fedml_tpu.utils.metrics import MetricsLogger
+
+
+# -- tracer ----------------------------------------------------------------
+
+class TestTracer:
+    def test_nested_spans_parent_on_thread_context(self):
+        t = Tracer()
+        with t.span("outer") as outer:
+            with t.span("inner") as inner:
+                assert t.current().span_id == inner.span_id
+        spans = {s.name: s for s in t.finished_spans()}
+        assert spans["inner"].parent_id == spans["outer"].span_id
+        assert spans["inner"].trace_id == spans["outer"].trace_id
+        assert spans["outer"].parent_id is None
+        assert spans["outer"].t1 >= spans["outer"].t0
+
+    def test_detached_span_cross_thread_end_and_root(self):
+        t = Tracer()
+        with t.span("ambient"):
+            s = t.start_span("round", root=True, round=3)
+            assert s.parent_id is None  # root even under an active ctx
+        done = threading.Event()
+
+        def closer():
+            s.set(outcome="complete").end()
+            done.set()
+
+        threading.Thread(target=closer).start()
+        assert done.wait(5)
+        rec = [x for x in t.finished_spans() if x.name == "round"][0]
+        assert rec.attrs == {"round": 3, "outcome": "complete"}
+
+    def test_end_is_idempotent(self):
+        t = Tracer()
+        s = t.start_span("x")
+        s.end()
+        first = s.t1
+        s.end()
+        assert s.t1 == first
+        assert len(t.finished_spans()) == 1
+
+    def test_concurrent_end_records_exactly_once(self):
+        # the check-and-set runs under the tracer lock: N racing end()
+        # calls on one detached span must record one span, not N
+        t = Tracer()
+        s = t.start_span("round")
+        start = threading.Barrier(8)
+
+        def racer():
+            start.wait()
+            s.end()
+
+        threads = [threading.Thread(target=racer) for _ in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert len(t.finished_spans()) == 1
+
+    def test_inject_extract_roundtrip_through_binary_codec(self):
+        t = Tracer()
+        with t.span("round") as sp:
+            m = Message("sync", 0, 1)
+            m.add("params", {"w": np.ones(3, np.float32)})
+            t.inject(m)
+        m2 = Message.from_bytes(m.to_bytes())
+        ctx = Tracer.extract(m2)
+        assert ctx.trace_id == sp.trace_id
+        assert ctx.span_id == sp.span_id
+        # receive side: adopt the remote context, spans stitch under it
+        with t.remote_context(ctx):
+            with t.span("local-train") as child:
+                assert child.parent_id == sp.span_id
+                assert child.trace_id == sp.trace_id
+
+    def test_chrome_export_balanced_and_jsonl(self, tmp_path):
+        t = Tracer()
+        with t.span("a", round=1):
+            with t.span("b"):
+                pass
+        chrome = t.export_chrome(str(tmp_path / "trace.json"))
+        doc = json.load(open(chrome))
+        evs = doc["traceEvents"]
+        assert sum(1 for e in evs if e.get("ph") == "B") == \
+            sum(1 for e in evs if e.get("ph") == "E") == 2
+        b_a = next(e for e in evs if e.get("ph") == "B" and e["name"] == "a")
+        assert b_a["args"]["round"] == 1 and "trace_id" in b_a["args"]
+        lines = [json.loads(l) for l in
+                 open(t.export_jsonl(str(tmp_path / "spans.jsonl")))]
+        assert {l["name"] for l in lines} == {"a", "b"}
+
+    def test_retention_bound(self):
+        t = Tracer(max_spans=10)
+        for i in range(25):
+            with t.span(f"s{i}"):
+                pass
+        assert len(t.finished_spans()) <= 10
+        assert t._dropped > 0
+
+    def test_noop_tracer_is_inert_and_leaves_messages_untouched(self):
+        t = NOOP_TRACER
+        m = Message("sync", 0, 1)
+        before = m.to_bytes()
+        with t.span("x") as s:
+            t.inject(m)  # must not add __trace__: disabled runs put
+            assert s.context is None  # bit-identical frames on the wire
+        assert TRACE_KEY not in m.get_params()
+        assert m.to_bytes() == before
+        assert t.extract(m) is None and t.current() is None
+        assert t.finished_spans() == [] and t.durations_by_name() == {}
+
+
+# -- registry --------------------------------------------------------------
+
+PROM_LINE = re.compile(
+    r"^(# HELP [a-zA-Z_:][a-zA-Z0-9_:]* .*"
+    r"|# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* (counter|gauge|histogram)"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? "
+    r"(-?[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?|[+-]Inf|NaN))$")
+
+
+class TestRegistry:
+    def test_counter_gauge_histogram_with_labels(self):
+        r = MetricsRegistry()
+        r.inc("wire_bytes_total", 10, transport="tcp", direction="sent")
+        r.inc("wire_bytes_total", 5, transport="tcp", direction="sent")
+        r.set_gauge("alive_clients", 7)
+        r.observe("round_seconds", 0.2)
+        r.observe("round_seconds", 3.0)
+        assert r.get("wire_bytes_total", transport="tcp",
+                     direction="sent") == 15
+        assert r.get("alive_clients") == 7
+        assert r.get("round_seconds") == (3.2, 2)
+
+    def test_type_conflict_and_bad_name_raise(self):
+        r = MetricsRegistry()
+        r.inc("x_total")
+        with pytest.raises(ValueError):
+            r.set_gauge("x_total", 1)
+        with pytest.raises(ValueError):
+            r.inc("bad name")
+        with pytest.raises(ValueError):
+            r.inc("neg_total", -1)
+
+    def test_prometheus_exposition_grammar(self):
+        r = MetricsRegistry()
+        r.inc("wire_bytes_total", 10, help="bytes", transport="tcp")
+        r.set_gauge("alive", 3.5, help="who lives")
+        r.set_gauge("ratio", float("nan"))  # must render 'NaN', not 'nan'
+        r.observe("lat_seconds", 0.007, help="latency")
+        text = r.render_prometheus()
+        for line in text.strip().split("\n"):
+            assert PROM_LINE.match(line), line
+        # histogram: cumulative buckets end at +Inf == count
+        assert 'lat_seconds_bucket{le="+Inf"} 1' in text
+        assert "lat_seconds_count 1" in text
+
+    def test_snapshot_into_emits_only_deltas(self):
+        r = MetricsRegistry()
+        r.inc("a_total", 3)
+        rec = r.snapshot_into({"round": 0})
+        assert rec["m/a_total"] == 3
+        rec2 = r.snapshot_into({"round": 1})  # unchanged: not re-emitted
+        assert "m/a_total" not in rec2
+        r.inc("a_total", 2)
+        rec3 = r.snapshot_into({"round": 2})
+        assert rec3["m/a_total"] == 5
+
+    def test_metrics_logger_snapshots_registry_per_record(self, tmp_path):
+        run_dir = str(tmp_path / "run")
+        with enable(trace=True, trace_dir=str(tmp_path),
+                    compile_events=False):
+            logger = MetricsLogger(run_dir=run_dir)
+            get_registry().inc("demo_total", 4)
+            logger({"round": 0})
+            logger.close()
+        recs = [json.loads(l)
+                for l in open(os.path.join(run_dir, "metrics.jsonl"))]
+        assert recs[0]["m/demo_total"] == 4
+        prom = open(os.path.join(tmp_path, "metrics.prom")).read()
+        assert "demo_total 4" in prom
+
+
+# -- flight recorder -------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_ring_bound_and_dump(self, tmp_path):
+        fr = FlightRecorder(out_dir=str(tmp_path), capacity=8)
+        for i in range(20):
+            fr.record("send", seq_no=i)
+        path = fr.dump("peer_lost", extra={"peer": 3})
+        events = [json.loads(l) for l in open(path)]
+        # bounded: only the 8 newest survive, plus the dump_info trailer
+        assert len(events) == 9
+        assert events[0]["seq_no"] == 12 and events[-2]["seq_no"] == 19
+        assert events[-1]["kind"] == "dump_info"
+        assert os.path.basename(path) == "flightrec_peer_lost.jsonl"
+
+    def test_repeat_reasons_suffix_and_max_dumps(self, tmp_path):
+        fr = FlightRecorder(out_dir=str(tmp_path), max_dumps=3)
+        fr.record("x")
+        p1 = fr.dump("crash")
+        p2 = fr.dump("crash")
+        p3 = fr.dump("peer_lost")
+        assert os.path.basename(p1) == "flightrec_crash.jsonl"
+        assert os.path.basename(p2) == "flightrec_crash_2.jsonl"
+        assert os.path.basename(p3) == "flightrec_peer_lost.jsonl"
+        assert fr.dump("crash") is None  # capped
+
+    def test_enable_scope_installs_and_restores_globals(self, tmp_path):
+        assert get_flight_recorder() is None
+        assert get_registry() is None
+        assert get_tracer() is NOOP_TRACER
+        with enable(trace=True, trace_dir=str(tmp_path), flightrec=True,
+                    compile_events=False) as obs:
+            assert get_flight_recorder() is obs.recorder
+            assert get_registry() is obs.registry
+            assert get_tracer() is obs.tracer
+        assert get_flight_recorder() is None
+        assert get_registry() is None
+        assert get_tracer() is NOOP_TRACER
+        assert os.path.exists(obs.chrome_path)
+        assert os.path.exists(obs.prom_path)
+
+
+# -- integration: the acceptance scenario ---------------------------------
+
+def _chaos(world=4, rounds=3, fault=True, deadline=1.0, **kw):
+    from fedml_tpu.resilience import (FaultPlan, FaultRule, RoundPolicy,
+                                      run_tcp_fedavg)
+
+    w0 = {"w": np.zeros((4, 4), np.float32), "b": np.ones(4, np.float32)}
+    plan = None
+    if fault:
+        plan = FaultPlan(seed=7, rules=(
+            FaultRule("kill", rank=3, msg_type="res_report", nth=2),
+            FaultRule("stall", rank=2, msg_type="res_report", nth=1,
+                      delay_s=4.0)))
+    return run_tcp_fedavg(world, rounds,
+                          RoundPolicy(deadline_s=deadline, quorum=0.3), w0,
+                          fault_plan=plan, join_timeout=90, **kw)
+
+
+class TestCrossRankTracing:
+    def test_chaos_run_stitches_spans_and_dumps_flight_recorder(
+            self, tmp_path):
+        d = str(tmp_path)
+        with enable(trace=True, trace_dir=d, flightrec=True,
+                    flightrec_dir=d, compile_events=False) as obs:
+            srv = _chaos()
+            spans = obs.tracer.finished_spans()
+        assert srv.failed is None and len(srv.history) == 3
+
+        rounds = {s.span_id: s for s in spans if s.name == "round"}
+        assert len(rounds) == 3
+        assert all(s.parent_id is None for s in rounds.values())
+        assert all(s.attrs.get("outcome") in ("complete", "degraded")
+                   for s in rounds.values())
+        # every client local-train span hangs under a server round span
+        # with the SAME trace id -- the Dapper stitch across ranks
+        lts = [s for s in spans if s.name == "local-train"]
+        assert lts, "client spans missing"
+        for s in lts:
+            assert s.parent_id in rounds, s.as_dict()
+            assert s.trace_id == rounds[s.parent_id].trace_id
+        # report-recv hangs under the client's report span
+        by_id = {s.span_id: s for s in spans}
+        recvs = [s for s in spans if s.name == "report-recv"]
+        assert recvs
+        for s in recvs:
+            assert by_id[s.parent_id].name == "report"
+
+        # exactly one flight-recorder dump TRIGGERED by the killed peer,
+        # identified by the dump_info trailer -- the ring's retained
+        # events (incl. the kill) also appear in any later dump, e.g.
+        # when the stalled client's wedged report outlives the run and
+        # observes the server's teardown as a lost peer.
+        kill_dumps = []
+        for p in obs.recorder.dumps:
+            events = [json.loads(l) for l in open(p)]
+            info = [e for e in events if e["kind"] == "dump_info"]
+            if info and info[-1].get("peer") == 3:
+                kill_dumps.append(events)
+        assert len(kill_dumps) == 1
+        events = kill_dumps[0]
+        assert any(e["kind"] == "peer_lost" and e.get("peer") == 3
+                   for e in events)
+        assert any(e["kind"] == "send" for e in events)
+        assert any(e["kind"] == "round_decision" for e in events)
+
+        # the exported Chrome trace parses with balanced B/E events
+        doc = json.load(open(obs.chrome_path))
+        evs = doc["traceEvents"]
+        assert sum(1 for e in evs if e.get("ph") == "B") == \
+            sum(1 for e in evs if e.get("ph") == "E") > 0
+        # registry absorbed the transports' wire counters
+        prom = open(obs.prom_path).read()
+        assert re.search(
+            r'comm_bytes_total\{direction="sent",transport="tcp"\} \d+',
+            prom)
+
+    def test_disabled_path_is_bitwise_identical(self):
+        # no faults, generous deadline: a deterministic scenario. The
+        # observability-enabled run must not perturb the protocol's
+        # arithmetic; the disabled run must equal a plain run bitwise.
+        srv_plain = _chaos(fault=False, deadline=30.0)
+        with enable(trace=True, flightrec=True, compile_events=False):
+            srv_obs = _chaos(fault=False, deadline=30.0)
+        srv_off = _chaos(fault=False, deadline=30.0)
+        assert srv_plain.reporting_log == srv_obs.reporting_log \
+            == srv_off.reporting_log
+        for a, b, c in zip(srv_plain.history, srv_obs.history,
+                           srv_off.history):
+            for k in a:
+                assert (a[k] == b[k]).all(), k
+                assert (a[k] == c[k]).all(), k
+
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_crash_hook_dumps_on_thread_exception(self, tmp_path):
+        with enable(flightrec=True, flightrec_dir=str(tmp_path),
+                    compile_events=False) as obs:
+            obs.recorder.record("send", type="sync")
+
+            def boom():
+                raise RuntimeError("injected worker crash")
+
+            th = threading.Thread(target=boom)
+            th.start()
+            th.join()
+        crash = [p for p in obs.recorder.dumps if "crash" in p]
+        assert len(crash) == 1
+        events = [json.loads(l) for l in open(crash[0])]
+        assert any(e["kind"] == "crash"
+                   and "injected worker crash" in e.get("error", "")
+                   for e in events)

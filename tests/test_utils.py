@@ -55,6 +55,88 @@ def test_init_logging_format_includes_process_tag(caplog):
 def test_profile_trace_disabled_noop(tmp_path):
     with profile_trace(str(tmp_path), enabled=False):
         pass  # must not start the profiler
+    assert not os.listdir(tmp_path)  # nothing was written
+
+
+def test_profile_trace_none_log_dir_noop():
+    # enabled but no directory: the argparse wiring's default -- still a
+    # clean no-op, not a crash or a trace to a None path
+    with profile_trace(None, enabled=True):
+        pass
+
+
+def test_metrics_logger_flushes_residual_wire_bytes_on_close(tmp_path):
+    """count_wire attaches to the NEXT record; a run ending between
+    count_wire and log() must not silently drop the accumulated bytes --
+    close() flushes them as a final record."""
+    run_dir = str(tmp_path / "run")
+    logger = MetricsLogger(run_dir=run_dir)
+    logger({"round": 0, "Train/Acc": 0.5})
+    logger.count_wire(1000, raw_bytes=4000)
+    logger.count_wire(24)  # ...and the run ends here
+    logger.close()
+    lines = [json.loads(line) for line in
+             open(os.path.join(run_dir, "metrics.jsonl"))]
+    assert len(lines) == 2
+    final = lines[-1]
+    assert final["event"] == "wire_flush_at_close"
+    assert final["bytes_on_wire"] == 1024
+    assert final["compression_ratio"] == round(4000 / 1024, 3)
+    # idempotent: a double close must not emit a second flush record
+    logger.close()
+    lines = [json.loads(line) for line in
+             open(os.path.join(run_dir, "metrics.jsonl"))]
+    assert len(lines) == 2
+
+
+def test_metrics_logger_no_flush_record_when_nothing_pending(tmp_path):
+    run_dir = str(tmp_path / "run")
+    logger = MetricsLogger(run_dir=run_dir)
+    logger.count_wire(512)
+    logger({"round": 0})  # consumed here, per-round as usual
+    logger.close()
+    lines = [json.loads(line) for line in
+             open(os.path.join(run_dir, "metrics.jsonl"))]
+    assert len(lines) == 1 and lines[0]["bytes_on_wire"] == 512
+
+
+def test_annotate_step_usable_under_jit():
+    from fedml_tpu.utils.profiling import annotate_step
+
+    @jax.jit
+    def f(x):
+        return x * 2
+
+    with annotate_step(0):
+        out = f(jnp.ones(4))
+    np.testing.assert_allclose(np.asarray(out), 2 * np.ones(4))
+
+
+def test_compile_watcher_counts_exactly_one_compile_on_shape_change():
+    """The fedtrace compile-event listener (observability.jaxmon): a
+    shape change is exactly one new compile in the next round's bucket;
+    a cache-hit round is zero."""
+    from fedml_tpu.observability.jaxmon import watch_compiles
+    from fedml_tpu.utils.profiling import end_of_round_sync
+
+    @jax.jit
+    def step(x):
+        return x * 2.0
+
+    # inputs built OUTSIDE the watch: jnp.ones itself compiles a fill
+    # program per shape, which would double-count the shape-change round
+    x3, x5 = jnp.ones(3), jnp.ones(5)
+    with watch_compiles() as w:
+        end_of_round_sync(step(x3))   # round 0: warm-up compile
+        end_of_round_sync(step(x3))   # round 1: cache hit
+        end_of_round_sync(step(x5))   # round 2: shape change
+    assert w.rounds == 3
+    assert w.compiles_per_round[0] >= 1
+    assert w.compiles_per_round[1] == 0
+    assert w.compiles_per_round[2] == 1
+    assert w.compile_seconds_per_round[2] > 0
+    rep = w.report()
+    assert rep["compile/total_compiles"] == sum(w.compiles_per_round)
 
 
 def _tiny_state(seed=0):
