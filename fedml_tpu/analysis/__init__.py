@@ -28,17 +28,27 @@ transport code that swallows errors. Two halves:
 - :mod:`fedml_tpu.analysis.protocol` / :mod:`fedml_tpu.analysis.concurrency`
   -- "fedcheck", the control-plane passes: FSM protocol verification
   (FL120 sent-but-unhandled, FL121 missing peer-lost handler, FL122 dead
-  handler) and thread-safety rules (FL123 unguarded shared state, FL124
-  lock-order cycles, FL125 blocking under a state lock).
+  handler, FL127 silent dead-end handler paths, FL128 payload-schema
+  read/set mismatches) and thread-safety rules (FL123 unguarded shared
+  state, FL124 lock-order cycles, FL125 blocking under a state lock).
+- :mod:`fedml_tpu.analysis.crossclass` -- the fedcheck v2 interprocedural
+  generation (FL126): a callgraph through attribute-typed fields
+  (``self.com_manager``, controller callbacks) propagating held-lock
+  sets across class boundaries -- cross-class lock-order cycles and
+  held-while-blocking chains, on the same creation-site lock identities
+  the runtime sanitizer and flight recorder report.
 - :mod:`fedml_tpu.analysis.locks` -- analysis-facing re-export of the
   cooperative lock factories (implemented in the stdlib-only leaf
   :mod:`fedml_tpu.core.locks`, so transports don't import the analysis
   machinery): ``audited_lock`` / ``audited_rlock`` state locks,
   ``io_lock`` send-serialization locks -- plain ``threading`` primitives
-  normally, instrumented inside ``race_audit()``.
+  normally, instrumented inside ``race_audit()``; plus
+  ``creation_site()``, the shared lock-identity helper.
 """
 
+from fedml_tpu.analysis.crossclass import CrossClassIndex, check_crossclass
 from fedml_tpu.analysis.dataflow import (ProjectIndex, infer_donate_argnums,
+                                         infer_donate_argnums_from_body,
                                          plan_donation_fixes)
 from fedml_tpu.analysis.linter import (Finding, RULES, lint_paths,
                                        lint_source)
@@ -46,6 +56,8 @@ from fedml_tpu.analysis.runtime import (RaceAuditor, RuntimeAuditor, audit,
                                         current_auditor, race_audit)
 
 __all__ = ["Finding", "RULES", "lint_paths", "lint_source",
-           "ProjectIndex", "infer_donate_argnums", "plan_donation_fixes",
+           "ProjectIndex", "infer_donate_argnums",
+           "infer_donate_argnums_from_body", "plan_donation_fixes",
+           "CrossClassIndex", "check_crossclass",
            "RuntimeAuditor", "audit", "current_auditor",
            "RaceAuditor", "race_audit"]

@@ -21,6 +21,7 @@ import argparse
 import ast
 import os
 import sys
+import time
 
 from fedml_tpu.analysis.linter import (RULES, _Aliases, apply_baseline,
                                        iter_python_files, lint_paths,
@@ -74,8 +75,16 @@ def main(argv=None):
     parser.add_argument("--diff", action="store_true",
                         help="with --fix: print the unified diff and "
                              "write nothing (exit 1 if fixes are pending)")
+    parser.add_argument("--max-seconds", type=float, default=None,
+                        metavar="S",
+                        help="wall-time budget for the whole run: exit "
+                             "non-zero when the project-wide passes took "
+                             "longer (ci.sh pins this so the "
+                             "interprocedural passes cannot silently "
+                             "regress lint latency as the tree grows)")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
+    t0 = time.monotonic()
 
     if args.list_rules:
         for code, (title, rationale) in sorted(RULES.items()):
@@ -90,10 +99,14 @@ def main(argv=None):
 
     if args.fix:
         try:
-            return run_fix(paths, diff=args.diff)
+            rc = run_fix(paths, diff=args.diff)
         except OSError as e:
             print(f"fedlint: {e}", file=sys.stderr)
             return 2
+        # the budget covers the whole run, fixer path included: its
+        # project-wide FL110 caller simulation is as interprocedural as
+        # the lint passes and must not drift unbounded either
+        return rc or _check_budget(args, t0)
     try:
         findings = lint_paths(paths, select=args.select, ignore=args.ignore)
     except OSError as e:
@@ -121,7 +134,26 @@ def main(argv=None):
         print(render_sarif(findings))
     else:
         print(render_text(findings, show_baselined=args.show_baselined))
+    if _check_budget(args, t0):
+        return 1
     return 1 if new else 0
+
+
+def _check_budget(args, t0):
+    """Enforce ``--max-seconds`` (0 = within budget / disabled, 1 =
+    blown): the CI gate's guard against interprocedural passes silently
+    regressing wall time as the tree grows."""
+    if args.max_seconds is None:
+        return 0
+    elapsed = time.monotonic() - t0
+    print(f"fedlint: wall time {elapsed:.1f}s "
+          f"(budget {args.max_seconds:.1f}s)", file=sys.stderr)
+    if elapsed > args.max_seconds:
+        print("fedlint: wall-time budget exceeded -- an "
+              "interprocedural pass regressed lint latency",
+              file=sys.stderr)
+        return 1
+    return 0
 
 
 def run_fix(paths, diff=False):

@@ -62,6 +62,16 @@ _BLOCKING_NAMES = {"_send_frame", "_recv_frame", "send_with_retry"}
 #: handler-thread roots by protocol convention.
 _NAMED_ROOTS = {"receive_message", "handle_receive_message"}
 
+#: Public aliases: the cross-class pass (``analysis.crossclass``, FL126)
+#: shares this pass's vocabulary -- lock-constructor classification and
+#: the blocking-call tables -- so the two generations can never disagree
+#: about what blocks or what is a state lock.
+STATE_CTORS = _STATE_CTORS
+IO_CTORS = _IO_CTORS
+BLOCKING_ATTRS = _BLOCKING_ATTRS
+BLOCKING_NAMES = _BLOCKING_NAMES
+NAMED_ROOTS = _NAMED_ROOTS
+
 
 class _Access:
     __slots__ = ("method", "attr", "kind", "held", "node")
@@ -427,4 +437,5 @@ def _header_exprs(stmt):
     return []
 
 
-__all__ = ["check_concurrency", "find_lock_cycles"]
+__all__ = ["check_concurrency", "find_lock_cycles", "STATE_CTORS",
+           "IO_CTORS", "BLOCKING_ATTRS", "BLOCKING_NAMES", "NAMED_ROOTS"]

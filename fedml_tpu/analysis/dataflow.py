@@ -78,6 +78,151 @@ def infer_donate_argnums(func):
     return tuple(out)
 
 
+def infer_donate_argnums_from_body(func):
+    """Donation candidates from the function *body*: the positional
+    params whose values flow into the returned pytree (XLA can only
+    alias a donated buffer into an output it feeds). Returns the tuple,
+    or ``None`` when the body evidence is ambiguous and the caller
+    should fall back to the name heuristic
+    (:data:`NONDONATABLE_SEGMENTS`).
+
+    The flow is an ordered taint walk: every name is tainted by the
+    params reachable through the expressions assigned to it (calls
+    over-approximate -- an argument taints the result), and the union of
+    taints over all ``return`` values is the donation set. Ambiguous --
+    judged too risky to replace the name heuristic -- means: ``*args``/
+    ``**kwargs`` (the positional index space is open), a nested
+    def/lambda (a closure can smuggle a param past the linear walk), or
+    no returned value at all (no output to alias into)."""
+    a = func.args
+    if a.vararg is not None or a.kwarg is not None:
+        return None
+    if isinstance(func, ast.Lambda):
+        body_stmts = [ast.Return(value=func.body)]
+    else:
+        body_stmts = func.body
+    for node in ast.walk(func):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not func:
+            return None
+    params = _positional_params(func)
+    idx = {name: i for i, name in enumerate(params) if name != "self"}
+    env = {name: {i} for name, i in idx.items()}
+    returned = set()
+    saw_return = []
+
+    def taint(expr):
+        out = set()
+        if expr is None:
+            return out
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name) and node.id in env:
+                out |= env[node.id]
+        return out
+
+    def targets(tgt, value_taint):
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                targets(e, value_taint)
+        elif isinstance(tgt, ast.Starred):
+            targets(tgt.value, value_taint)
+        elif isinstance(tgt, ast.Name):
+            env[tgt.id] = set(value_taint)
+
+    def loop_fix(body):
+        """Walk a loop body to a join-fixpoint: each round, the body is
+        re-walked and the result is UNIONED with the entry env (the
+        zero-iteration path keeps the pre-loop bindings, and a carried
+        chain -- `out = norm(tmp); tmp = mix(acc, x); acc = step(state)`
+        -- needs one round per link). Joined taints only grow, so the
+        loop terminates (capped defensively)."""
+        for _ in range(len(env) + len(body) * 4 + 2):
+            before = {k: set(v) for k, v in env.items()}
+            walk(body)
+            changed = False
+            for k in set(before) | set(env):
+                merged = before.get(k, set()) | env.get(k, set())
+                env[k] = merged
+                # convergence is judged against the ENTRY snapshot: the
+                # in-place strong updates already hold the new values
+                if merged != before.get(k, set()):
+                    changed = True
+            if not changed:
+                break
+
+    def branch_join(stmt):
+        """Walk each branch of an If/Try from a copy of the entry env
+        and union the outcomes (including the entry itself: a Try body
+        may execute partially, an If may lack an else)."""
+        entry = {k: set(v) for k, v in env.items()}
+        branches = [stmt.body] + ([stmt.orelse] if stmt.orelse else [])
+        branches += [h.body for h in getattr(stmt, "handlers", ())]
+        outcomes = [entry]
+        for body in branches:
+            env.clear()
+            env.update({k: set(v) for k, v in entry.items()})
+            walk(body)
+            outcomes.append({k: set(v) for k, v in env.items()})
+        env.clear()
+        for out in outcomes:
+            for k, v in out.items():
+                env.setdefault(k, set()).update(v)
+        final = getattr(stmt, "finalbody", None)
+        if final:
+            walk(final)
+
+    def walk(stmts):
+        for stmt in stmts:
+            if isinstance(stmt, ast.Return):
+                if stmt.value is not None:
+                    saw_return.append(stmt)
+                returned.update(taint(stmt.value))
+            elif isinstance(stmt, ast.Assign):
+                t = taint(stmt.value)
+                for tgt in stmt.targets:
+                    targets(tgt, t)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets(stmt.target, taint(stmt.value))
+            elif isinstance(stmt, ast.AugAssign):
+                t = taint(stmt.value) | taint(stmt.target)
+                targets(stmt.target, t)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                targets(stmt.target, taint(stmt.iter))
+                loop_fix(stmt.body)
+                walk(stmt.orelse)
+                continue
+            elif isinstance(stmt, ast.While):
+                loop_fix(stmt.body)
+                walk(stmt.orelse)
+                continue
+            elif isinstance(stmt, (ast.If, ast.Try)):
+                # mutually exclusive branches walk from the SAME entry
+                # env and the outcomes union at the join point -- a
+                # sequential walk would let the else branch's strong
+                # updates overwrite what the if branch bound, dropping
+                # params that flow to the return on one path only
+                branch_join(stmt)
+                continue
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    if item.optional_vars is not None:
+                        targets(item.optional_vars,
+                                taint(item.context_expr))
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if isinstance(sub, list):
+                    walk(sub)
+            for handler in getattr(stmt, "handlers", ()):
+                walk(handler.body)
+
+    walk(body_stmts)
+    if not saw_return:
+        return None  # nothing returned: no output to alias into
+    # a returned value no param flows into is UNAMBIGUOUS evidence that
+    # donation aliases nothing: the empty tuple (the fixer skips)
+    return tuple(sorted(returned))
+
+
 def format_argnums(nums):
     inner = ", ".join(str(n) for n in nums)
     return f"({inner},)" if len(nums) == 1 else f"({inner})"
@@ -697,10 +842,25 @@ def plan_donation_fixes(path, src, index=None):
         line_codes = per_line.get(site.site.lineno, set()) | per_file
         if "*" in line_codes or "FL104" in line_codes:
             continue
-        donate = infer_donate_argnums(func)
-        if not donate:
-            plan.skipped.append((site.site.lineno, name,
-                                 "no donation-eligible positional params"))
+        # body evidence first: the params that actually flow into the
+        # returned pytree are the only buffers XLA can alias, so where
+        # that evidence is unambiguous it replaces the name heuristic
+        # (NONDONATABLE_SEGMENTS) in both directions -- donating a
+        # state-like-named param the body never returns buys nothing,
+        # and a returned param with a data-like name is aliasable (the
+        # project-wide FL110 simulation below still guards the caller)
+        donate = infer_donate_argnums_from_body(func)
+        if donate is None:
+            donate = infer_donate_argnums(func)
+            if not donate:
+                plan.skipped.append(
+                    (site.site.lineno, name,
+                     "no donation-eligible positional params"))
+                continue
+        elif not donate:
+            plan.skipped.append(
+                (site.site.lineno, name,
+                 "no positional param flows into the returned pytree"))
             continue
         if index is not None and _fix_would_break_callers(
                 index, module, site.site.lineno, name, func, donate):
@@ -797,6 +957,7 @@ def render_fix_diff(plan):
 
 
 __all__ = ["NONDONATABLE_SEGMENTS", "infer_donate_argnums",
+           "infer_donate_argnums_from_body",
            "format_argnums", "donate_from_kwargs", "JitSymbol",
            "ProjectIndex", "check_use_after_donate", "plan_donation_fixes",
            "render_fix_diff", "FixPlan"]

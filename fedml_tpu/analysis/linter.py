@@ -164,7 +164,51 @@ RULES = {
         "buffer, dead socket) pin every thread that needs the lock. "
         "Serialize I/O with a dedicated io_lock() "
         "(fedml_tpu.analysis.locks) and keep state locks non-blocking."),
+    "FL126": (
+        "cross-class lock-order cycle or held-lock blocking chain",
+        "a call chain followed through attribute-typed fields "
+        "(self.com_manager, controller callbacks) either acquires locks "
+        "in a cycle no single class exhibits, or reaches a blocking "
+        "operation in another class while a state lock is held -- the "
+        "finish()-under-_advance_lock deadlock class that only the "
+        "runtime sanitizer used to catch. Lock identities are creation "
+        "sites (core/locks.creation_site), the same strings "
+        "race_audit() and the flight recorder report."),
+    "FL127": (
+        "FSM handler with a silent dead-end path",
+        "a registered message handler has an execution path that "
+        "neither replies, advances the round controller, terminates "
+        "(finish()/raise), nor logs the decision: the counterpart FSM "
+        "blocks forever on that path -- a silently hung round, the "
+        "temporal shape of FL120."),
+    "FL128": (
+        "payload key read/set mismatch between counterpart FSMs",
+        "a msg.get(key) read in a handler whose key no counterpart "
+        "Message.add() site sets returns None and corrupts the round "
+        "silently; a set key no counterpart handler reads is dead "
+        "bytes in every wire frame. Renamed keys produce both findings "
+        "as a pair."),
 }
+
+#: SARIF rule metadata: which analysis pass owns each rule (rendered as
+#: SARIF ``properties.tags`` so PR-annotation UIs can group findings).
+RULE_PASS = {
+    "FL120": "fedcheck-protocol", "FL121": "fedcheck-protocol",
+    "FL122": "fedcheck-protocol", "FL127": "fedcheck-protocol",
+    "FL128": "fedcheck-protocol",
+    "FL123": "fedcheck-concurrency", "FL124": "fedcheck-concurrency",
+    "FL125": "fedcheck-concurrency", "FL126": "fedcheck-concurrency",
+}
+
+
+def rule_tags(code):
+    """SARIF tags for one rule: the owning pass, plus the runtime
+    cross-reference for the rules whose findings the race sanitizer /
+    flight recorder mirror at runtime."""
+    tags = [RULE_PASS.get(code, "fedlint-jax")]
+    if code in ("FL124", "FL125", "FL126"):
+        tags.append("race-audit-crossref")
+    return tags
 
 #: FL112 only flags captures whose *static* element count is at least
 #: this (64 KiB of f32): closing over small constant tables is idiomatic.
@@ -1147,11 +1191,11 @@ def _lint_module(path, src, tree, index, select=None, ignore=None):
     return out
 
 
-def _protocol_findings(pindex, mod_info, select=None, ignore=None):
-    """Run the project-wide protocol pass (FL120-FL122) and attach each
-    finding to its owning module, honoring that module's suppressions.
+def _emitted_findings(run, mod_info, select=None, ignore=None):
+    """Collect findings from a project-wide pass that reports through an
+    ``emit(module, node, code, message)`` callback, attaching each to its
+    owning module and honoring that module's suppressions.
     ``mod_info``: dotted module name -> (rel path, src)."""
-    from fedml_tpu.analysis.protocol import check_protocol
     raw = []
 
     def emit(module, node, code, message):
@@ -1167,7 +1211,7 @@ def _protocol_findings(pindex, mod_info, select=None, ignore=None):
             col=getattr(node, "col_offset", 0) + 1, code=code,
             message=message, text=text)))
 
-    check_protocol(pindex, emit)
+    run(emit)
     out = []
     supp = {}
     for module, f in raw:
@@ -1179,9 +1223,25 @@ def _protocol_findings(pindex, mod_info, select=None, ignore=None):
     return out
 
 
+def _protocol_findings(pindex, mod_info, select=None, ignore=None):
+    """Project-wide protocol passes: FL120-FL122 plus the v2 sequencing
+    (FL127) and payload-schema (FL128) checks."""
+    from fedml_tpu.analysis.protocol import check_protocol
+    return _emitted_findings(lambda emit: check_protocol(pindex, emit),
+                             mod_info, select=select, ignore=ignore)
+
+
+def _crossclass_findings(cindex, mod_info, select=None, ignore=None):
+    """Project-wide cross-class concurrency pass (FL126)."""
+    from fedml_tpu.analysis.crossclass import check_crossclass
+    return _emitted_findings(lambda emit: check_crossclass(cindex, emit),
+                             mod_info, select=select, ignore=ignore)
+
+
 def lint_source(src, path="<string>", select=None, ignore=None):
     """Lint one module's source (project-wide rules see only this one
     module). Returns non-suppressed findings."""
+    from fedml_tpu.analysis.crossclass import CrossClassIndex
     from fedml_tpu.analysis.dataflow import ProjectIndex
     from fedml_tpu.analysis.protocol import ProtocolIndex
     try:
@@ -1193,11 +1253,15 @@ def lint_source(src, path="<string>", select=None, ignore=None):
     index.add_module(path, tree, _Aliases(tree))
     pindex = ProtocolIndex()
     pindex.add_module(path, tree)
+    cindex = CrossClassIndex()
+    cindex.add_module(path, tree)
+    mod_info = {ProtocolIndex.module_name(path): (path, src)}
     findings = _lint_module(path, src, tree, index, select=select,
                             ignore=ignore)
-    findings += _protocol_findings(
-        pindex, {ProtocolIndex.module_name(path): (path, src)},
-        select=select, ignore=ignore)
+    findings += _protocol_findings(pindex, mod_info, select=select,
+                                   ignore=ignore)
+    findings += _crossclass_findings(cindex, mod_info, select=select,
+                                     ignore=ignore)
     findings.sort(key=lambda f: (f.line, f.col, f.code))
     return findings
 
@@ -1220,12 +1284,15 @@ def lint_paths(paths, select=None, ignore=None):
     cross-module symbol tables (jit/donation contracts travel through
     builder returns and imports; protocol constants and FSM classes
     through import edges); pass 2 runs the per-module rules with the jit
-    index in scope, then the project-wide protocol pass over the whole
-    fileset."""
+    index in scope, then the project-wide protocol (FL120-FL122,
+    FL127/FL128) and cross-class concurrency (FL126) passes over the
+    whole fileset."""
+    from fedml_tpu.analysis.crossclass import CrossClassIndex
     from fedml_tpu.analysis.dataflow import ProjectIndex
     from fedml_tpu.analysis.protocol import ProtocolIndex
     index = ProjectIndex()
     pindex = ProtocolIndex()
+    cindex = CrossClassIndex()
     modules, findings = [], []
     mod_info = {}
     for path in iter_python_files(paths):
@@ -1241,6 +1308,7 @@ def lint_paths(paths, select=None, ignore=None):
             continue
         index.add_module(rel, tree, _Aliases(tree))
         pindex.add_module(rel, tree)
+        cindex.add_module(rel, tree)
         mod_info[ProtocolIndex.module_name(rel)] = (rel, src)
         modules.append((rel, src, tree))
     for rel, src, tree in modules:
@@ -1248,6 +1316,8 @@ def lint_paths(paths, select=None, ignore=None):
                                      ignore=ignore))
     findings.extend(_protocol_findings(pindex, mod_info, select=select,
                                        ignore=ignore))
+    findings.extend(_crossclass_findings(cindex, mod_info, select=select,
+                                         ignore=ignore))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
     return findings
 
@@ -1326,6 +1396,7 @@ def render_sarif(findings):
         "shortDescription": {"text": title},
         "fullDescription": {"text": rationale},
         "defaultConfiguration": {"level": "warning"},
+        "properties": {"tags": rule_tags(code)},
     } for code, (title, rationale) in sorted(catalog.items())]
     rule_index = {r["id"]: i for i, r in enumerate(rules)}
     results = []

@@ -8,6 +8,7 @@ analysis namespace (the rule messages and docs reference them here), and
 setting ``fedml_tpu.core.locks._auditor``.
 """
 
-from fedml_tpu.core.locks import audited_lock, audited_rlock, io_lock
+from fedml_tpu.core.locks import (audited_lock, audited_rlock,
+                                  creation_site, io_lock)
 
-__all__ = ["audited_lock", "audited_rlock", "io_lock"]
+__all__ = ["audited_lock", "audited_rlock", "io_lock", "creation_site"]

@@ -33,6 +33,29 @@ Unresolvable types (computed strings, caller-supplied parameters) judge
 nothing, and transport-reserved types (``__``-prefixed: peer-lost,
 goodbye, stop) are synthesized by the transports, not sent by FSMs, so
 they are exempt from FL120/FL122.
+
+The v2 generation adds the *temporal* and *payload* halves of the same
+model (``docs/ANALYSIS.md`` "Cross-class callgraph" section):
+
+- **FL127** -- FSM sequencing: a registered handler with an execution
+  path that neither replies (``send_message``/``send_with_retry``),
+  advances the round controller (a call on a ``*Controller``-constructed
+  field), terminates (``finish()``/``raise``), transitively does one of
+  those through a same-class helper, nor *logs the decision to stand
+  pat* -- today that path is a silently hung round, the temporal shape
+  of FL120. An explicitly logged ignore (the client shrugging off a
+  sibling's death) is a decision, not a silence, and passes.
+- **FL128** -- payload schema: every literal ``msg.get("key")`` /
+  ``msg["key"]`` read in a handler is checked against the keys the
+  counterpart role's ``Message(TYPE, ...)`` build sites actually
+  ``add()``. A read key no counterpart sets is a silent ``None``
+  (read-never-set); a set key no counterpart handler reads is dead wire
+  bytes (set-never-read) -- which matters at the compressed frame sizes
+  the codec buys. Judged only when the evidence is closed: resolvable
+  type, literal add keys, and (for set-never-read) handlers whose
+  message parameter never escapes to calls the pass cannot see.
+  Reserved keys (``msg_type``/``sender``/``receiver``, ``__``-prefixed
+  control fields like the tracer's ``__trace__``) are exempt.
 """
 
 from __future__ import annotations
@@ -59,6 +82,26 @@ _RESERVED_PREFIX = "__"
 _SEND_FUNCS = {"send_message", "send_with_retry"}
 _REGISTER = "register_message_receive_handler"
 
+#: Envelope-reserved payload keys: set by the Message constructor or the
+#: transports/tracer, never by FSM ``add()`` sites -- exempt from FL128.
+_RESERVED_KEYS = {"msg_type", "sender", "receiver"}
+
+#: Methods a handler may call on its message parameter without the
+#: parameter "escaping" static view (FL128 set-never-read soundness).
+_MSG_SELF_METHODS = {"get", "get_params", "get_sender_id",
+                     "get_receiver_id", "get_type", "to_string"}
+
+#: Callees a built Message may flow into without opening its schema:
+#: delivery itself, the tracer (adds only the reserved ``__trace__``),
+#: and container plumbing.
+_BENIGN_MSG_SINKS = {"send_message", "send_with_retry", "inject", "append"}
+
+#: Logging-call shapes: an explicitly logged no-op path is a decision,
+#: not a silent hang (FL127).
+_LOG_ROOTS = {"logging", "logger", "log", "warnings"}
+_LOG_ATTRS = {"warning", "error", "exception", "info", "debug", "warn",
+              "critical"}
+
 
 class _TypeRef:
     """One message-type reference: the syntactic name (if any), the
@@ -72,6 +115,20 @@ class _TypeRef:
         self.node = node
 
 
+class _MsgBuild:
+    """One ``Message(TYPE, ...)`` build site and its observed payload:
+    the literal keys ``add()``-ed to it, and whether the schema is *open*
+    (a non-literal key, or the message escaping into a call the pass
+    cannot see may add more)."""
+
+    __slots__ = ("type_ref", "keys", "open")
+
+    def __init__(self, type_ref):
+        self.type_ref = type_ref
+        self.keys = {}     # key -> add-call node
+        self.open = False
+
+
 class _FsmClass:
     """Protocol surface of one class: bases, handled and sent types."""
 
@@ -83,6 +140,9 @@ class _FsmClass:
         self.handled = []  # [_TypeRef]
         self.sent = []     # [_TypeRef]
         self.registers_any = False
+        self.handler_map = []      # (TypeRef, handler method name)
+        self.builds = []           # [_MsgBuild] (send-capable classes)
+        self.controller_attrs = set()  # fields built from *Controller(...)
 
 
 def _base_name(node):
@@ -147,6 +207,17 @@ class _ModuleProtocol:
         fsm = _FsmClass(self.module, node)
         class_sends = False
         for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) \
+                    and isinstance(sub.value, ast.Call):
+                cf = sub.value.func
+                cname = cf.attr if isinstance(cf, ast.Attribute) else (
+                    cf.id if isinstance(cf, ast.Name) else None)
+                if cname is not None and cname.endswith("Controller"):
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Attribute) \
+                                and isinstance(tgt.value, ast.Name) \
+                                and tgt.value.id == "self":
+                            fsm.controller_attrs.add(tgt.attr)
             if not isinstance(sub, ast.Call):
                 continue
             f = sub.func
@@ -155,11 +226,20 @@ class _ModuleProtocol:
             if fname == _REGISTER and sub.args:
                 fsm.registers_any = True
                 fsm.handled.append(_type_expr_ref(sub.args[0], sub))
+                if len(sub.args) > 1 \
+                        and isinstance(sub.args[1], ast.Attribute) \
+                        and isinstance(sub.args[1].value, ast.Name) \
+                        and sub.args[1].value.id == "self":
+                    fsm.handler_map.append(
+                        (_type_expr_ref(sub.args[0], sub),
+                         sub.args[1].attr))
             elif fname in _SEND_FUNCS:
                 class_sends = True
         for meth in node.body:
             if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 fsm.sent.extend(_sent_types(meth, class_sends))
+                if class_sends:
+                    fsm.builds.extend(_extract_builds(meth))
         return fsm
 
 
@@ -184,6 +264,240 @@ def _sent_types(func, class_sends):
         if name == "Message" and node.args:
             sent.append(_type_expr_ref(node.args[0], node))
     return sent
+
+
+def _extract_builds(meth):
+    """``Message(TYPE, ...)`` build sites in one method with their
+    ``add()``-ed literal keys (FL128's send-side schema). A non-literal
+    key, or the message variable flowing into a call outside the benign
+    sinks (delivery, tracer inject, container append), opens the schema:
+    the pass then refuses to judge read-never-set for that type."""
+    builds = {}       # id(Message call node) -> _MsgBuild
+    var_builds = {}   # local var name -> _MsgBuild
+    for node in ast.walk(meth):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if name == "Message" and node.args:
+            builds[id(node)] = _MsgBuild(_type_expr_ref(node.args[0], node))
+    for node in ast.walk(meth):
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call) \
+                and id(node.value) in builds:
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    var_builds[tgt.id] = builds[id(node.value)]
+    if var_builds:
+        for node in ast.walk(meth):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id in var_builds \
+                    and f.attr in ("add", "add_params"):
+                b = var_builds[f.value.id]
+                if node.args and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    b.keys.setdefault(node.args[0].value, node)
+                else:
+                    b.open = True
+                continue
+            # escape analysis: the built message flowing into an
+            # unknown call may gain keys this pass cannot see
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if name in _BENIGN_MSG_SINKS or name == "Message":
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name) and sub.id in var_builds:
+                        var_builds[sub.id].open = True
+    return list(builds.values())
+
+
+def _handler_reads(meth):
+    """Literal payload reads of a handler's message parameter ->
+    ``(reads {key: node}, transparent)``. ``transparent`` is False when
+    the handler's reads are not fully visible to this pass: the
+    parameter escapes (passed to a call, aliased, rebound), a dynamic
+    read hides the key (``msg.get(var)``, ``msg.get_params()`` -- the
+    whole dict walks away), or the message is subscript-written (the
+    handler mutates/forwards it). Set-never-read judgments are then
+    suppressed for its type."""
+    params = [a.arg for a in meth.args.args]
+    if meth.args.vararg or meth.args.kwarg or len(params) < 2:
+        return {}, False
+    msg = params[1]  # (self, msg, ...)
+    reads, allowed = {}, set()
+    opaque = False
+    for node in ast.walk(meth):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == msg:
+            if node.func.attr not in _MSG_SELF_METHODS:
+                continue  # method outside the read surface: escape below
+            allowed.add(id(node.func.value))
+            if node.func.attr == "get":
+                if node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    reads.setdefault(node.args[0].value, node)
+                else:
+                    opaque = True  # dynamic key: a read we cannot see
+            elif node.func.attr in ("get_params", "to_string"):
+                # the whole payload dict escapes: any key may be read
+                opaque = True
+        elif isinstance(node, ast.Subscript) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == msg:
+            allowed.add(id(node.value))
+            if not isinstance(node.ctx, ast.Load):
+                opaque = True  # msg["k"] = v: mutation, not a read
+            elif isinstance(node.slice, ast.Constant) \
+                    and isinstance(node.slice.value, str):
+                reads.setdefault(node.slice.value, node)
+            else:
+                opaque = True  # msg[var]: dynamic read
+    transparent = not opaque
+    for node in ast.walk(meth):
+        # params are ast.arg nodes, so every Name here is a USE; any use
+        # outside the allowed read surface (call arg, alias, rebind)
+        # means the handler may read keys this pass cannot see
+        if isinstance(node, ast.Name) and node.id == msg \
+                and id(node) not in allowed:
+            transparent = False
+    return reads, transparent
+
+
+class _ActContext:
+    """FL127 act-resolution context: the *registering* class's view --
+    its own plus inherited methods (helpers on the base chain act too)
+    and the union of controller fields along that chain (a controller
+    assigned in a subclass __init__ counts for a base-class handler
+    running on that subclass's instances)."""
+
+    __slots__ = ("controller_attrs", "methods")
+
+    def __init__(self, controller_attrs, methods):
+        self.controller_attrs = controller_attrs
+        self.methods = methods
+
+
+def _call_acts(node, ctx, memo):
+    """Is this call an FL127 'act'? Reply, controller advance,
+    termination, logging, or an own/inherited helper that acts on all
+    of its own paths."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id in _SEND_FUNCS
+    if not isinstance(f, ast.Attribute):
+        return False
+    if f.attr in _SEND_FUNCS or f.attr == "finish":
+        return True
+    if f.attr in _LOG_ATTRS:
+        return True
+    root = f.value
+    if isinstance(root, ast.Name) and root.id in _LOG_ROOTS:
+        return True
+    if isinstance(root, ast.Attribute) and isinstance(root.value, ast.Name) \
+            and root.value.id == "self" \
+            and root.attr in ctx.controller_attrs:
+        return True  # self._controller.<anything>(...): round advance
+    if isinstance(root, ast.Name) and root.id == "self" \
+            and f.attr in ctx.methods:
+        return _method_acts(f.attr, ctx, memo)
+    return False
+
+
+def _expr_acts(expr, ctx, memo):
+    if expr is None:
+        return False
+    for node in ast.walk(expr):
+        if isinstance(node, (ast.Lambda,)):
+            continue
+        if isinstance(node, ast.Call) and _call_acts(node, ctx, memo):
+            return True
+    return False
+
+
+def _method_acts(name, ctx, memo):
+    if name in memo:
+        return memo[name]
+    memo[name] = False  # recursion guard: cycles do not prove acting
+    acts_all, exits_silent = _analyze_suite(ctx.methods[name].body, ctx,
+                                            memo)
+    memo[name] = acts_all and not exits_silent
+    return memo[name]
+
+
+def _analyze_suite(stmts, ctx, memo):
+    """FL127 path analysis over one suite -> ``(acts_all,
+    exits_silent)``: whether every path through the suite performs an act
+    before leaving, and whether any path *returns* without one."""
+    exits_silent = False
+    for stmt in stmts:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(stmt, ast.Raise):
+            return True, exits_silent  # termination is a decision
+        if isinstance(stmt, ast.Return):
+            acted = _expr_acts(stmt.value, ctx, memo)
+            return acted, exits_silent or not acted
+        if isinstance(stmt, ast.If):
+            if _expr_acts(stmt.test, ctx, memo):
+                return True, exits_silent
+            t_acts, t_exit = _analyze_suite(stmt.body, ctx, memo)
+            e_acts, e_exit = (_analyze_suite(stmt.orelse, ctx, memo)
+                              if stmt.orelse else (False, False))
+            exits_silent = exits_silent or t_exit or e_exit
+            if t_acts and e_acts and stmt.orelse:
+                return True, exits_silent
+            continue
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            if any(_expr_acts(i.context_expr, ctx, memo)
+                   for i in stmt.items):
+                return True, exits_silent
+            b_acts, b_exit = _analyze_suite(stmt.body, ctx, memo)
+            exits_silent = exits_silent or b_exit
+            if b_acts:
+                return True, exits_silent
+            continue
+        if isinstance(stmt, ast.Try):
+            f_acts, f_exit = _analyze_suite(stmt.finalbody, ctx, memo)
+            exits_silent = exits_silent or f_exit
+            if f_acts:
+                return True, exits_silent
+            b_acts, b_exit = _analyze_suite(stmt.body, ctx, memo)
+            h_results = [_analyze_suite(h.body, ctx, memo)
+                         for h in stmt.handlers]
+            exits_silent = exits_silent or b_exit \
+                or any(x for (_a, x) in h_results)
+            if b_acts and all(a for (a, _x) in h_results):
+                return True, exits_silent
+            continue
+        if isinstance(stmt, (ast.For, ast.While, ast.AsyncFor)):
+            # the header evaluates even on the zero-iteration path: an
+            # act in the iterable/test (a controller drain, a reply in
+            # the condition) covers every path through the loop
+            header = stmt.iter if isinstance(stmt, (ast.For, ast.AsyncFor)) \
+                else stmt.test
+            if _expr_acts(header, ctx, memo):
+                return True, exits_silent
+            # zero-iteration path: the body cannot guarantee an act
+            _b_acts, b_exit = _analyze_suite(stmt.body, ctx, memo)
+            exits_silent = exits_silent or b_exit
+            continue
+        # simple statement: any act call anywhere in it acts
+        if any(isinstance(n, ast.Call)
+               and _call_acts(n, ctx, memo)
+               for n in ast.walk(stmt)):
+            return True, exits_silent
+    return False, exits_silent
 
 
 class ProtocolIndex:
@@ -416,6 +730,158 @@ def check_protocol(index, emit):
                      "counterpart FSM ever sends that type -- dead "
                      "protocol state (renamed constant or deleted send "
                      "path?)")
+
+    _check_sequencing(index, fsms, emit)
+    _check_payload_schema(index, fsms, emit)
+
+
+def _resolve_handler(index, cls, mod, name):
+    """Handler method def + its defining (class, module): own methods
+    first, then FSM ancestors inside the fileset."""
+    own = {m.name: m for m in cls.node.body
+           if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    if name in own:
+        return cls, mod, own[name]
+    for acls, amod in index.ancestors(mod, cls.name):
+        for m in acls.node.body:
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and m.name == name:
+                return acls, amod, m
+    return None, None, None
+
+
+def _check_sequencing(index, fsms, emit):
+    """FL127: every registered handler must act -- reply, advance the
+    round controller, terminate, or log the decision -- on EVERY path.
+    A path that silently dead-ends is a hung round waiting to happen.
+
+    Act resolution uses the *registering* class's view: its own plus
+    inherited methods, and controller fields assigned anywhere on its
+    chain. A handler registered by several subclasses is reported only
+    when it is silent in EVERY registering context -- a controller
+    assigned in one subclass is an act on that subclass's instances."""
+    by_def = {}  # (omod, owner name, hname) -> [owner, omod, meth,
+    #              tref, [ctx, ...]]
+    for cls, mod, _role, _handled, _reg in fsms:
+        for (tref, hname) in cls.handler_map:
+            owner, omod, meth = _resolve_handler(index, cls, mod, hname)
+            if meth is None:
+                continue  # outside the fileset: judge nothing
+            methods = {}
+            ctrl = set()
+            for acls, _amod in ([(cls, mod)]
+                                + index.ancestors(mod, cls.name)):
+                ctrl |= acls.controller_attrs
+                for m in acls.node.body:
+                    if isinstance(m, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                        methods.setdefault(m.name, m)
+            ent = by_def.setdefault((omod, owner.name, hname),
+                                    [owner, omod, meth, tref, []])
+            ent[4].append(_ActContext(ctrl, methods))
+    for (owner, omod, meth, tref, ctxs) in by_def.values():
+        results = [_analyze_suite(meth.body, ctx, {}) for ctx in ctxs]
+        if any(acts_all and not exits_silent
+               for (acts_all, exits_silent) in results):
+            continue
+        tname = tref.name or tref.value or "?"
+        how = ("falls off the end" if not results[0][0]
+               else "returns early")
+        emit(omod, meth, "FL127",
+             f"handler `{owner.name}.{meth.name}` (registered for "
+             f"{tname}) has a path that {how} without replying, "
+             "advancing the round controller, terminating, or even "
+             "logging -- the counterpart FSM waits forever on that "
+             "path (a silently hung round, the temporal shape of "
+             "FL120). Send, advance, finish(), raise, or log the "
+             "decision on every path")
+
+
+def _check_payload_schema(index, fsms, emit):
+    """FL128: pair handler payload reads with the counterpart role's
+    ``Message.add()`` schemas for the same type."""
+    _WANT = {"server": ("client", "both"),
+             "client": ("server", "both"),
+             "both": ("server", "client", "both")}
+    # send-side schemas and read-side surfaces, resolved once per role
+    schemas = {}  # role -> type -> {"keys": {k: (mod, node)}, "open": bool}
+    readers = {}  # role -> type -> {"keys": {k: (mod, node)},
+    #                                "opaque": bool, "n": int}
+    for cls, mod, role, _handled, _reg in fsms:
+        for b in cls.builds:
+            t = _resolved(index, mod, b.type_ref)
+            if t is None or t.startswith(_RESERVED_PREFIX):
+                continue
+            ent = schemas.setdefault(role, {}).setdefault(
+                t, {"keys": {}, "open": False})
+            for k, node in b.keys.items():
+                ent["keys"].setdefault(k, (mod, node))
+            ent["open"] = ent["open"] or b.open
+        for (tref, hname) in cls.handler_map:
+            t = _resolved(index, mod, tref)
+            if t is None or t.startswith(_RESERVED_PREFIX) \
+                    or _is_peer_lost(index, mod, tref):
+                continue
+            ent = readers.setdefault(role, {}).setdefault(
+                t, {"keys": {}, "opaque": False, "n": 0})
+            ent["n"] += 1
+            owner, omod, meth = _resolve_handler(index, cls, mod, hname)
+            if meth is None:
+                ent["opaque"] = True
+                continue
+            reads, transparent = _handler_reads(meth)
+            ent["opaque"] = ent["opaque"] or not transparent
+            for k, node in reads.items():
+                ent["keys"].setdefault(k, (omod, node))
+
+    def merged(table, role):
+        out = {}
+        for r in _WANT[role]:
+            for t, ent in table.get(r, {}).items():
+                cur = out.setdefault(t, {"keys": {}, "open": False,
+                                         "opaque": False, "n": 0})
+                cur["keys"].update(ent["keys"])
+                cur["open"] = cur["open"] or ent.get("open", False)
+                cur["opaque"] = cur["opaque"] or ent.get("opaque", False)
+                cur["n"] += ent.get("n", 0)
+        return out
+
+    emitted = set()
+    for role in sorted(readers):
+        peer_schema = merged(schemas, role)
+        for t, ent in sorted(readers[role].items()):
+            sch = peer_schema.get(t)
+            if sch is None:
+                continue  # nothing sends the type at all: FL120's finding
+            for k, (kmod, knode) in sorted(ent["keys"].items()):
+                if k in _RESERVED_KEYS or k.startswith("__") \
+                        or k in sch["keys"] or sch["open"] \
+                        or ("r", t, k) in emitted:
+                    continue
+                emitted.add(("r", t, k))
+                emit(kmod, knode, "FL128",
+                     f"handler reads payload key '{k}' of message type "
+                     f"'{t}' but no counterpart build site ever add()s "
+                     "it -- msg.get() returns None and the round "
+                     "corrupts silently (renamed or missing key at the "
+                     "sender?)")
+    for role in sorted(schemas):
+        peer_reads = merged(readers, role)
+        for t, ent in sorted(schemas[role].items()):
+            rd = peer_reads.get(t)
+            if rd is None or rd["opaque"] or rd["n"] == 0:
+                continue  # unhandled type (FL120) or unseeable reads
+            for k, (kmod, knode) in sorted(ent["keys"].items()):
+                if k in _RESERVED_KEYS or k.startswith("__") \
+                        or k in rd["keys"] or ("s", t, k) in emitted:
+                    continue
+                emitted.add(("s", t, k))
+                emit(kmod, knode, "FL128",
+                     f"payload key '{k}' of message type '{t}' is set "
+                     "here but no counterpart handler ever reads it -- "
+                     "dead wire bytes in every frame (and a likely "
+                     "renamed key: the reader's half may be the FL128 "
+                     "read-never-set finding next to this one)")
 
 
 def _merge_role(a, b):

@@ -36,9 +36,9 @@ from __future__ import annotations
 
 import contextlib
 import logging
-import os
 import threading
-import traceback
+
+from fedml_tpu.core.locks import creation_site as _creation_site
 
 #: jax.monitoring event names (stable strings from jax._src.dispatch;
 #: hardcoded so the auditor never imports private modules at import time).
@@ -243,18 +243,6 @@ class _AuditedLock:
         if name == "_inner":  # not yet bound (unpickling-style paths)
             raise AttributeError(name)
         return getattr(self._inner, name)
-
-
-def _creation_site():
-    """file:line of the lock's creation, skipping the factory frames --
-    the stable identity lock-order edges aggregate on (per-peer send
-    locks are many instances of ONE site)."""
-    own = ("locks.py", "runtime.py")
-    for frame in reversed(traceback.extract_stack()[:-1]):
-        base = os.path.basename(frame.filename)
-        if base not in own:
-            return f"{base}:{frame.lineno}"
-    return "<unknown>"
 
 
 class RaceAuditor:

@@ -37,6 +37,13 @@ class BaseCommunicationManager(abc.ABC):
     - ``abort()``: die abruptly (no clean-shutdown handshake) so peers
       observe :data:`MSG_TYPE_PEER_LOST` -- the fault-injection harness's
       crash primitive.
+
+    The concrete backends are also the any-candidate set fedcheck's
+    cross-class pass (FL126) resolves ``self.com_manager`` to: a new
+    transport whose ``send_message``/``stop_receive_message`` blocks is
+    automatically part of every FSM's held-lock chain analysis, so a
+    blocking call reached under a manager's state lock fails lint, not
+    a chaos run.
     """
 
     @abc.abstractmethod

@@ -27,12 +27,32 @@ re-exports it as the analysis-facing surface.
 
 from __future__ import annotations
 
+import os
 import threading
+import traceback
 
 #: Armed by ``fedml_tpu.analysis.runtime.race_audit``; when set, the
 #: factories route through ``_auditor.make_lock`` so every lock created
 #: inside the audited region is instrumented.
 _auditor = None
+
+
+def creation_site():
+    """``basename.py:lineno`` of the statement creating a lock through
+    these factories, skipping the factory/instrumentation frames.
+
+    This string is THE lock identity everywhere: the runtime race
+    auditor's order edges and the flight recorder's
+    ``held_while_blocking`` events aggregate on it, and the static
+    cross-class pass (fedcheck FL126) derives the *same* string from the
+    AST (the lock-constructor call's line), so a static finding and the
+    runtime event it predicts name the same lock."""
+    own = ("locks.py", "runtime.py")
+    for frame in reversed(traceback.extract_stack()[:-1]):
+        base = os.path.basename(frame.filename)
+        if base not in own:
+            return f"{base}:{frame.lineno}"
+    return "<unknown>"
 
 
 def _make(kind, reentrant):
@@ -62,4 +82,4 @@ def io_lock():
     return _make("io", reentrant=False)
 
 
-__all__ = ["audited_lock", "audited_rlock", "io_lock"]
+__all__ = ["audited_lock", "audited_rlock", "io_lock", "creation_site"]

@@ -4,6 +4,16 @@ Parity with reference ``fedml_core/distributed/client/client_manager.py:12-64``
 and ``server/server_manager.py:11-57``: a handler registry keyed by message
 type, a blocking receive loop, and ``finish()``. The reference terminated via
 ``MPI.COMM_WORLD.Abort()``; here ``finish()`` stops the receive loop cleanly.
+
+Verifier contract (fedcheck, ``fedml_tpu/analysis/``): these class names
+are the FSM roots the protocol passes key roles on (FL120-FL122,
+FL127/FL128), ``receive_message``/``handle_receive_message`` are the
+handler-thread roots of the concurrency pass (FL123-FL125), and
+``self.com_manager`` is the archetypal attribute-typed field the
+cross-class pass (FL126) follows into the transports -- renaming any of
+them must update ``analysis/protocol.py``/``concurrency.py``/
+``crossclass.py`` in the same change, or the verifier goes silently
+blind to the control plane.
 """
 
 from __future__ import annotations

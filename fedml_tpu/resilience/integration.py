@@ -326,9 +326,11 @@ class ResilientFedAvgServer(ServerManager):
             done = done or self.failed is not None
         # finish() OUTSIDE the lock: it reaches the transport's STOP wave
         # (blocking per-peer socket writes) and must not pin the turnover
-        # lock every handler needs -- the race sanitizer's
-        # held-while-blocking check catches this cross-class chain that
-        # the class-local static FL125 cannot see
+        # lock every handler needs. The class-local static FL125 cannot
+        # see this cross-class chain; fedcheck FL126 (crossclass.py) now
+        # catches it at lint time -- reverting this shape is the pinned
+        # mutation fixture -- and the race sanitizer's
+        # held-while-blocking check remains the runtime backstop
         if done:
             self.finish()
             return

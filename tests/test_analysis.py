@@ -1592,3 +1592,833 @@ class TestRuntimeAuditor:
                 assert current_auditor() is inner
             assert current_auditor() is outer
         assert current_auditor() is None
+
+
+class TestCrossClass:
+    """FL126: the fedcheck v2 interprocedural pass -- cross-class
+    lock-order cycles and held-lock blocking chains."""
+
+    BLOCKING = (
+        "from fedml_tpu.core.locks import audited_lock, io_lock\n"
+        "class Transport:\n"
+        "    def __init__(self):\n"
+        "        self._send_lock = io_lock()\n"
+        "    def stop(self):\n"
+        "        with self._send_lock:\n"
+        "            self.sock.sendall(b'')\n"
+        "class Server:\n"
+        "    def __init__(self):\n"
+        "        self._lock = audited_lock()\n"
+        "        self.t = Transport()\n"
+        "    def on_report(self, msg):\n"
+        "        with self._lock:\n"
+        "            self.shutdown()\n"
+        "    def shutdown(self):\n"
+        "        self.t.stop()\n")
+
+    def test_fl126_blocking_chain_through_field(self):
+        found = lint_source(self.BLOCKING, path=LIB_PATH)
+        assert [f.code for f in found] == ["FL126"]
+        msg = found[0].message
+        # anchored at the call under the lock, citing the creation site
+        # and the blocking label reached two classes away
+        assert "`Server.on_report` calls `self.shutdown()`" in msg
+        assert "fake.py:10" in msg         # audited_lock() creation site
+        assert "sendall" in msg and "Transport" in msg
+
+    def test_fl126_negative_call_outside_lock(self):
+        src = self.BLOCKING.replace(
+            "        with self._lock:\n"
+            "            self.shutdown()\n",
+            "        with self._lock:\n"
+            "            pass\n"
+            "        self.shutdown()\n")
+        assert codes(src) == []
+
+    def test_fl126_negative_direct_blocking_stays_fl125(self):
+        # blocking directly under the class's own lock is the
+        # class-local FL125 finding, not a duplicate FL126
+        src = (
+            "from fedml_tpu.core.locks import audited_lock\n"
+            "class Server:\n"
+            "    def __init__(self):\n"
+            "        self._lock = audited_lock()\n"
+            "    def on_report(self, msg):\n"
+            "        with self._lock:\n"
+            "            self.sock.sendall(b'')\n")
+        assert codes(src) == ["FL125"]
+
+    CYCLE = (
+        "from fedml_tpu.core.locks import audited_lock\n"
+        "class Left:\n"
+        "    def __init__(self):\n"
+        "        self._la = audited_lock()\n"
+        "        self.peer = Right(self)\n"
+        "    def step(self):\n"
+        "        with self._la:\n"
+        "            self.peer.poke()\n"
+        "    def nudge(self):\n"
+        "        with self._la:\n"
+        "            pass\n"
+        "class Right:\n"
+        "    def __init__(self, owner):\n"
+        "        self._lb = audited_lock()\n"
+        "        self.owner = owner\n"
+        "    def poke(self):\n"
+        "        with self._lb:\n"
+        "            pass\n"
+        "    def kick(self):\n"
+        "        with self._lb:\n"
+        "            self.owner.nudge()\n")
+
+    def test_fl126_cross_class_cycle(self):
+        # Left holds la and takes Right's lb; Right holds lb and takes
+        # la back through the owner field -- neither class's AST alone
+        # shows the cycle (FL124 is silent), the global graph does
+        found = lint_source(self.CYCLE, path=LIB_PATH)
+        assert [f.code for f in found] == ["FL126"]
+        assert "cycle" in found[0].message
+        assert "fake.py:4" in found[0].message  # la's creation site
+        assert "fake.py:14" in found[0].message  # lb's creation site
+
+    def test_fl126_negative_consistent_cross_class_order(self):
+        src = self.CYCLE.replace(
+            "    def kick(self):\n"
+            "        with self._lb:\n"
+            "            self.owner.nudge()\n",
+            "    def kick(self):\n"
+            "        self.owner.nudge()\n")
+        assert codes(src) == []
+
+    def test_fl126_ctor_param_flow_through_super_init(self):
+        # the com_manager shape: the field is assigned in the BASE
+        # __init__ from a forwarded ctor param; its type comes from the
+        # instantiation site two classes away
+        src = (
+            "from fedml_tpu.core.locks import audited_lock\n"
+            "class Pipe:\n"
+            "    def send(self, b):\n"
+            "        self.sock.sendall(b)\n"
+            "class BaseMgr:\n"
+            "    def __init__(self, comm):\n"
+            "        self.comm = comm\n"
+            "    def flush(self):\n"
+            "        self.comm.send(b'')\n"
+            "class Sub(BaseMgr):\n"
+            "    def __init__(self, comm):\n"
+            "        super().__init__(comm)\n"
+            "        self._lock = audited_lock()\n"
+            "    def handler(self, msg):\n"
+            "        with self._lock:\n"
+            "            self.flush()\n"
+            "def build():\n"
+            "    p = Pipe()\n"
+            "    return Sub(p)\n")
+        found = lint_source(src, path=LIB_PATH)
+        assert [f.code for f in found] == ["FL126"]
+        assert "`Sub.handler` calls `self.flush()`" in found[0].message
+        # sever the flow: nobody instantiates Sub with a Pipe -> the
+        # field is untyped and the pass judges nothing
+        severed = src.replace("    p = Pipe()\n    return Sub(p)\n",
+                              "    return None\n")
+        assert codes(severed) == []
+
+    def test_fl126_callback_field_cycle_and_fixed_shape(self):
+        # the RoundController shape: a bound method handed to another
+        # class's constructor; invoking it UNDER that class's lock while
+        # the method takes its own class's lock closes a cycle
+        src = (
+            "from fedml_tpu.core.locks import audited_lock\n"
+            "class Ctl:\n"
+            "    def __init__(self, cb):\n"
+            "        self._cl = audited_lock()\n"
+            "        self._cb = cb\n"
+            "    def begin(self):\n"
+            "        with self._cl:\n"
+            "            pass\n"
+            "    def fire(self):\n"
+            "        with self._cl:\n"
+            "            self._cb()\n"
+            "class Srv:\n"
+            "    def __init__(self):\n"
+            "        self._sl = audited_lock()\n"
+            "        self.ctl = Ctl(self._advance)\n"
+            "    def _advance(self):\n"
+            "        with self._sl:\n"
+            "            self.ctl.begin()\n")
+        found = lint_source(src, path=LIB_PATH)
+        assert [f.code for f in found] == ["FL126"]
+        assert "cycle" in found[0].message
+        # the shipped fix shape: fire the callback OUTSIDE the lock
+        fixed = src.replace(
+            "    def fire(self):\n"
+            "        with self._cl:\n"
+            "            self._cb()\n",
+            "    def fire(self):\n"
+            "        with self._cl:\n"
+            "            cb = self._cb\n"
+            "        cb()\n")
+        assert codes(fixed) == []
+
+    def test_creation_site_identity_matches_runtime(self, tmp_path):
+        # satellite: the static FL126 lock identity and the runtime
+        # auditor's instrumented-lock identity are the SAME string, so a
+        # static finding and a held_while_blocking flight-recorder event
+        # cross-reference by equality
+        import ast
+        import importlib.util
+        from fedml_tpu.analysis.crossclass import CrossClassIndex
+        from fedml_tpu.analysis.runtime import race_audit
+        src = ("from fedml_tpu.core.locks import audited_lock\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._lock = audited_lock()\n")
+        mod_file = tmp_path / "idmod.py"
+        mod_file.write_text(src)
+        index = CrossClassIndex()
+        index.add_module(str(mod_file), ast.parse(src))
+        cls = next(iter(index.modules.values()))["classes"]["C"]
+        static_site = cls.families["_lock"][1]
+        assert static_site == "idmod.py:4"
+        spec = importlib.util.spec_from_file_location("idmod",
+                                                      str(mod_file))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        with race_audit() as ra:
+            inst = mod.C()
+        assert inst._lock.site == static_site
+        assert ra.locks_created == 1
+
+    def _subset_paths(self, tmp_path, integration_src):
+        import shutil
+        files = ["fedml_tpu/core/managers.py",
+                 "fedml_tpu/core/comm/base.py",
+                 "fedml_tpu/core/comm/tcp.py",
+                 "fedml_tpu/core/locks.py",
+                 "fedml_tpu/core/message.py",
+                 "fedml_tpu/resilience/policy.py"]
+        for f in files:
+            dst = tmp_path / f
+            dst.parent.mkdir(parents=True, exist_ok=True)
+            shutil.copy(os.path.join(REPO_ROOT, f), dst)
+        dst = tmp_path / "fedml_tpu/resilience/integration.py"
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text(integration_src)
+        return str(tmp_path)
+
+    def test_acceptance_reverting_finish_under_advance_lock(self, tmp_path):
+        # THE acceptance fixture: reverting the PR-5 fix (finish() ran
+        # the transport STOP wave -- blocking per-peer writes -- under
+        # _advance_lock) must produce exactly one FL126, statically,
+        # over the real control-plane sources. The committed tree is
+        # clean.
+        path = os.path.join(REPO_ROOT,
+                            "fedml_tpu/resilience/integration.py")
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        fixed = (
+            "            done = done or self.failed is not None\n"
+            "        if done:                    "
+            "# see start(): no STOP wave under the\n"
+            "            self.finish()           # turnover lock\n"
+            "            return\n"
+            "        self._send_syncs(syncs, span)\n"
+            "\n"
+            "    def _on_round_abandoned")
+        reverted = (
+            "            done = done or self.failed is not None\n"
+            "            if done:\n"
+            "                self.finish()\n"
+            "                return\n"
+            "        self._send_syncs(syncs, span)\n"
+            "\n"
+            "    def _on_round_abandoned")
+        assert fixed in src, "integration.py turnover shape changed"
+        clean_root = self._subset_paths(tmp_path, src)
+        assert [f.code for f in lint_paths([clean_root])] == []
+        mutated = src.replace(fixed, reverted, 1)
+        found = lint_paths([self._subset_paths(tmp_path, mutated)])
+        assert [f.code for f in found] == ["FL126"]
+        msg = found[0].message
+        assert "`ResilientFedAvgServer._on_round_complete` " \
+               "calls `self.finish()`" in msg
+        # the cited identity is _advance_lock's creation site -- the
+        # same string race_audit()/the flight recorder would report
+        assert "integration.py:296" in msg
+        assert "_send_frame" in msg and "TcpCommManager" in msg
+
+
+class TestFsmSequencing:
+    """FL127: path-sensitive handler analysis -- a handler path that
+    neither replies, advances the controller, terminates, nor logs is a
+    silently hung round."""
+
+    FSM_PATH = "fedml_tpu/core/fsm_fake.py"
+
+    HEADER = (
+        "import logging\n"
+        "from fedml_tpu.core.managers import ClientManager, ServerManager\n"
+        "from fedml_tpu.core.comm.base import MSG_TYPE_PEER_LOST\n"
+        "from fedml_tpu.core.message import Message\n"
+        "MSG_A = 'a'\n"
+        "MSG_B = 'b'\n"
+        "class Cli(ClientManager):\n"
+        "    def register_message_receive_handlers(self):\n"
+        "        self.register_message_receive_handler(MSG_A, self._on_a)\n"
+        "        self.register_message_receive_handler(\n"
+        "            MSG_TYPE_PEER_LOST, self._on_lost)\n"
+        "    def _on_a(self, msg):\n"
+        "        m = Message(MSG_B, 1, 0)\n"
+        "        m.add('flag', 1)\n"
+        "        self.send_message(m)\n"
+        "    def _on_lost(self, msg):\n"
+        "        self.finish()\n"
+        "class Srv(ServerManager):\n"
+        "    def register_message_receive_handlers(self):\n"
+        "        self.register_message_receive_handler(MSG_B, self._on_b)\n"
+        "        self.register_message_receive_handler(\n"
+        "            MSG_TYPE_PEER_LOST, self._on_lost)\n"
+        "    def _on_lost(self, msg):\n"
+        "        self.finish()\n")
+
+    def _with_on_b(self, body):
+        return self.HEADER + "    def _on_b(self, msg):\n" + body
+
+    def test_fl127_silent_fall_through_branch(self):
+        src = self._with_on_b(
+            "        if msg.get('flag'):\n"
+            "            self.send_message(Message(MSG_A, 0, 1))\n")
+        found = lint_source(src, path=self.FSM_PATH)
+        assert [f.code for f in found] == ["FL127"]
+        assert "`Srv._on_b`" in found[0].message
+        assert "falls off the end" in found[0].message
+
+    def test_fl127_silent_early_return(self):
+        src = self._with_on_b(
+            "        if not msg.get('flag'):\n"
+            "            return\n"
+            "        self.send_message(Message(MSG_A, 0, 1))\n")
+        found = lint_source(src, path=self.FSM_PATH)
+        assert [f.code for f in found] == ["FL127"]
+        assert "returns early" in found[0].message
+
+    def test_fl127_negative_logged_ignore_is_a_decision(self):
+        src = self._with_on_b(
+            "        if not msg.get('flag'):\n"
+            "            logging.info('stale report ignored')\n"
+            "            return\n"
+            "        self.send_message(Message(MSG_A, 0, 1))\n")
+        assert codes(src, path=self.FSM_PATH) == []
+
+    def test_fl127_negative_raise_terminates(self):
+        src = self._with_on_b(
+            "        if not msg.get('flag'):\n"
+            "            raise RuntimeError('protocol violation')\n"
+            "        self.send_message(Message(MSG_A, 0, 1))\n")
+        assert codes(src, path=self.FSM_PATH) == []
+
+    def test_fl127_negative_finish_terminates(self):
+        src = self._with_on_b(
+            "        if msg.get('flag'):\n"
+            "            self.send_message(Message(MSG_A, 0, 1))\n"
+            "        else:\n"
+            "            self.finish()\n")
+        assert codes(src, path=self.FSM_PATH) == []
+
+    def test_fl127_negative_controller_advance(self):
+        src = self.HEADER.replace(
+            "class Srv(ServerManager):\n",
+            "class RoundController:\n"
+            "    pass\n"
+            "class Srv(ServerManager):\n"
+            "    def __init__(self, args, comm):\n"
+            "        super().__init__(args, comm)\n"
+            "        self._controller = RoundController()\n") + (
+            "    def open_round(self):\n"
+            "        self.send_message(Message(MSG_A, 0, 1))\n"
+            "    def _on_b(self, msg):\n"
+            "        self._controller.report(msg.get('flag'))\n")
+        assert codes(src, path=self.FSM_PATH) == []
+
+    def test_fl127_helper_transitivity(self):
+        # a same-class helper that acts on all of ITS paths acts for the
+        # handler; a helper with a silent path does not
+        acting = self._with_on_b(
+            "        self._reply(msg)\n") + (
+            "    def _reply(self, msg):\n"
+            "        self.send_message(Message(MSG_A, 0, 1))\n")
+        assert codes(acting, path=self.FSM_PATH) == []
+        silent = self._with_on_b(
+            "        self._reply(msg)\n") + (
+            "    def _reply(self, msg):\n"
+            "        if msg.get('flag'):\n"
+            "            self.send_message(Message(MSG_A, 0, 1))\n")
+        assert codes(silent, path=self.FSM_PATH) == ["FL127"]
+
+    def test_fl127_try_except_paths(self):
+        # an except path that only swallows is silent; logging it passes
+        silent = self._with_on_b(
+            "        try:\n"
+            "            self.send_message(Message(MSG_A, 0,\n"
+            "                                      msg.get('flag')))\n"
+            "        except OSError:\n"
+            "            pass\n")
+        assert codes(silent, path=self.FSM_PATH) == ["FL127"]
+        logged = self._with_on_b(
+            "        try:\n"
+            "            self.send_message(Message(MSG_A, 0,\n"
+            "                                      msg.get('flag')))\n"
+            "        except OSError:\n"
+            "            logging.warning('send failed')\n")
+        assert codes(logged, path=self.FSM_PATH) == []
+
+    def test_fl127_loop_body_cannot_guarantee(self):
+        # a for-loop may run zero times: an act only inside it does not
+        # cover the zero-iteration path
+        src = self._with_on_b(
+            "        for r in msg.get('flag') or []:\n"
+            "            self.send_message(Message(MSG_A, 0, r))\n")
+        assert codes(src, path=self.FSM_PATH) == ["FL127"]
+
+    def test_acceptance_deleting_reply_in_report_handler(self):
+        # the ISSUE's mutation fixture: deleting the controller advance
+        # on the report handler's path in resilience/integration.py
+        # yields exactly one FL127 (the committed file yields zero)
+        path = os.path.join(REPO_ROOT,
+                            "fedml_tpu/resilience/integration.py")
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        needle = (
+            "            self._controller.report(\n"
+            "                msg.get(\"round\"), msg.get(\"attempt\"), "
+            "msg.get_sender_id(),\n"
+            "                msg.get(\"num_samples\"),\n"
+            "                {k: np.asarray(v) for k, v in "
+            "msg.get(\"params\").items()})")
+        assert needle in src, "integration.py report handler changed"
+        clean = lint_source(src, path="fedml_tpu/resilience/integration.py")
+        assert [f.code for f in clean] == []
+        found = lint_source(src.replace(needle, "            pass"),
+                            path="fedml_tpu/resilience/integration.py")
+        assert [f.code for f in found].count("FL127") == 1
+        f127 = [f for f in found if f.code == "FL127"][0]
+        assert "`ResilientFedAvgServer._on_report`" in f127.message
+        # the orphaned payload keys surface as FL128 companions: the
+        # deleted reads leave num_samples/attempt/params set-never-read
+        assert {f.code for f in found} == {"FL127", "FL128"}
+
+
+class TestPayloadSchema:
+    """FL128: handler payload reads paired against the counterpart
+    role's Message.add() schemas."""
+
+    FSM_PATH = "fedml_tpu/core/fsm_fake.py"
+    HEADER = TestFsmSequencing.HEADER
+
+    def _with_on_b(self, body):
+        return self.HEADER + "    def _on_b(self, msg):\n" + body
+
+    def test_fl128_renamed_key_produces_the_pair(self):
+        # rename the sender's add(): the read goes never-set, the new
+        # key goes never-read -- exactly one of each
+        src = self._with_on_b(
+            "        if msg.get('flag'):\n"
+            "            self.send_message(Message(MSG_A, 0, 1))\n"
+            "        else:\n"
+            "            self.finish()\n")
+        assert codes(src, path=self.FSM_PATH) == []
+        renamed = src.replace("m.add('flag', 1)", "m.add('flagg', 1)")
+        found = lint_source(renamed, path=self.FSM_PATH)
+        assert [f.code for f in found] == ["FL128", "FL128"]
+        msgs = " | ".join(f.message for f in found)
+        assert "reads payload key 'flag'" in msgs
+        assert "key 'flagg' of message type 'b' is set here" in msgs
+
+    def test_fl128_negative_open_schema_non_literal_key(self):
+        # a computed add() key opens the schema: read-never-set judges
+        # nothing for that type
+        src = self._with_on_b(
+            "        if msg.get('flag'):\n"
+            "            self.send_message(Message(MSG_A, 0, 1))\n"
+            "        else:\n"
+            "            self.finish()\n").replace(
+            "        m.add('flag', 1)\n",
+            "        k = 'fl' + 'ag'\n"
+            "        m.add(k, 1)\n")
+        assert codes(src, path=self.FSM_PATH) == []
+
+    def test_fl128_negative_escaping_message_opens_schema(self):
+        # the built message flowing into an unknown call may gain keys
+        # the pass cannot see -- no read-never-set for its type
+        src = self._with_on_b(
+            "        if msg.get('flag') and msg.get('extra'):\n"
+            "            self.send_message(Message(MSG_A, 0, 1))\n"
+            "        else:\n"
+            "            self.finish()\n").replace(
+            "        self.send_message(m)\n",
+            "        self.decorate(m)\n"
+            "        self.send_message(m)\n")
+        assert codes(src, path=self.FSM_PATH) == []
+
+    def test_fl128_negative_opaque_handler_suppresses_set_never_read(self):
+        # the handler passes its message on: reads are unknowable, so a
+        # set key is not judged dead
+        src = self._with_on_b(
+            "        self.process(msg)\n"
+            "        self.finish()\n") + (
+            "    def open_round(self):\n"
+            "        self.send_message(Message(MSG_A, 0, 1))\n")
+        assert codes(src, path=self.FSM_PATH) == []
+
+    def test_fl128_set_never_read_by_transparent_handler(self):
+        src = self._with_on_b(
+            "        if msg.get('flag'):\n"
+            "            self.send_message(Message(MSG_A, 0, 1))\n"
+            "        else:\n"
+            "            self.finish()\n").replace(
+            "        m.add('flag', 1)\n",
+            "        m.add('flag', 1)\n"
+            "        m.add('debug_blob', 2)\n")
+        found = lint_source(src, path=self.FSM_PATH)
+        assert [f.code for f in found] == ["FL128"]
+        assert "'debug_blob'" in found[0].message
+        assert "ever reads it" in found[0].message
+
+    def test_fl128_reserved_and_control_keys_exempt(self):
+        # __-prefixed control fields (the tracer's __trace__) and the
+        # envelope keys are never judged
+        src = self._with_on_b(
+            "        if msg.get('flag'):\n"
+            "            self.send_message(Message(MSG_A, 0, 1))\n"
+            "        else:\n"
+            "            self.finish()\n").replace(
+            "        m.add('flag', 1)\n",
+            "        m.add('flag', 1)\n"
+            "        m.add('__trace__', {})\n")
+        assert codes(src, path=self.FSM_PATH) == []
+
+    def test_acceptance_renaming_add_key_in_integration(self):
+        # the ISSUE's mutation fixture: renaming ONE Message.add() key in
+        # resilience/integration.py yields exactly one FL128 read-never-
+        # set and exactly one set-never-read companion
+        path = os.path.join(REPO_ROOT,
+                            "fedml_tpu/resilience/integration.py")
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        needle = 'out.add("num_samples", float(n))'
+        assert needle in src, "integration.py report build changed"
+        clean = lint_source(src, path="fedml_tpu/resilience/integration.py")
+        assert [f.code for f in clean] == []
+        found = lint_source(
+            src.replace(needle, 'out.add("n_samples", float(n))'),
+            path="fedml_tpu/resilience/integration.py")
+        assert [f.code for f in found] == ["FL128", "FL128"]
+        msgs = " | ".join(f.message for f in found)
+        assert "reads payload key 'num_samples'" in msgs
+        assert "'n_samples' of message type 'res_report' is set" in msgs
+
+
+class TestBodyDonationInference:
+    """The --fix upgrade: donation argnums inferred from which params
+    flow into the returned pytree, replacing the name heuristic where
+    the body evidence is unambiguous."""
+
+    def _body(self, src):
+        import ast as ast_mod
+        from fedml_tpu.analysis.dataflow import (
+            infer_donate_argnums_from_body)
+        return infer_donate_argnums_from_body(ast_mod.parse(src).body[0])
+
+    def test_flow_into_return_is_the_donation_set(self):
+        assert self._body(
+            "def round_fn(state, data):\n"
+            "    new = state * 2\n"
+            "    return new\n") == (0,)
+        assert self._body(
+            "def round_fn(state, opt, data):\n"
+            "    g = grad(state, data)\n"
+            "    s2, o2 = update(state, opt, g)\n"
+            "    return s2, o2\n") == (0, 1, 2)
+
+    def test_loop_carried_rebind_keeps_taint(self):
+        # iteration 2's `state` taint must survive the strong update
+        assert self._body(
+            "def round_fn(state, xs):\n"
+            "    for x in xs:\n"
+            "        state = step(state, x)\n"
+            "    return state\n") == (0, 1)
+
+    def test_ambiguity_bails_to_none(self):
+        assert self._body(
+            "def round_fn(state, *rest):\n"
+            "    return state\n") is None
+        assert self._body(
+            "def round_fn(state, data):\n"
+            "    f = lambda v: v + 1\n"
+            "    return f(state)\n") is None
+        assert self._body(
+            "def round_fn(state, data):\n"
+            "    state.update(data)\n") is None  # no returned value
+
+    def test_fix_body_overrides_name_heuristic_both_ways(self):
+        from fedml_tpu.analysis.dataflow import plan_donation_fixes
+        # `n_state` is name-ineligible ('n' segment) but flows into the
+        # return: the body evidence donates it
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def agg_round(n_state, acc):\n"
+            "    return n_state + acc\n")
+        fixed = plan_donation_fixes("m.py", src).apply()
+        assert "donate_argnums=(0, 1)" in fixed
+        # `residuals` is name-eligible but never flows into the return:
+        # the body evidence excludes it (donating it aliases nothing)
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def agg_round(state, residuals):\n"
+            "    log_norm(residuals)\n"
+            "    return state * 2\n")
+        fixed = plan_donation_fixes("m.py", src).apply()
+        assert "donate_argnums=(0,)" in fixed
+
+    def test_fix_falls_back_to_names_when_ambiguous(self):
+        from fedml_tpu.analysis.dataflow import plan_donation_fixes
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def agg_round(state, cohort_data):\n"
+            "    f = lambda v: v\n"
+            "    return f(state)\n")
+        fixed = plan_donation_fixes("m.py", src).apply()
+        # name heuristic: state donated, cohort_data never
+        assert "donate_argnums=(0,)" in fixed
+
+    def test_fix_skips_when_nothing_flows(self):
+        from fedml_tpu.analysis.dataflow import plan_donation_fixes
+        src = (
+            "import jax\n"
+            "@jax.jit\n"
+            "def agg_round(state, data):\n"
+            "    return jnp.zeros(4)\n")
+        plan = plan_donation_fixes("m.py", src)
+        assert not plan.edits
+        assert plan.skipped \
+            and "flows into the returned" in plan.skipped[0][2]
+
+
+class TestSarifRuleMetadata:
+    """Satellite: SARIF rule metadata for the fedcheck passes."""
+
+    def test_rules_carry_pass_tags(self, tmp_path):
+        from fedml_tpu.analysis.linter import render_sarif
+        doc = json.loads(render_sarif([]))
+        rules = {r["id"]: r for r in doc["runs"][0]["tool"]["driver"]["rules"]}
+        for code in ("FL126", "FL127", "FL128"):
+            assert code in rules, code
+        assert rules["FL126"]["properties"]["tags"] == [
+            "fedcheck-concurrency", "race-audit-crossref"]
+        assert rules["FL127"]["properties"]["tags"] == ["fedcheck-protocol"]
+        assert rules["FL128"]["properties"]["tags"] == ["fedcheck-protocol"]
+        assert rules["FL120"]["properties"]["tags"] == ["fedcheck-protocol"]
+        assert rules["FL124"]["properties"]["tags"] == [
+            "fedcheck-concurrency", "race-audit-crossref"]
+        assert rules["FL101"]["properties"]["tags"] == ["fedlint-jax"]
+
+    def test_catalog_has_the_new_rules(self):
+        for code in ("FL126", "FL127", "FL128"):
+            assert code in RULES
+            title, rationale = RULES[code]
+            assert title and rationale
+
+
+class TestWallTimeBudget:
+    """Satellite: the CI wall-time budget flag."""
+
+    def test_within_budget_exits_zero(self, tmp_path, capsys):
+        mod = tmp_path / "ok.py"
+        mod.write_text("x = 1\n")
+        assert fedlint_main([str(mod), "--baseline", "",
+                             "--max-seconds", "300"]) == 0
+        err = capsys.readouterr().err
+        assert "wall time" in err and "budget 300.0s" in err
+
+    def test_blown_budget_exits_nonzero(self, tmp_path, capsys):
+        mod = tmp_path / "ok.py"
+        mod.write_text("x = 1\n")
+        assert fedlint_main([str(mod), "--baseline", "",
+                             "--max-seconds", "0"]) == 1
+        assert "budget exceeded" in capsys.readouterr().err
+
+
+class TestReviewHardening:
+    """Regression pins for the precision defects found in review: FL128
+    read-surface opacity, FL127 inherited-context acts, FL126 reach
+    through recursion cycles, and the taint fixpoint."""
+
+    FSM_PATH = "fedml_tpu/core/fsm_fake.py"
+    HEADER = TestFsmSequencing.HEADER
+
+    def _with_on_b(self, body):
+        return self.HEADER + "    def _on_b(self, msg):\n" + body
+
+    def test_fl128_get_params_makes_reader_opaque(self):
+        # the whole payload dict walks away: a set key must NOT be
+        # judged dead (the reads are invisible, not absent)
+        src = self._with_on_b(
+            "        p = msg.get_params()\n"
+            "        self.use(p)\n"
+            "        self.finish()\n") + (
+            "    def open_round(self):\n"
+            "        self.send_message(Message(MSG_A, 0, 1))\n")
+        assert codes(src, path=self.FSM_PATH) == []
+
+    def test_fl128_dynamic_get_key_makes_reader_opaque(self):
+        src = self._with_on_b(
+            "        for k in ('flag',):\n"
+            "            if msg.get(k):\n"
+            "                self.send_message(Message(MSG_A, 0, 1))\n"
+            "                return\n"
+            "        self.finish()\n")
+        assert codes(src, path=self.FSM_PATH) == []
+
+    def test_fl128_subscript_write_is_not_a_read(self):
+        # msg['relayed'] = True is a mutation: no read-never-set FP for
+        # 'relayed', and the mutated message marks the reader opaque
+        src = self._with_on_b(
+            "        msg['relayed'] = True\n"
+            "        if msg.get('flag'):\n"
+            "            self.send_message(Message(MSG_A, 0, 1))\n"
+            "        else:\n"
+            "            self.finish()\n")
+        assert codes(src, path=self.FSM_PATH) == []
+
+    def test_fl127_inherited_helper_acts(self):
+        # the handler lives in a subclass, the acting helper on the base
+        src = self.HEADER.replace(
+            "class Srv(ServerManager):\n",
+            "class SrvBase(ServerManager):\n"
+            "    def _broadcast(self):\n"
+            "        self.send_message(Message(MSG_A, 0, 1))\n"
+            "class Srv(SrvBase):\n") + (
+            "    def _on_b(self, msg):\n"
+            "        _ = msg.get('flag')\n"
+            "        self._broadcast()\n")
+        assert codes(src, path=self.FSM_PATH) == []
+
+    def test_fl127_subclass_controller_acts_for_base_handler(self):
+        # the handler is defined (and registered) on the base; the
+        # controller field is assigned only in the registering subclass
+        src = self.HEADER.replace(
+            "class Srv(ServerManager):\n",
+            "class RoundController:\n"
+            "    pass\n"
+            "class SrvBase(ServerManager):\n"
+            "    def open_round(self):\n"
+            "        self.send_message(Message(MSG_A, 0, 1))\n"
+            "    def _on_b(self, msg):\n"
+            "        self._controller.report(msg.get('flag'))\n"
+            "class Srv(SrvBase):\n"
+            "    def __init__(self, args, comm):\n"
+            "        super().__init__(args, comm)\n"
+            "        self._controller = RoundController()\n")
+        assert codes(src, path=self.FSM_PATH) == []
+
+    def test_fl126_reach_survives_recursion_cycle(self):
+        # A.ping <-> B.pong recurse; the blocking op hangs off the
+        # cycle. A memoized DFS freezes an empty partial result for the
+        # cycle partner; the fixpoint must still see the block when a
+        # third class enters through it under a lock.
+        src = (
+            "from fedml_tpu.core.locks import audited_lock\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self.b = B(self)\n"
+            "    def ping(self, n):\n"
+            "        self.sock.sendall(b'')\n"
+            "        self.b.pong(n)\n"
+            "class B:\n"
+            "    def __init__(self, a):\n"
+            "        self.a = a\n"
+            "    def pong(self, n):\n"
+            "        self.a.ping(n)\n"
+            "class H:\n"
+            "    def __init__(self):\n"
+            "        self._lock = audited_lock()\n"
+            "        self.b = B(A())\n"
+            "    def handler(self, msg):\n"
+            "        with self._lock:\n"
+            "            self.enterhelper()\n"
+            "    def enterhelper(self):\n"
+            "        self.b.pong(0)\n")
+        found = lint_source(src, path=LIB_PATH)
+        assert [f.code for f in found] == ["FL126"]
+        assert "sendall" in found[0].message
+
+    def test_taint_fixpoint_reaches_three_link_loop_chain(self):
+        import ast as ast_mod
+        from fedml_tpu.analysis.dataflow import (
+            infer_donate_argnums_from_body)
+        fn = ast_mod.parse(
+            "def round_fn(state, xs):\n"
+            "    out = 0\n"
+            "    acc = 0\n"
+            "    tmp = 0\n"
+            "    for x in xs:\n"
+            "        out = norm(tmp)\n"
+            "        tmp = mix(acc, x)\n"
+            "        acc = step(state)\n"
+            "    return out\n").body[0]
+        # state -> acc -> tmp -> out needs one pass per link
+        assert infer_donate_argnums_from_body(fn) == (0, 1)
+
+    def test_taint_branch_join_unions_if_else(self):
+        import ast as ast_mod
+        from fedml_tpu.analysis.dataflow import (
+            infer_donate_argnums_from_body)
+        # state flows to the return via the if branch only; a
+        # sequential walk would let the else branch overwrite it
+        fn = ast_mod.parse(
+            "def round_fn(state, data):\n"
+            "    if cond():\n"
+            "        out = state\n"
+            "    else:\n"
+            "        out = data\n"
+            "    return out\n").body[0]
+        assert infer_donate_argnums_from_body(fn) == (0, 1)
+        # try/except branches join the same way
+        fn = ast_mod.parse(
+            "def round_fn(state, fallback):\n"
+            "    try:\n"
+            "        out = step(state)\n"
+            "    except ValueError:\n"
+            "        out = fallback\n"
+            "    return out\n").body[0]
+        assert infer_donate_argnums_from_body(fn) == (0, 1)
+
+    def test_fl127_act_in_loop_header_covers_all_paths(self):
+        # the iterable/test evaluates even on the zero-iteration path
+        src = self._with_on_b(
+            "        for r in self.mk(msg.get('flag')):\n"
+            "            pass\n").replace(
+            "class Srv(ServerManager):\n",
+            "class RoundController:\n"
+            "    pass\n"
+            "class Srv(ServerManager):\n"
+            "    def __init__(self, args, comm):\n"
+            "        super().__init__(args, comm)\n"
+            "        self._controller = RoundController()\n"
+            "    def open_round(self):\n"
+            "        self.send_message(Message(MSG_A, 0, 1))\n"
+            "    def mk(self, flag):\n"
+            "        return self._controller.drain(flag)\n")
+        assert codes(src, path=self.FSM_PATH) == []
+
+    def test_max_seconds_applies_to_fix_path(self, tmp_path, capsys):
+        mod = tmp_path / "ok.py"
+        mod.write_text("x = 1\n")
+        assert fedlint_main([str(mod), "--fix", "--max-seconds", "0"]) == 1
+        assert "budget exceeded" in capsys.readouterr().err
+        assert fedlint_main([str(mod), "--fix",
+                             "--max-seconds", "300"]) == 0
+        capsys.readouterr()
