@@ -233,6 +233,102 @@ def measure(args, epochs, client_chunk, wave_mode):
     }
 
 
+def _ragged_lr_clients(clients, dim=16, classes=4, seed=0):
+    """Ragged synthetic population: lognormal shard sizes (the LDA-skew
+    shape at population scale), tiny LR task -- the workload is the
+    *cohort axis*, not the model, so a CPU host can smoke 50k clients."""
+    rng = np.random.default_rng(seed)
+    ns = np.clip(rng.lognormal(mean=2.0, sigma=1.0, size=clients),
+                 1, 400).astype(np.int64)
+    # one draw for the whole population, then per-client views: 50k
+    # per-client RNG round-trips would dominate the setup time
+    total = int(ns.sum())
+    x = rng.standard_normal((total, dim)).astype(np.float32)
+    y = rng.integers(0, classes, total).astype(np.int32)
+    local, local_num = {}, {}
+    off = 0
+    for c in range(clients):
+        n = int(ns[c])
+        local[c] = {"x": x[off:off + n], "y": y[off:off + n]}
+        local_num[c] = n
+        off += n
+    test = {"x": x[:256], "y": y[:256]}
+    # the 8-tuple dataset contract (SURVEY.md section 1 L2)
+    return [total, len(test["y"]), {"x": x, "y": y}, test, local_num,
+            local, {0: test}, classes]
+
+
+def run_massive_cohort(args):
+    """``--massive_cohort [N]``: one-chip bucketed-streaming rounds over N
+    ragged simulated clients (default 50,000), with buffered-async
+    aggregation when ``--massive_async`` is set. Emits one BENCH_*-style
+    JSON line whose headline is clients/sec."""
+    import types
+
+    import jax
+
+    from fedml_tpu import models
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    from fedml_tpu.algorithms.specs import make_classification_spec
+    from fedml_tpu.observability.jaxmon import watch_compiles
+
+    C = int(args.massive_cohort)
+    dim, classes = 16, 4
+    dataset = _ragged_lr_clients(C, dim=dim, classes=classes)
+    import jax.numpy as jnp
+    spec = make_classification_spec(
+        models.LogisticRegression(num_classes=classes, apply_sigmoid=False),
+        jnp.zeros((1, dim)))
+    run_args = types.SimpleNamespace(
+        client_num_in_total=C, client_num_per_round=C,
+        comm_round=10 ** 9, epochs=1, batch_size=8, lr=0.05, wd=0.0,
+        client_optimizer="sgd", frequency_of_the_test=10 ** 9, seed=0,
+        client_chunk=args.massive_chunk, bucket_edges="geometric",
+        async_agg=int(args.massive_async), buffer_k=args.buffer_k,
+        staleness_decay=args.staleness_decay, async_window=4,
+        device_resident="0")
+    api = FedAvgAPI(dataset, spec, run_args)
+    t0 = time.time()
+    with watch_compiles() as watcher:
+        api.train_one_round()  # compile + warmup (one program per bucket)
+    compile_s = time.time() - t0
+    rounds = max(1, args.rounds)
+    times = []
+    with watch_compiles() as steady_watcher:
+        for _ in range(rounds):
+            t0 = time.time()
+            metrics = api.train_one_round()
+            times.append(time.time() - t0)
+    round_s = float(np.median(times))
+    out = {
+        "metric": f"massive-cohort clients/sec (bucketed streaming, "
+                  f"{C} ragged LR clients"
+                  + (", async buffered" if args.massive_async else "")
+                  + ")",
+        "value": round(C / round_s, 1),
+        "unit": "clients/sec",
+        "clients_per_round": C,
+        "rounds_measured": rounds,
+        "round_s": round(round_s, 3),
+        "compile_s": round(compile_s, 2),
+        # compile-cache satellite: warm-cache runs show compiles ~0 here
+        "warmup_compiles": watcher.total_compiles,
+        "warmup_compile_s": round(watcher.total_compile_seconds, 2),
+        "steady_compiles": steady_watcher.total_compiles,
+        "bucket_shapes": api.bucket_runner.compiled_shapes(),
+        "bucket_waste_frac": metrics.get("bucket/waste_frac"),
+        "executed_steps": metrics.get("bucket/executed_steps"),
+        "true_steps": metrics.get("bucket/true_steps"),
+        "train_loss": round(float(metrics["Train/Loss"]), 4),
+        "device": str(jax.devices()[0]),
+    }
+    if args.massive_async:
+        out["async"] = {k.split("/", 1)[1]: v for k, v in metrics.items()
+                        if k.startswith("async/")}
+    print(json.dumps(out), flush=True)
+    return 0
+
+
 def _sweep_params(model_name):
     """Model-shaped ``params`` pytree on CPU (shapes are what matter)."""
     import jax
@@ -355,6 +451,29 @@ def main():
                    help="fedopt = same engine/shapes with a server-Adam "
                         "step on the pseudo-gradient (second bench line; "
                         "vs_baseline stays tied to the FedAvg baseline)")
+    p.add_argument("--massive_cohort", nargs="?", const=50_000, type=int,
+                   default=None, metavar="N",
+                   help="bucketed-streaming massive-cohort bench: one chip "
+                        "runs rounds of N (default 50,000) ragged "
+                        "simulated LR clients; emits a JSON record with "
+                        "clients/sec, bucket-shape count and padded-waste "
+                        "fraction (docs/PERFORMANCE.md round 6)")
+    p.add_argument("--massive_async", type=int, default=0,
+                   help="massive-cohort bench: run the buffered-async "
+                        "aggregation path (--buffer_k/--staleness_decay)")
+    p.add_argument("--massive_chunk", type=int, default=128,
+                   help="massive-cohort bench: clients per streamed "
+                        "dispatch (smaller = tighter trip counts in the "
+                        "heavy tail, more dispatches; measured sweet spot "
+                        "128 -- see docs/PERFORMANCE.md round 6)")
+    p.add_argument("--buffer_k", type=int, default=2048,
+                   help="massive-cohort bench: async buffer K")
+    p.add_argument("--staleness_decay", type=float, default=0.5,
+                   help="massive-cohort bench: async staleness exponent")
+    p.add_argument("--compile_cache_dir", type=str, default=None,
+                   help="persistent XLA compilation cache directory "
+                        "(default: FEDML_TPU_COMPILE_CACHE env or "
+                        "~/.cache/fedml_tpu/xla)")
     p.add_argument("--compression_sweep", action="store_true",
                    help="measure each --compressors spec on a "
                         "--sweep_model pytree (encoded bytes + "
@@ -387,6 +506,16 @@ def main():
         jax.config.update("jax_platforms", "cpu")
         sys.exit(run_compression_tools(args))
 
+    if args.massive_cohort:
+        # the workload is the cohort axis, not the model: runs on any
+        # platform (CI smokes it on CPU; numbers are per-device honest)
+        if args.platform == "cpu":
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        from fedml_tpu.utils.compile_cache import enable_compilation_cache
+        enable_compilation_cache(args.compile_cache_dir)
+        sys.exit(run_massive_cohort(args))
+
     if args.algo == "fedopt":
         global _FAILURE_METRIC
         _FAILURE_METRIC = "FedOpt rounds/hour (CIFAR-10-scale ResNet-56)"
@@ -413,7 +542,7 @@ def main():
 
     # persistent XLA cache: the degrade ladder re-compiles per rung
     # (113-163 s each on TPU); cached rungs start measuring immediately
-    enable_compilation_cache()
+    enable_compilation_cache(args.compile_cache_dir)
     device = jax.devices()[0]
     mode = 0 if args.flat else args.mode
 

@@ -104,6 +104,32 @@ class FedAvgAPI:
                 "distributed control-plane paths; mesh rounds aggregate "
                 "over ICI collectives, where the wire bottleneck being "
                 "compressed does not exist")
+        # Bucketed ragged streaming + optional buffered-async aggregation
+        # (--bucket_edges / --async_agg): the massive-cohort path. Clients
+        # are bucketed by local step count, streamed chunk-by-chunk
+        # through one compiled program per bucket shape, and folded on
+        # host in fp64 -- the cohort axis is unbounded (engine.py
+        # BucketedStreamRunner; docs/PERFORMANCE.md round 6). Validated
+        # BEFORE any round fn is built: a bogus mesh/compressor combo must
+        # fail loudly here, not deep in shard_map.
+        self.bucket_runner = None
+        self.async_agg = None
+        from fedml_tpu.resilience.async_agg import AsyncAggPolicy
+        async_policy = AsyncAggPolicy.from_args(args)
+        use_buckets = (getattr(args, "bucket_edges", None) is not None
+                       or async_policy is not None)
+        if use_buckets:
+            if mesh is not None:
+                raise ValueError(
+                    "--bucket_edges/--async_agg run the single-chip "
+                    "bucketed streaming path; it does not compose with "
+                    "--mesh (the sharded-lane path owns multi-chip)")
+            if self.compressor is not None:
+                raise ValueError(
+                    "--bucket_edges/--async_agg do not compose with "
+                    "--compressor yet: EF residual state for unbounded "
+                    "cohorts is the compression follow-up (ROADMAP)")
+
         self.compressed_round_fn = None
         if mesh is None:
             self.round_fn = make_sim_round(spec, cfg, payload_fn, server_fn)
@@ -115,6 +141,35 @@ class FedAvgAPI:
             self.round_fn = make_sharded_round(spec, cfg, mesh, payload_fn,
                                                server_fn)
         self.eval_fn = make_eval_fn(spec)
+
+        if use_buckets:
+            from fedml_tpu.parallel.engine import BucketedStreamRunner
+            from fedml_tpu.parallel.packing import (_steps_for,
+                                                    parse_bucket_edges)
+            # edges are sized from the POPULATION max so bucket shapes --
+            # and therefore compiled programs -- are stable across rounds
+            # no matter which cohort is sampled
+            pop_ns = [int(v)
+                      for v in self.train_data_local_num_dict.values()]
+            eff_bs = (args.batch_size
+                      if args.batch_size not in (-1, 0)
+                      else max(1, max(pop_ns)))
+            s_max = max(_steps_for(max(n, 1), eff_bs, args.epochs)
+                        for n in pop_ns)
+            edges = parse_bucket_edges(
+                getattr(args, "bucket_edges", None), s_max)
+            # pass the RESOLVED batch size: -1 (full-batch) must pin to
+            # the population max, not each cohort's, or re-sampled
+            # cohorts change the compiled [C, S, B] shape
+            self.bucket_runner = BucketedStreamRunner(
+                spec, cfg, payload_fn, server_fn,
+                client_chunk=getattr(args, "client_chunk", 8) or 8,
+                batch_size=eff_bs, epochs=args.epochs,
+                edges=edges)
+            if async_policy is not None:
+                from fedml_tpu.resilience.async_agg import BufferedAggregator
+                self.async_agg = BufferedAggregator(async_policy)
+                self._async_window = async_policy.async_window
 
         # Device-resident data path (single-chip): upload every client's
         # padded shard to HBM once; per-round host work shrinks to an index
@@ -133,7 +188,8 @@ class FedAvgAPI:
                            or int(getattr(args, "wave_mode", 1)) in (2, 3))
         stacked = (self._stack_if_fits(args)
                    if device_resident and wants_residency
-                   and self.compressor is None else None)
+                   and self.compressor is None
+                   and self.bucket_runner is None else None)
         self.packed_lane_runner = None
         if stacked is not None and mesh is None:
             import jax.numpy as jnp
@@ -191,16 +247,20 @@ class FedAvgAPI:
         self.history = []
 
         if self.compressed_round_fn is not None:
-            import jax.numpy as jnp
-            from fedml_tpu.compression import (compressed_payload_nbytes,
+            from fedml_tpu.compression import (ResidualStore,
+                                               compressed_payload_nbytes,
                                                raw_payload_nbytes)
             # error-feedback residual per client IN TOTAL, carried across
             # rounds (clients keep their own accumulator between the rounds
-            # they are sampled into -- DGC/EF-SignSGD semantics)
-            C_total = len(self.train_data_local_dict)
-            self._ef_residuals = jax.tree.map(
-                lambda x: jnp.zeros((C_total,) + x.shape, x.dtype),
-                self.global_state["params"])
+            # they are sampled into -- DGC/EF-SignSGD semantics). Keyed by
+            # STABLE client id, never cohort slot: re-sampled cohorts must
+            # not cross-contaminate accumulators (regression-pinned in
+            # tests/test_compression.py)
+            self._ef_store = ResidualStore(
+                self.global_state["params"],
+                num_clients=len(self.train_data_local_dict),
+                dense_cap_gb=float(getattr(args, "device_data_cap_gb",
+                                           2.0)))
             # on-wire cost per client update: static given the template, so
             # computed once from abstract shapes (nothing runs on device)
             self._payload_bytes = compressed_payload_nbytes(
@@ -288,7 +348,25 @@ class FedAvgAPI:
 
     def _traced_round_body(self, tracer, t0):
         self.rng, round_rng = jax.random.split(self.rng)
-        if self.device_data is not None:
+        if self.bucket_runner is not None:
+            client_indexes = self._sample_cohort(self.round_idx)
+            logging.info("bucketed round over %d clients",
+                         len(client_indexes))
+            datasets = [self.train_data_local_dict[i]
+                        for i in client_indexes]
+            if all(len(d["y"]) == 0 for d in datasets):
+                raise ValueError(f"round {self.round_idx}: every sampled "
+                                 f"client has an empty shard")
+            with tracer.span("local-train", mode="bucketed",
+                             clients=len(client_indexes)):
+                (self.global_state, self.server_state,
+                 info) = self.bucket_runner.run_round(
+                    self.global_state, self.server_state, datasets,
+                    round_rng, data_rng=self._data_rng,
+                    aggregator=self.async_agg,
+                    async_window=getattr(self, "_async_window", 4))
+            self._last_bucket_info = info
+        elif self.device_data is not None:
             import jax.numpy as jnp
             client_indexes = self._sample_cohort(self.round_idx)
             logging.info("client_indexes = %s", client_indexes)
@@ -334,19 +412,17 @@ class FedAvgAPI:
                         self.global_state, self.server_state, dd, sched,
                         round_rng)
         elif self.compressed_round_fn is not None:
-            import jax.numpy as jnp
             client_indexes, packed = self._cohort(self.round_idx)
             with tracer.span("local-train", mode="compressed"):
-                sel = jnp.asarray(np.asarray(client_indexes, np.int32))
-                cohort_res = jax.tree.map(lambda x: x[sel],
-                                          self._ef_residuals)
+                # gather/scatter by stable client id (ResidualStore): the
+                # round fn sees cohort-ordered rows, the store owns the
+                # id-keyed carry across re-sampled cohorts
+                cohort_res = self._ef_store.gather(client_indexes)
                 (self.global_state, self.server_state, new_res,
                  info) = self.compressed_round_fn(
                     self.global_state, self.server_state, packed, cohort_res,
                     round_rng)
-                self._ef_residuals = jax.tree.map(
-                    lambda full, upd: full.at[sel].set(upd),
-                    self._ef_residuals, new_res)
+                self._ef_store.scatter(client_indexes, new_res)
             self._last_cohort_size = len(client_indexes)
         else:
             _, packed = self._cohort(self.round_idx)
@@ -368,6 +444,20 @@ class FedAvgAPI:
         }
         if self._last_res_record is not None:
             train_metrics.update(self._last_res_record)
+        if self.bucket_runner is not None:
+            b = self._last_bucket_info["bucket"]
+            train_metrics.update({
+                "bucket/clients": b["clients"],
+                "bucket/shapes": b["buckets_used"],
+                "bucket/chunks": b["chunks"],
+                "bucket/executed_steps": b["executed_steps"],
+                "bucket/true_steps": b["true_steps"],
+                "bucket/waste_frac": b["waste_frac"],
+            })
+            # buffer-depth/staleness series ride every round record on
+            # async runs (metrics.jsonl observability contract) even when
+            # the registry is off
+            train_metrics.update(self._last_bucket_info.get("async") or {})
         if self.compressed_round_fn is not None:
             # client->server update traffic this round (uplink; the
             # downlink model broadcast is uncompressed and identical in

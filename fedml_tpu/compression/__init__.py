@@ -31,8 +31,8 @@ _EXPORTS = {
         "QSGDCompressor", "SignSGDCompressor", "ErrorFeedback",
         "get_compressor"),
     "fedml_tpu.compression.integration": (
-        "make_compressed_sim_round", "compressed_payload_nbytes",
-        "raw_payload_nbytes"),
+        "make_compressed_sim_round", "ResidualStore",
+        "compressed_payload_nbytes", "raw_payload_nbytes"),
 }
 
 __all__ = [name for names in _EXPORTS.values() for name in names]

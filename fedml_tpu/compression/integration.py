@@ -99,6 +99,104 @@ def make_compressed_sim_round(spec, cfg, compressor: Compressor,
     return round_fn
 
 
+class ResidualStore:
+    """Per-client error-feedback residuals keyed by STABLE client id.
+
+    EF correctness depends on each client accumulating ITS OWN
+    compression error across the rounds it is sampled into (DGC /
+    EF-SignSGD semantics). Indexing residuals by *cohort slot* silently
+    cross-contaminates clients as soon as two rounds sample different
+    cohorts (or a resilience re-attempt reshuffles the reporting subset):
+    slot 0's residual would belong to whichever client happened to sit at
+    slot 0 last round. This store makes the id-keyed contract explicit
+    and testable -- ``gather(ids)`` stacks the cohort's residuals in
+    cohort order for the jitted round, ``scatter(ids, updated)`` writes
+    each row back to its OWNER id.
+
+    Two backings behind one surface:
+
+    - **dense** (default when ``num_clients`` is known and the stacked
+      array fits ``dense_cap_gb``): one device-resident ``[C_total, ...]``
+      pytree, rows ARE client ids; gather/scatter are fused ``take`` /
+      ``at[].set`` -- the fast path for the cross-silo regime.
+    - **sparse** (unbounded populations): a host dict ``id -> numpy
+      pytree``, residuals materialize lazily as zeros on first gather --
+      memory scales with *touched* clients, never the population, which
+      is what lets EF compose with massive cohorts.
+    """
+
+    def __init__(self, params_template, num_clients=None, dense_cap_gb=2.0,
+                 dense=None):
+        import numpy as np
+
+        self._template = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            params_template)
+        self._bytes_per_client = sum(
+            int(np.prod(s.shape, dtype=np.int64)) * s.dtype.itemsize
+            for s in jax.tree.leaves(self._template))
+        if dense is None:
+            dense = (num_clients is not None
+                     and num_clients * self._bytes_per_client
+                     <= float(dense_cap_gb) * 1e9)
+        self.dense = bool(dense)
+        if self.dense:
+            if num_clients is None:
+                raise ValueError("dense ResidualStore needs num_clients")
+            self._stacked = jax.tree.map(
+                lambda s: jnp.zeros((int(num_clients),) + s.shape, s.dtype),
+                self._template)
+        else:
+            self._rows = {}  # client id -> host numpy pytree
+
+    def gather(self, ids):
+        """Stacked residual pytree for ``ids`` (cohort order)."""
+        import numpy as np
+
+        if self.dense:
+            sel = jnp.asarray(np.asarray(ids, np.int32))
+            return jax.tree.map(lambda x: x[sel], self._stacked)
+        rows = []
+        for i in ids:
+            r = self._rows.get(int(i))
+            if r is None:
+                r = jax.tree.map(
+                    lambda s: np.zeros(s.shape, s.dtype), self._template)
+            rows.append(r)
+        return jax.tree.map(
+            lambda *xs: jnp.asarray(np.stack(xs)), *rows)
+
+    def scatter(self, ids, updated):
+        """Write each updated row back to its owner id. A duplicate id in
+        ``ids`` (cannot happen via ``client_sampling``, which draws
+        without replacement) would resolve last-wins."""
+        import numpy as np
+
+        if self.dense:
+            sel = jnp.asarray(np.asarray(ids, np.int32))
+            self._stacked = jax.tree.map(
+                lambda full, upd: full.at[sel].set(upd),
+                self._stacked, updated)
+            return
+        host = jax.tree.map(np.asarray, updated)
+        for k, i in enumerate(ids):
+            self._rows[int(i)] = jax.tree.map(lambda x: x[k].copy(), host)
+
+    def peek(self, client_id):
+        """One client's residual as host numpy (zeros if never touched)
+        -- the regression tests' observation point."""
+        import numpy as np
+
+        if self.dense:
+            return jax.tree.map(
+                lambda x: np.asarray(x[int(client_id)]), self._stacked)
+        r = self._rows.get(int(client_id))
+        if r is None:
+            return jax.tree.map(
+                lambda s: np.zeros(s.shape, s.dtype), self._template)
+        return jax.tree.map(lambda x: np.asarray(x), r)
+
+
 def compressed_payload_nbytes(compressor: Compressor, params_template) -> int:
     """Exact per-client on-wire bytes of one compressed update, computed
     from abstract shapes (``jax.eval_shape`` -- nothing runs on device).
@@ -117,5 +215,5 @@ def raw_payload_nbytes(params_template) -> int:
     return tree_wire_nbytes(shapes)
 
 
-__all__ = ["make_compressed_sim_round", "compressed_payload_nbytes",
-           "raw_payload_nbytes"]
+__all__ = ["make_compressed_sim_round", "ResidualStore",
+           "compressed_payload_nbytes", "raw_payload_nbytes"]

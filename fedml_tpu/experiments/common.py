@@ -114,11 +114,23 @@ def add_base_args(parser: argparse.ArgumentParser):
                         "(audit/retraces_per_round, "
                         "audit/transfer_guard_violations, ...) goes to the "
                         "metrics sink at the end of the run")
+    p.add_argument("--compile_cache_dir", type=str, default=None,
+                   help="persistent XLA compilation cache directory "
+                        "(default: FEDML_TPU_COMPILE_CACHE env or "
+                        "~/.cache/fedml_tpu/xla; the first bite of the "
+                        "155-193 s per-config compile item -- warm-cache "
+                        "restarts skip compilation entirely, measured by "
+                        "the CompileWatcher per-round compile events)")
     # resilience knobs (fedml_tpu.resilience): over-selection, report
     # deadline, quorum, simulated stragglers; --resume above is the
     # recovery half
     from fedml_tpu.resilience.integration import add_resilience_args
     add_resilience_args(p)
+    # buffered-async aggregation + bucketed ragged streaming
+    # (fedml_tpu.resilience.async_agg / parallel.engine
+    # BucketedStreamRunner): the massive-cohort knobs
+    from fedml_tpu.resilience.async_agg import add_async_args
+    add_async_args(p)
     # observability knobs (fedml_tpu.observability): span tracing, trace
     # export dir, control-plane flight recorder
     from fedml_tpu.observability import add_observability_args
@@ -146,7 +158,7 @@ def setup(args, run_name=None):
         import jax
         jax.config.update("jax_platforms", args.platform)
     from fedml_tpu.utils.compile_cache import enable_compilation_cache
-    enable_compilation_cache()
+    enable_compilation_cache(getattr(args, "compile_cache_dir", None))
     proc, nproc = maybe_initialize_distributed()
     init_logging(proctitle=run_name)
     logging.info("args = %s (process %d/%d)", vars(args), proc, nproc)

@@ -197,31 +197,23 @@ def make_indexed_client_update(spec: TrainSpec, cfg: ClientUpdateConfig):
     return client_update
 
 
-def make_loop_client_update(spec: TrainSpec, cfg: ClientUpdateConfig):
-    """Per-client local training as a ``fori_loop`` with a DYNAMIC trip count.
+def _make_trip_loop_core(spec: TrainSpec, cfg: ClientUpdateConfig):
+    """THE dynamic-trip training loop, shared by every variant that runs
+    exactly ``trip`` (traced-scalar) steps: grad + optimizer step +
+    masked valid-select + running metric sums. The variants
+    (:func:`make_loop_client_update` over device-resident data + index
+    schedules, :func:`make_streamed_client_update` over pre-gathered
+    chunk batches) differ ONLY in their ``batch_at`` -- fixes to
+    masking, augmentation RNG, or optimizer semantics land here once.
 
-    ``fn(global_state, data, sched, steps, rng) -> (local_state, aux,
-    metrics_sum)``. Unlike :func:`make_indexed_client_update`'s fixed-length
-    ``scan``, the step loop runs exactly ``steps`` iterations where ``steps``
-    is a *traced scalar* -- so one compiled program serves every wave length,
-    and steps past a wave's true maximum are never executed at all (instead
-    of executing fully-masked fwd+bwd no-ops). Metrics accumulate as running
-    sums in the carry; schedule rows are fetched with ``dynamic_index_in_dim``.
+    Returns ``run(global_state, batch_at, trip, rng) ->
+    (params, rest, metrics_sum)``.
     """
     optimizer = make_optimizer(cfg)
 
-    def client_update(global_state, data, sched, steps, rng):
+    def run(global_state, batch_at, trip, rng):
         params, rest = _split_state(global_state)
         opt_state = optimizer.init(params)
-
-        def batch_at(i):
-            idx_b = jax.lax.dynamic_index_in_dim(
-                sched["idx"], i, axis=0, keepdims=False)
-            mask_b = jax.lax.dynamic_index_in_dim(
-                sched["mask"], i, axis=0, keepdims=False)
-            return {"x": jnp.take(data["x"], idx_b, axis=0),
-                    "y": jnp.take(data["y"], idx_b, axis=0),
-                    "mask": mask_b}
 
         def grad_at(params, rest, batch, step_rng):
             if spec.augment_fn is not None:
@@ -259,7 +251,36 @@ def make_loop_client_update(spec: TrainSpec, cfg: ClientUpdateConfig):
             return (params, rest, opt_state, msum)
 
         params, rest, _, msum = jax.lax.fori_loop(
-            0, steps, body, (params, rest, opt_state, metrics0))
+            0, trip, body, (params, rest, opt_state, metrics0))
+        return params, rest, msum
+
+    return run
+
+
+def make_loop_client_update(spec: TrainSpec, cfg: ClientUpdateConfig):
+    """Per-client local training as a ``fori_loop`` with a DYNAMIC trip count.
+
+    ``fn(global_state, data, sched, steps, rng) -> (local_state, aux,
+    metrics_sum)``. Unlike :func:`make_indexed_client_update`'s fixed-length
+    ``scan``, the step loop runs exactly ``steps`` iterations where ``steps``
+    is a *traced scalar* -- so one compiled program serves every wave length,
+    and steps past a wave's true maximum are never executed at all (instead
+    of executing fully-masked fwd+bwd no-ops). Metrics accumulate as running
+    sums in the carry; schedule rows are fetched with ``dynamic_index_in_dim``.
+    """
+    run = _make_trip_loop_core(spec, cfg)
+
+    def client_update(global_state, data, sched, steps, rng):
+        def batch_at(i):
+            idx_b = jax.lax.dynamic_index_in_dim(
+                sched["idx"], i, axis=0, keepdims=False)
+            mask_b = jax.lax.dynamic_index_in_dim(
+                sched["mask"], i, axis=0, keepdims=False)
+            return {"x": jnp.take(data["x"], idx_b, axis=0),
+                    "y": jnp.take(data["y"], idx_b, axis=0),
+                    "mask": mask_b}
+
+        params, rest, msum = run(global_state, batch_at, steps, rng)
         local_state = dict(rest)
         local_state["params"] = params
         steps_done = jnp.sum(jnp.any(sched["mask"] > 0, axis=-1))
@@ -267,6 +288,322 @@ def make_loop_client_update(spec: TrainSpec, cfg: ClientUpdateConfig):
         return local_state, aux, msum
 
     return client_update
+
+
+def make_streamed_client_update(spec: TrainSpec, cfg: ClientUpdateConfig):
+    """Per-client local training over PRE-GATHERED batch arrays with a
+    dynamic trip count -- the bucketed-streaming unit.
+
+    ``fn(global_state, batches, n, trip, rng) -> (local_state, aux,
+    metrics_sum)`` where ``batches`` is ``{"x": [S, B, ...], "y":
+    [S, B, ...], "mask": [S, B]}`` staged per chunk (no device-resident
+    dataset -- the cohort axis is unbounded) and ``trip`` is a *traced*
+    scalar: the loop executes exactly ``trip`` steps, so steps past a
+    chunk's true maximum are never run even though the array shape is
+    padded to the bucket edge. Fully-masked steps inside the trip are
+    guarded no-ops (same valid-select as every other update variant --
+    the training loop itself is :func:`_make_trip_loop_core`).
+    """
+    run = _make_trip_loop_core(spec, cfg)
+
+    def client_update(global_state, batches, n, trip, rng):
+        def batch_at(i):
+            return {k: jax.lax.dynamic_index_in_dim(
+                        batches[k], i, axis=0, keepdims=False)
+                    for k in ("x", "y", "mask")}
+
+        params, rest, msum = run(global_state, batch_at, trip, rng)
+        local_state = dict(rest)
+        local_state["params"] = params
+        steps_done = jnp.sum(jnp.any(batches["mask"] > 0, axis=-1))
+        aux = {"n": n, "steps": steps_done}
+        return local_state, aux, msum
+
+    return client_update
+
+
+class BucketedStreamRunner:
+    """Bucketed ragged streaming: one chip, an UNBOUNDED cohort axis.
+
+    The device-resident runners cap the cohort at what fits HBM and pad
+    every client's schedule to the cohort max -- both walls at population
+    scale (the paper's premise is O(10^4-10^6) non-IID clients with
+    ragged sample counts per round). This runner removes both:
+
+    - **Bucketing bounds padded compute.** The cohort is sorted ASCENDING
+      by local step count and cut into fixed-size chunks; each chunk's
+      schedule pads to the smallest GEOMETRIC edge covering it
+      (``packing.parse_bucket_edges`` -- the compiled-shape anchor) while
+      the dispatch's ``fori_loop`` trip count is the chunk's true maximum
+      (a traced scalar), so steps past it never execute at all. Sorted
+      neighbors make chunks near-homogeneous: executed-step waste is the
+      sorted-adjacency slack (~0%, LPT-grade), and the edge only bounds
+      the *allocated* shape. Fastest-first dispatch also mirrors a real
+      async population's report order, so the staleness the async fold
+      sees is honest.
+    - **Streaming bounds memory.** Each dispatch stages one chunk's
+      batches host->device (``packing.gather_batches``) and returns only
+      the chunk's weighted payload SUM -- O(client_chunk) data and O(1)
+      model state on device, regardless of cohort size. The per-chunk
+      partials fold on host in float64 (the
+      ``resilience.policy.fold_entries_fp64`` canonical fold) and one
+      jitted ``advance_fn`` applies the server update.
+    - **One compiled program per bucket shape**, pinned: ``trip`` is
+      traced and every chunk of a bucket shares the edge-padded shape, so
+      steady-state retraces are zero and ``compiled_shapes()`` equals the
+      number of non-empty buckets (asserted in CI).
+
+    Async composition: pass a ``resilience.async_agg.BufferedAggregator``
+    and the stream folds chunk partials through it instead -- up to
+    ``async_window`` chunks stay in flight (the simulated client
+    concurrency), every ``buffer_k`` folded clients flush a server update
+    MID-ROUND, and chunks dispatched before a flush fold in staleness-
+    discounted. With an unbounded buffer and decay 0 this reduces to the
+    synchronous fold bit-for-bit (the CI oracle).
+    """
+
+    def __init__(self, spec: TrainSpec, cfg: ClientUpdateConfig,
+                 payload_fn=None, server_fn=None, client_chunk=256,
+                 batch_size=32, epochs=1, edges=(8,), step_bucket=8):
+        self.payload_fn = payload_fn or _default_payload
+        self.server_fn = server_fn or _default_server
+        self.client_chunk = max(1, int(client_chunk))
+        self.batch_size = int(batch_size)
+        self.epochs = int(epochs)
+        self.edges = sorted(int(e) for e in edges)
+        self.step_bucket = int(step_bucket)
+        client_update = make_streamed_client_update(spec, cfg)
+        payload_fn_ = self.payload_fn
+        server_fn_ = self.server_fn
+
+        @jax.jit
+        def chunk_fn(global_state, batches, ns, trip, rngs):
+            local_states, aux, metrics = jax.vmap(
+                client_update, in_axes=(None, 0, 0, None, 0))(
+                    global_state, batches, ns, trip, rngs)
+            payloads = jax.vmap(payload_fn_, in_axes=(0, None, 0))(
+                local_states, global_state, aux)
+            w = aux["n"].astype(jnp.float32)
+            pay_sum = jax.tree.map(
+                lambda x: jnp.tensordot(w, x.astype(jnp.float32),
+                                        axes=(0, 0)),
+                payloads)
+            metrics_sum = jax.tree.map(lambda m: jnp.sum(m, axis=0),
+                                       metrics)
+            return pay_sum, jnp.sum(w), metrics_sum
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def advance_fn(global_state, server_state, avg_payload, rng):
+            return server_fn_(global_state, avg_payload, server_state, rng)
+
+        self._chunk_fn = chunk_fn
+        self._advance_fn = advance_fn
+        self._dtypes = None
+
+    def _payload_dtypes(self, global_state):
+        if self._dtypes is None:
+            self._dtypes = payload_dtype_template(self.payload_fn,
+                                                  global_state)
+        return self._dtypes
+
+    def compiled_shapes(self) -> int:
+        """Distinct compiled chunk programs (should equal the number of
+        non-empty buckets ever dispatched -- the retrace-audit anchor)."""
+        try:
+            return int(self._chunk_fn._cache_size())
+        except AttributeError:  # older jax: no cache introspection
+            return -1
+
+    def run_round(self, global_state, server_state, datasets, rng,
+                  data_rng=None, aggregator=None, async_window=4):
+        """One federated round over ``datasets`` (the cohort's raw client
+        shards, list of ``{"x", "y"}``), streamed bucket by bucket.
+
+        ``aggregator`` (optional ``BufferedAggregator``) switches the
+        host-side fold to buffered-async; otherwise the partials fold
+        synchronously. Returns ``(new_global, new_server_state, info)``
+        with ``info["bucket"]`` (waste accounting) and ``info["async"]``
+        (buffer counters) next to the usual ``aux``/``metrics``.
+        """
+        import numpy as np
+        from collections import deque
+
+        from fedml_tpu.parallel.packing import (
+            _steps_for, bucket_edge_for, gather_batches, pack_schedule)
+
+        data_rng = data_rng or np.random.default_rng(0)
+        C = len(datasets)
+        if C == 0:
+            raise ValueError("bucketed round over an empty cohort")
+        ns = [len(d["y"]) for d in datasets]
+        if sum(ns) == 0:
+            raise ValueError("bucketed round: every client shard is empty")
+        if self.batch_size in (-1, 0):
+            # full-batch convention: resolve ONCE (first cohort seen) and
+            # pin it -- a per-cohort B would change the [C, S, B] compiled
+            # shape whenever a re-sampled cohort's largest shard differs,
+            # breaking the zero-steady-state-retrace invariant. FedAvgAPI
+            # resolves from the POPULATION max before construction.
+            self.batch_size = max(1, max(ns))
+        bs = self.batch_size
+        steps_pc = np.asarray(
+            [_steps_for(max(n, 1), bs, self.epochs) for n in ns], np.int64)
+        bucket_edge_for(steps_pc.max(), self.edges)  # top-edge guard
+        client_keys = np.asarray(
+            jax.random.split(jax.random.fold_in(rng, 1), C))
+        dtypes = self._payload_dtypes(global_state)
+        flush_rng = jax.random.fold_in(rng, 2)
+
+        gs, ss = global_state, server_state
+        flushes = 0
+        metrics_acc = None
+        # sync path: incremental canonical fold. Entries are consumed in
+        # ordinal (= sorted-key) order, so accumulating here is bitwise
+        # fold_entries_fp64 over the same entries -- with O(1 model) host
+        # memory instead of retaining every chunk payload to round end
+        sync_acc = {"num": None, "w": 0.0}
+        inflight = deque()
+        exec_steps = 0
+        per_bucket = []
+        tracer = get_tracer()
+
+        def apply_avg(avg, f):
+            # avg: f32 numpy pytree from the canonical fold; cast through
+            # the payload dtype template (accumulators run f32/f64, the
+            # model may not) and run the donated server step
+            nonlocal gs, ss
+            avg_dev = jax.tree.map(
+                lambda a, d: jnp.asarray(np.asarray(a), d.dtype), avg,
+                dtypes)
+            gs, ss = self._advance_fn(gs, ss, avg_dev,
+                                      jax.random.fold_in(flush_rng, f))
+
+        def fold_oldest():
+            nonlocal flushes, metrics_acc
+            ordinal, born, k_real, handles = inflight.popleft()
+            # FIRST host touch of this chunk's outputs: the device sync
+            # point. Everything stays a device handle until here, so up
+            # to async_window chunks genuinely overlap host packing/H2D
+            # staging with device compute.
+            pay = jax.tree.map(np.asarray, handles[0])
+            w = float(np.asarray(handles[1]))
+            m_host = jax.tree.map(
+                lambda m: np.asarray(m, np.float64), handles[2])
+            metrics_acc = m_host if metrics_acc is None else \
+                jax.tree.map(np.add, metrics_acc, m_host)
+            staleness = (aggregator.version - born) if aggregator else 0
+            if aggregator is None:
+                contrib = jax.tree.map(
+                    lambda x: np.asarray(x, np.float64), pay)
+                sync_acc["num"] = contrib if sync_acc["num"] is None \
+                    else jax.tree.map(np.add, sync_acc["num"], contrib)
+                sync_acc["w"] += w
+                return
+            aggregator.fold(ordinal, w, pay, staleness=staleness,
+                            clients=k_real, preweighted=True)
+            if aggregator.ready():
+                res = aggregator.flush("buffer_k")
+                apply_avg(res.params, flushes)
+                flushes += 1
+
+        # fastest-first streaming: the cohort is sorted ASCENDING by step
+        # count and cut into chunks; each chunk's schedule is padded to
+        # the smallest covering bucket edge (the compiled-shape anchor)
+        # while its fori_loop trip is the chunk's true maximum. Sorted
+        # neighbors make chunks near-homogeneous, so executed-step waste
+        # is the sorted-adjacency slack (~0%, LPT-grade) -- and dispatch
+        # order mirrors a real async population, whose fastest clients
+        # report first (the staleness the async fold sees is honest).
+        order = np.argsort(steps_pc, kind="stable")
+        b_stats = {e: {"clients": 0, "chunks": 0, "executed_steps": 0,
+                       "true_steps": 0} for e in self.edges}
+        ordinal = 0
+        for c0 in range(0, C, self.client_chunk):
+            chunk = [int(i) for i in order[c0:c0 + self.client_chunk]]
+            k = len(chunk)
+            trip = int(steps_pc[chunk].max())
+            edge = int(bucket_edge_for(trip, self.edges))
+            sched = pack_schedule([ns[i] for i in chunk], bs, self.epochs,
+                                  rng=data_rng, s_max=edge,
+                                  step_bucket=self.step_bucket)
+            xb, yb = gather_batches(datasets, sched, chunk)
+            maskb = sched["mask"]
+            n_arr = sched["n"]
+            rngs = client_keys[chunk]
+            if k < self.client_chunk:  # ragged final chunk: pad to the
+                # bucket's ONE compiled shape with inert clients
+                pad = self.client_chunk - k
+                xb, yb, maskb, n_arr = zero_pad_leading(
+                    (xb, yb, maskb, n_arr), pad)
+                rngs = np.concatenate([rngs, rngs[:1].repeat(pad, 0)])
+            born = aggregator.version if aggregator else 0
+            with tracer.span("bucket-chunk", edge=edge, clients=int(k),
+                             trip=trip):
+                pay_sum, w_sum, msum = self._chunk_fn(
+                    gs, {"x": jnp.asarray(xb), "y": jnp.asarray(yb),
+                         "mask": jnp.asarray(maskb)},
+                    jnp.asarray(n_arr), jnp.int32(trip),
+                    jnp.asarray(rngs))
+            inflight.append((ordinal, born, k, (pay_sum, w_sum, msum)))
+            ordinal += 1
+            st = b_stats[edge]
+            st["clients"] += k
+            st["chunks"] += 1
+            # padded lanes of the (single) ragged final chunk run too --
+            # the waste accounting counts every executed vmap lane
+            st["executed_steps"] += trip * self.client_chunk
+            st["true_steps"] += int(steps_pc[chunk].sum())
+            exec_steps += trip * self.client_chunk
+            while len(inflight) > max(1, int(async_window)):
+                fold_oldest()
+        for e in self.edges:
+            st = b_stats[e]
+            per_bucket.append({"edge": int(e), "skipped": int(
+                st["chunks"] == 0), **st})
+
+        while inflight:
+            fold_oldest()
+        if aggregator is not None:
+            if aggregator.depth:
+                # round-boundary drain: whatever is buffered flushes even
+                # below K (the stream is over; holding updates across
+                # rounds would starve the last window)
+                res = aggregator.flush("drain")
+                apply_avg(res.params, flushes)
+                flushes += 1
+            async_info = aggregator.record()
+            async_info["async/flushes_this_round"] = flushes
+        else:
+            total = sync_acc["w"]
+            if sync_acc["num"] is None or total <= 0:
+                raise ValueError("bucketed round folded zero weight "
+                                 "(every cohort shard empty?)")
+            avg = jax.tree.map(
+                lambda x: (x / total).astype(np.float32), sync_acc["num"])
+            apply_avg(avg, 0)
+            flushes = 1
+            async_info = None
+
+        true_steps = int(steps_pc.sum())
+        info = {
+            "aux": {"n": np.asarray(ns, np.float32),
+                    "steps": steps_pc.astype(np.int64)},
+            "metrics": metrics_acc,
+            "bucket": {
+                "edges": list(self.edges),
+                "buckets_used": sum(1 for b in per_bucket
+                                    if not b["skipped"]),
+                "clients": C, "chunks": ordinal,
+                "executed_steps": int(exec_steps),
+                "true_steps": true_steps,
+                "waste_frac": round(1.0 - true_steps / max(exec_steps, 1),
+                                    4),
+                "per_bucket": per_bucket,
+            },
+        }
+        if async_info is not None:
+            info["async"] = async_info
+        return gs, ss, info
 
 
 class WaveRunner:

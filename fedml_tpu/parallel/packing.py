@@ -160,7 +160,7 @@ def stack_clients(client_datasets, n_max=None):
 
 
 def pack_schedule(ns, batch_size, epochs, rng=None, drop_last=False,
-                  step_bucket=8, native="auto"):
+                  step_bucket=8, native="auto", s_max=None):
     """Index schedule only -- no data movement.
 
     Args: ``ns`` per-client sample counts. Returns ``{"idx": [C, S, B]
@@ -168,15 +168,23 @@ def pack_schedule(ns, batch_size, epochs, rng=None, drop_last=False,
     epoch/batch semantics as ``pack_cohort``. The C++ shim generates it
     when available; the numpy fallback shares semantics (shuffles differ --
     different RNG families -- but both are seeded from the same host
-    generator so runs stay reproducible/resumable).
+    generator so runs stay reproducible/resumable). ``s_max`` forces the
+    step axis to a caller-chosen length (the bucketed streaming path pins
+    it to the bucket edge so every chunk of a bucket shares ONE compiled
+    shape); it must cover the cohort's true maximum.
     """
     rng = rng or np.random.default_rng(0)
     ns = [int(v) for v in ns]
     C = len(ns)
     if batch_size in (-1, 0):
         batch_size = max(1, max(ns))
-    S = max(_steps_for(n, batch_size, epochs, drop_last) for n in ns)
-    S = int(math.ceil(S / step_bucket) * step_bucket)
+    true_max = max(_steps_for(n, batch_size, epochs, drop_last) for n in ns)
+    S = int(math.ceil(true_max / step_bucket) * step_bucket)
+    if s_max is not None:
+        if int(s_max) < true_max:
+            raise ValueError(f"s_max={s_max} below the cohort's true max "
+                             f"step count {true_max}")
+        S = int(s_max)
     B = batch_size
 
     # one-draw contract and backend resolution identical to pack_cohort's,
@@ -311,6 +319,78 @@ def pack_lanes(sched, n_lanes, step_bucket=8, l_max=None, native="auto"):
     return {"idx": out_idx, "mask": out_mask, "slot": slot,
             "local_step": local_step, "flush": flush, "flush_n": flush_n,
             "flush_steps": flush_steps, "trip": int(loads.max())}
+
+
+def parse_bucket_edges(spec, s_max, step_bucket=8):
+    """Resolve a ``--bucket_edges`` spec into sorted step-count edges.
+
+    ``spec`` is ``None``/``"geometric"``/``"geo"`` for power-of-two edges
+    ``[b, 2b, 4b, ...]`` (b = ``step_bucket``) covering ``s_max``, or an
+    explicit comma list (``"8,16,48"``). Explicit lists that stop short of
+    ``s_max`` are extended geometrically (doubling the last edge) so every
+    client has a bucket -- a client can exceed the top edge mid-run only
+    if the caller sized edges from a stale population, and silently
+    truncating its schedule would be a correctness bug.
+
+    Edges are jit-shape anchors: one compiled program per edge, so the
+    list should be short (geometric gives ``O(log s_max)``) and STABLE
+    across rounds -- size it from the population's max step count, not a
+    cohort's.
+    """
+    s_max = max(1, int(s_max))
+    if spec is None or str(spec).strip().lower() in ("geometric", "geo",
+                                                     "auto", ""):
+        edges = [int(step_bucket)]
+        while edges[-1] < s_max:
+            edges.append(edges[-1] * 2)
+        return edges
+    edges = sorted({int(v) for v in str(spec).split(",") if str(v).strip()})
+    if not edges or any(e <= 0 for e in edges):
+        raise ValueError(f"invalid bucket edge spec {spec!r}")
+    while edges[-1] < s_max:
+        edges.append(edges[-1] * 2)
+    return edges
+
+
+def bucket_edge_for(steps, edges):
+    """THE edge-assignment rule of the bucketed streaming engine: the
+    smallest edge covering ``steps`` (vector or scalar) -- a step count
+    exactly ON an edge lands in that edge's bucket, no off-by-one
+    padding to the next one. Raises when any step count exceeds the top
+    edge (silently truncating a client's schedule would be a correctness
+    bug; size edges from the population max)."""
+    steps = np.asarray(steps, np.int64)
+    edge_arr = np.asarray(sorted(int(e) for e in edges), np.int64)
+    if steps.size and int(steps.max()) > edge_arr[-1]:
+        raise ValueError(
+            f"client with {int(steps.max())} steps exceeds the top bucket "
+            f"edge {edge_arr[-1]} (size edges from the population max)")
+    return edge_arr[np.searchsorted(edge_arr, steps, side="left")]
+
+
+def gather_batches(datasets, sched, members):
+    """Materialize a schedule's batches from raw client shards:
+    ``xb[c, s, b] = datasets[members[c]]["x"][sched["idx"][c, s, b]]``.
+
+    This is the streaming path's host->device staging unit -- called per
+    chunk, so peak host memory is one chunk's batches, never the cohort's
+    (the cohort axis is unbounded). Masked slots gather row 0 of their
+    client; the mask zeroes their loss contribution downstream.
+    """
+    idx = np.asarray(sched["idx"])
+    C, S, B = idx.shape
+    x0 = np.asarray(datasets[members[0]]["x"])
+    y0 = np.asarray(datasets[members[0]]["y"])
+    xb = np.zeros((C, S, B) + x0.shape[1:], x0.dtype)
+    yb = np.zeros((C, S, B) + y0.shape[1:], y0.dtype)
+    for c, m in enumerate(members):
+        d = datasets[m]
+        x, y = np.asarray(d["x"]), np.asarray(d["y"])
+        if len(y) == 0:
+            continue
+        xb[c] = x[idx[c]]
+        yb[c] = y[idx[c]]
+    return xb, yb
 
 
 def pack_eval(data, batch_size, pad_multiple=1):

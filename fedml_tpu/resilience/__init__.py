@@ -9,6 +9,9 @@ failure a first-class, *testable* event for the control plane:
                      transport (drop/delay/duplicate/reorder/stall/kill).
 - ``policy``      -- send retry with exponential backoff; over-selection,
                      report deadlines, quorum, round abandonment.
+- ``async_agg``   -- FedBuff-style buffered ASYNC aggregation: fold
+                     updates as they arrive, staleness-weighted, server
+                     update every K folds -- no round barrier.
 - ``recovery``    -- round-granular crash/resume over utils/checkpoint.
 - ``integration`` -- wiring into FedAvg-family algorithms, the comm
                      managers, MetricsLogger, and the experiment flags.
@@ -16,6 +19,12 @@ failure a first-class, *testable* event for the control plane:
 See docs/RESILIENCE.md for the failure model and determinism contract.
 """
 
+from fedml_tpu.resilience.async_agg import (AsyncAggPolicy,
+                                            AsyncBufferedFedAvgServer,
+                                            BufferedAggregator,
+                                            add_async_args,
+                                            run_async_tcp_fedavg,
+                                            staleness_weight)
 from fedml_tpu.resilience.faults import (ACTIONS, FaultPlan, FaultRule,
                                          FaultyCommManager)
 from fedml_tpu.resilience.integration import (ResilientFedAvgClient,
@@ -29,15 +38,18 @@ from fedml_tpu.resilience.policy import (ROUND_ABANDONED, ROUND_COMPLETE,
                                          PeerUnreachableError,
                                          RetryPolicy, RoundController,
                                          RoundPolicy, aggregate_reports,
+                                         fold_entries_fp64,
                                          send_with_retry)
 from fedml_tpu.resilience.recovery import RoundRecovery
 
 __all__ = [
     "ACTIONS", "FaultRule", "FaultPlan", "FaultyCommManager",
     "RetryPolicy", "RoundPolicy", "RoundController", "PeerUnreachableError",
-    "send_with_retry", "aggregate_reports",
+    "send_with_retry", "aggregate_reports", "fold_entries_fp64",
     "ROUND_COMPLETE", "ROUND_DEGRADED", "ROUND_ABANDONED",
     "RoundRecovery",
     "SimResilience", "ResilientFedAvgClient", "ResilientFedAvgServer",
     "add_resilience_args", "quadratic_trainer", "run_tcp_fedavg",
+    "AsyncAggPolicy", "BufferedAggregator", "AsyncBufferedFedAvgServer",
+    "staleness_weight", "add_async_args", "run_async_tcp_fedavg",
 ]

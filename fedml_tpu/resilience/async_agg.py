@@ -1,0 +1,566 @@
+"""FedBuff-style buffered asynchronous aggregation (Nguyen et al.,
+AISTATS 2022), composed with this repo's partial-aggregation substrate.
+
+The synchronous control plane (``policy.RoundController``) is a barrier:
+a round holds the server until ``target`` reports (or the deadline)
+arrive. At population scale the barrier IS the bottleneck -- Bonawitz et
+al. (MLSys 2019 §3) already pace-steer around it, and FedBuff removes it:
+the server folds client updates into a buffer *as they arrive*,
+staleness-weighted, and applies a server update every K folds (or on a
+flush deadline). No client ever waits on a straggler; a straggler's
+late update still counts, just down-weighted by how many server versions
+it missed.
+
+Design notes, in decreasing order of importance:
+
+- **Determinism over arrival order.** :meth:`BufferedAggregator.flush`
+  folds the buffered entries through
+  :func:`~fedml_tpu.resilience.policy.fold_entries_fp64` -- the same
+  sorted-key float64 fold ``aggregate_reports`` uses -- NOT in arrival
+  order. Two runs that buffer the same entries flush bitwise-identical
+  results no matter how the reports raced. This is also what makes the
+  correctness oracle exact: with an infinite flush deadline, staleness
+  decay 0 (weight 1) and ``buffer_k`` = cohort size, one flush IS
+  ``aggregate_reports`` of the same reports, bit for bit.
+- **Staleness weighting** is polynomial (FedBuff's ``1/sqrt(1+s)`` is
+  the ``staleness_decay=0.5`` point): an update born at server version
+  ``v0`` and folded at ``v`` carries weight multiplier
+  ``(1 + (v - v0)) ** -staleness_decay``.
+- **Flush-time re-sync** (distributed FSM): a reporting client receives
+  the next model when its contribution is *consumed* by a flush, not
+  immediately on report. Fast clients therefore cycle in windows of K
+  without ever waiting for stragglers (barrier-free), while each client
+  contributes at most one update per flush window -- which keeps the
+  oracle settings exactly equivalent to the synchronous round and keeps
+  "degraded" meaningful without a barrier: a deadline flush below K is
+  the async analog of a degraded round (see docs/RESILIENCE.md).
+
+The sim path (``parallel/engine.py BucketedStreamRunner``) feeds the same
+aggregator with PRE-WEIGHTED bucket-chunk partial sums (``preweighted=
+True``): a chunk dispatched at version ``v0`` and folded after later
+flushes is a stale cohort slice, exactly the semantics a real async
+population shows, simulated on one chip.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from fedml_tpu.core.locks import audited_lock, audited_rlock
+from fedml_tpu.core.comm.base import MSG_TYPE_PEER_LOST
+from fedml_tpu.core.message import Message
+from fedml_tpu.core.managers import ServerManager
+from fedml_tpu.observability.registry import get_registry
+from fedml_tpu.observability.tracing import get_tracer
+from fedml_tpu.resilience.policy import (
+    RetryPolicy, fold_entries_fp64, send_with_retry)
+
+# the async server speaks the SAME message schema as the synchronous FSM
+# (ResilientFedAvgClient is reused unchanged); import the types from the
+# integration module so fedcheck's pairing pass sees one vocabulary
+from fedml_tpu.resilience.integration import (  # noqa: F401 (re-export)
+    MSG_C2S_REPORT, MSG_S2C_SYNC, ResilientFedAvgClient)
+
+
+@dataclass(frozen=True)
+class AsyncAggPolicy:
+    """Buffered-async aggregation knobs (FedBuff, Nguyen et al. 2022).
+
+    Args:
+      buffer_k: server update every K buffered client updates (FedBuff's
+        K; the flush also fires early when every still-alive client has
+        reported -- a buffer that can never fill must not deadlock).
+      staleness_decay: polynomial staleness exponent ``a``; an update
+        ``s`` versions stale is weighted ``(1 + s) ** -a``. ``0`` weights
+        every update 1 (the oracle setting); ``0.5`` is FedBuff's
+        ``1/sqrt(1+s)``.
+      flush_deadline_s: wall-clock bound from the first fold of a window
+        to its flush; ``0`` disables (flush only on K). The async analog
+        of the synchronous report deadline: a deadline flush below K is
+        counted ``degraded``.
+      async_window: simulation only -- how many in-flight bucket chunks
+        the streaming engine keeps dispatched before folding the oldest
+        (the simulated client concurrency; staleness appears when
+        ``buffer_k`` flushes fall inside the window).
+    """
+
+    buffer_k: int = 64
+    staleness_decay: float = 0.5
+    flush_deadline_s: float = 0.0
+    async_window: int = 4
+
+    @classmethod
+    def from_args(cls, args) -> Optional["AsyncAggPolicy"]:
+        if not int(getattr(args, "async_agg", 0) or 0):
+            return None
+        return cls(
+            buffer_k=int(getattr(args, "buffer_k", 64) or 64),
+            staleness_decay=float(getattr(args, "staleness_decay", 0.5)),
+            flush_deadline_s=float(getattr(args, "flush_deadline", 0.0)
+                                   or 0.0),
+            async_window=int(getattr(args, "async_window", 4) or 4))
+
+
+def staleness_weight(staleness, decay) -> float:
+    """Polynomial staleness discount ``(1 + s) ** -decay`` (monotone
+    non-increasing in ``s``; exactly 1.0 at ``s == 0`` or ``decay == 0``,
+    so the oracle settings multiply by a float64-exact 1.0)."""
+    s = max(0, int(staleness))
+    if s == 0 or decay == 0:
+        return 1.0
+    return float((1.0 + s) ** -float(decay))
+
+
+@dataclass(frozen=True)
+class FlushResult:
+    """One server update produced by :meth:`BufferedAggregator.flush`."""
+
+    params: dict          # f32 pytree (the fold output)
+    weight: float         # renormalization denominator (post-staleness)
+    version: int          # server version AFTER this flush
+    contributors: tuple   # entry keys folded (ranks / chunk ordinals)
+    clients: int          # client updates represented by those entries
+    reason: str           # "buffer_k" | "deadline" | "drain" | "peer_lost"
+    max_staleness: int
+
+
+class BufferedAggregator:
+    """Thread-safe staleness-weighted update buffer with K/deadline flush.
+
+    ``fold`` accepts either per-client reports (``weight`` = the client's
+    sample count, payload = its params) or pre-weighted partial sums from
+    the streaming engine (``preweighted=True``: payload is already
+    ``sum_i n_i * p_i`` over ``clients`` members, ``weight`` their
+    ``sum_i n_i``). Entries are retained until ``flush`` folds them in
+    sorted-key order through :func:`fold_entries_fp64` -- memory is
+    O(buffer_k) payloads and the flushed bytes are arrival-order
+    independent. Re-folding an existing key overwrites (newest wins --
+    the older update trained on strictly staler params) and is counted.
+    """
+
+    def __init__(self, policy: AsyncAggPolicy):
+        self.policy = policy
+        self._lock = audited_lock()
+        self._entries = {}        # key -> (weight, payload, scale)
+        self._entry_clients = {}  # key -> client count
+        self._entry_staleness = {}
+        self.version = 0
+        self.counters = {"folds": 0, "flushes": 0, "drain_flushes": 0,
+                         "deadline_flushes": 0, "overwrites": 0,
+                         "clients_folded": 0, "max_staleness": 0,
+                         "depth_peak": 0}
+
+    @property
+    def depth(self) -> int:
+        """Distinct buffered entries (the ``fed_buffer_depth`` gauge)."""
+        with self._lock:
+            return len(self._entries)
+
+    def clients_buffered(self) -> int:
+        with self._lock:
+            return sum(self._entry_clients.values())
+
+    def fold(self, key, weight, payload, staleness=0, clients=1,
+             preweighted=False) -> int:
+        """Buffer one update; returns the post-fold distinct-entry depth.
+
+        ``staleness`` = server versions elapsed since the update's model
+        was issued (``version_now - version_born``); the entry's weight
+        (and, for pre-weighted partials, its numerator scale) is
+        multiplied by :func:`staleness_weight`.
+        """
+        sw = staleness_weight(staleness, self.policy.staleness_decay)
+        w = float(weight) * sw
+        scale = sw if preweighted else w
+        with get_tracer().span("buffer-fold", staleness=int(staleness),
+                               clients=int(clients)) as sp:
+            with self._lock:
+                if key in self._entries:
+                    self.counters["overwrites"] += 1
+                else:
+                    self.counters["clients_folded"] += int(clients)
+                self._entries[key] = (w, payload, scale)
+                self._entry_clients[key] = int(clients)
+                self._entry_staleness[key] = int(staleness)
+                self.counters["folds"] += 1
+                self.counters["max_staleness"] = max(
+                    self.counters["max_staleness"], int(staleness))
+                depth = len(self._entries)
+                self.counters["depth_peak"] = max(
+                    self.counters["depth_peak"], depth)
+            sp.set(depth=depth)
+        reg = get_registry()
+        if reg is not None:
+            reg.set_gauge("fed_buffer_depth", depth,
+                          help="distinct updates buffered awaiting flush")
+            reg.set_gauge("fed_update_staleness", int(staleness),
+                          help="staleness (server versions) of the last "
+                               "folded update")
+        return depth
+
+    def ready(self, target=None) -> bool:
+        """True when the buffered client count reaches ``buffer_k`` --
+        capped by ``target`` (e.g. the number of still-alive clients)
+        so a buffer that can never fill does not deadlock the plane."""
+        k = self.policy.buffer_k
+        if target is not None:
+            k = min(k, int(target))
+        with self._lock:
+            return sum(self._entry_clients.values()) >= max(1, k)
+
+    def flush(self, reason="buffer_k") -> FlushResult:
+        """Fold + clear the buffer, bump the server version."""
+        with self._lock:
+            if not self._entries:
+                raise ValueError("flush of an empty update buffer")
+            entries = [(k, w, p, s)
+                       for k, (w, p, s) in self._entries.items()]
+            clients = sum(self._entry_clients.values())
+            max_stale = max(self._entry_staleness.values())
+            self._entries = {}
+            self._entry_clients = {}
+            self._entry_staleness = {}
+            self.version += 1
+            version = self.version
+            self.counters["flushes"] += 1
+            if reason == "deadline":
+                self.counters["deadline_flushes"] += 1
+            elif reason == "drain":
+                self.counters["drain_flushes"] += 1
+        with get_tracer().span("buffer-flush", reason=reason,
+                               entries=len(entries), clients=clients,
+                               version=version):
+            params, weight = fold_entries_fp64(entries)
+        reg = get_registry()
+        if reg is not None:
+            reg.set_gauge("fed_buffer_depth", 0,
+                          help="distinct updates buffered awaiting flush")
+            reg.inc("fed_buffer_flushes_total",
+                    help="server updates produced by the async buffer",
+                    reason=reason)
+        return FlushResult(params=params, weight=weight, version=version,
+                           contributors=tuple(k for k, _, _, _ in entries),
+                           clients=clients, reason=reason,
+                           max_staleness=max_stale)
+
+    def record(self, prefix="async/") -> dict:
+        """Cumulative counters as a metrics-record fragment (rides every
+        round record on async runs -- the buffer-depth/staleness series
+        lands in metrics.jsonl even with observability off)."""
+        with self._lock:
+            out = {prefix + k: v for k, v in self.counters.items()}
+            out[prefix + "version"] = self.version
+            out[prefix + "buffer_depth"] = len(self._entries)
+        return out
+
+
+def add_async_args(parser):
+    parser.add_argument(
+        "--async_agg", type=int, default=0,
+        help="FedBuff-style buffered async aggregation (Nguyen et al. "
+             "2022): fold client updates as they arrive, staleness-"
+             "weighted, server update every --buffer_k folds -- no round "
+             "barrier. On these mains it runs the single-chip simulation "
+             "(composes with --bucket_edges; --mesh is rejected); the "
+             "distributed FSM is AsyncBufferedFedAvgServer, driven "
+             "programmatically via run_async_tcp_fedavg")
+    parser.add_argument(
+        "--buffer_k", type=int, default=64,
+        help="async aggregation: client updates per server update "
+             "(FedBuff's K)")
+    parser.add_argument(
+        "--staleness_decay", type=float, default=0.5,
+        help="async aggregation: polynomial staleness exponent a -- an "
+             "update s server-versions stale is weighted (1+s)**-a "
+             "(0 = no discount, 0.5 = FedBuff's 1/sqrt(1+s))")
+    parser.add_argument(
+        "--flush_deadline", type=float, default=0.0,
+        help="async aggregation: wall-clock bound from a window's first "
+             "buffered update to its flush (0 = flush only on K); a "
+             "deadline flush below K is the async analog of a degraded "
+             "round")
+    parser.add_argument(
+        "--async_window", type=int, default=4,
+        help="simulation: in-flight bucket chunks before the oldest is "
+             "folded (the simulated client concurrency; staleness "
+             "appears when --buffer_k flushes land inside the window)")
+    parser.add_argument(
+        "--bucket_edges", type=str, default=None,
+        help="bucketed ragged streaming for the simulation rounds: "
+             "'geometric' (power-of-two local-step bucket edges) or an "
+             "explicit comma list e.g. '8,16,32'. Clients are bucketed "
+             "by local-step count, masked-and-padded only within their "
+             "bucket, and streamed through one compiled program per "
+             "bucket shape -- the cohort axis is unbounded "
+             "(parallel/engine.py BucketedStreamRunner)")
+    return parser
+
+
+class AsyncBufferedFedAvgServer(ServerManager):
+    """Rank-0 barrier-free FSM: buffered async aggregation over the same
+    SYNC/REPORT schema as :class:`ResilientFedAvgServer` (clients run the
+    unchanged :class:`ResilientFedAvgClient`).
+
+    Protocol: every alive client gets the v0 model; each report is folded
+    into the :class:`BufferedAggregator` with staleness = current version
+    minus the version the client trained on; a flush (K clients buffered,
+    or the flush deadline) produces server version v+1 and re-syncs
+    exactly the flush's contributors with the new model. Fast clients
+    cycle in windows of K; stragglers' late reports fold into a later
+    window, staleness-discounted. The run ends after ``total_updates``
+    flushes.
+
+    Under the oracle settings (no deadline, decay 0, K >= clients) each
+    flush collects every alive client exactly once and is bitwise
+    ``aggregate_reports`` -- the trajectory equals the synchronous
+    server's, which the A/B test pins.
+    """
+
+    def __init__(self, args, comm, size, init_params, total_updates,
+                 async_policy: AsyncAggPolicy,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 metrics_logger=None, timer_factory=threading.Timer):
+        super().__init__(args, comm, rank=0, size=size)
+        self.params = {k: np.asarray(v) for k, v in init_params.items()}
+        self.total_updates = int(total_updates)
+        self.async_policy = async_policy
+        self.retry_policy = retry_policy or RetryPolicy()
+        self.metrics_logger = metrics_logger
+        self.agg = BufferedAggregator(async_policy)
+        self.alive = set(range(1, size))
+        self.failed = None
+        self.history = []     # params after each flush
+        self.flush_log = []   # per-flush sorted contributor ranks
+        self.counters = {"reports": 0, "late_reports": 0,
+                         "clients_dropped": 0, "retries": 0}
+        self._timer_factory = timer_factory
+        self._timer = None
+        # serializes version turnover/alive/params; all sends happen
+        # OUTSIDE it (same discipline as ResilientFedAvgServer: a
+        # blocking write to a wedged peer must never pin the lock the
+        # fold/flush machinery needs -- fedcheck FL125/FL126)
+        self._advance_lock = audited_rlock()
+
+    # -- FSM surface -------------------------------------------------------
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MSG_C2S_REPORT,
+                                              self._on_report)
+        self.register_message_receive_handler(MSG_TYPE_PEER_LOST,
+                                              self._on_peer_lost)
+
+    def start(self):
+        with self._advance_lock:
+            syncs = [self._make_sync_locked(r) for r in sorted(self.alive)]
+            done = self.total_updates <= 0 or not self.alive
+        if done:  # finish() = transport STOP wave, never under the lock
+            self.finish()
+            return
+        self._send_syncs(syncs)
+
+    def _make_sync_locked(self, rank):
+        m = Message(MSG_S2C_SYNC, 0, rank)
+        m.add("params", self.params)
+        m.add("round", self.agg.version)
+        m.add("attempt", 0)  # schema parity with the synchronous client
+        return m
+
+    def _send_syncs(self, syncs):
+        for m in syncs:
+            try:
+                send_with_retry(self.com_manager, m, self.retry_policy,
+                                counters=self.counters)
+            except (ConnectionError, OSError):
+                pass  # peer-lost dispatch already updated `alive`
+
+    # -- handler threads ---------------------------------------------------
+    def _on_report(self, msg):
+        rank = int(msg.get_sender_id())
+        syncs, done = [], False
+        with self._advance_lock:
+            if self.failed is not None \
+                    or self.agg.version >= self.total_updates:
+                self.counters["late_reports"] += 1
+                logging.info("async server: late report from rank %d "
+                             "(run already finished)", rank)
+                return
+            born = int(msg.get("round"))
+            staleness = max(0, self.agg.version - born)
+            depth = self.agg.fold(
+                rank, float(msg.get("num_samples")),
+                {k: np.asarray(v) for k, v in msg.get("params").items()},
+                staleness=staleness)
+            self.counters["reports"] += 1
+            if self.agg.ready(target=len(self.alive)):
+                done, syncs = self._flush_locked("buffer_k")
+            else:
+                self._arm_deadline_locked()
+                logging.debug("async server: buffered report from rank %d "
+                              "(depth %d, staleness %d)", rank, depth,
+                              staleness)
+        if done:
+            self.finish()
+            return
+        self._send_syncs(syncs)
+
+    def _on_peer_lost(self, msg):
+        rank = int(msg.get_sender_id())
+        syncs, done = [], False
+        with self._advance_lock:
+            if (self.failed is not None
+                    or self.agg.version >= self.total_updates):
+                # teardown race: clients dropping after the final flush
+                # must not mark a completed run failed or flush past
+                # total_updates
+                logging.info("async server: peer-lost for rank %d after "
+                             "run end (ignored)", rank)
+                return
+            if rank in self.alive:
+                self.alive.discard(rank)
+                self.counters["clients_dropped"] += 1
+                logging.warning("async server: client rank %d lost "
+                                "(%d alive)", rank, len(self.alive))
+            else:
+                logging.info("async server: duplicate peer-lost for rank "
+                             "%d (already dropped)", rank)
+            if not self.alive:
+                self.failed = "every client is lost"
+                done = True
+            elif (self.agg.depth
+                  and self.agg.ready(target=len(self.alive))):
+                # the lost peer was the one the buffer was waiting on
+                done, syncs = self._flush_locked("peer_lost")
+        if done:
+            self.finish()
+            return
+        self._send_syncs(syncs)
+
+    # -- flush machinery (runs UNDER _advance_lock) ------------------------
+    def _flush_locked(self, reason):
+        self._cancel_timer_locked()
+        res = self.agg.flush(reason)
+        self.params = res.params
+        self.history.append(dict(res.params))
+        self.flush_log.append(tuple(sorted(res.contributors)))
+        degraded = res.clients < min(self.async_policy.buffer_k,
+                                     max(1, len(self.alive)))
+        logging.info("async server: flush %d/%d (%s) over %d client(s), "
+                     "max staleness %d%s", res.version, self.total_updates,
+                     reason, res.clients, res.max_staleness,
+                     " [degraded]" if degraded else "")
+        if self.metrics_logger is not None:
+            rec = {"update": res.version, "async/flush_reason": reason,
+                   "async/flush_clients": res.clients,
+                   "async/flush_degraded": int(degraded)}
+            rec.update(self.agg.record())
+            self.metrics_logger(rec)
+        done = res.version >= self.total_updates
+        syncs = []
+        if not done:
+            for r in sorted(set(res.contributors) & self.alive):
+                syncs.append(self._make_sync_locked(r))
+        return done, syncs
+
+    def _arm_deadline_locked(self):
+        if (self.async_policy.flush_deadline_s <= 0
+                or self._timer is not None):
+            return
+        # the timer carries its version generation: a flush cancels it,
+        # but a callback already racing the lock must not flush the NEXT
+        # window it wakes up inside (same pattern as RoundController)
+        self._timer = self._timer_factory(
+            self.async_policy.flush_deadline_s, self._on_flush_deadline,
+            args=(self.agg.version,))
+        self._timer.daemon = True
+        self._timer.start()
+
+    def _cancel_timer_locked(self):
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+    def _on_flush_deadline(self, version):
+        syncs, done = [], False
+        with self._advance_lock:
+            self._timer = None
+            if (self.failed is not None or version != self.agg.version
+                    or self.agg.depth == 0):
+                return  # stale generation / already flushed
+            done, syncs = self._flush_locked("deadline")
+        if done:
+            self.finish()
+            return
+        self._send_syncs(syncs)
+
+    def finish(self):
+        with self._advance_lock:
+            self._cancel_timer_locked()
+        super().finish()
+
+
+def run_async_tcp_fedavg(world_size, total_updates, async_policy,
+                         init_params, fault_plan=None, retry_policy=None,
+                         trainer=None, metrics_logger=None,
+                         host="localhost", port=None, timeout=60.0,
+                         join_timeout=90.0):
+    """Drive a multi-rank TCP buffered-async FedAvg scenario in one
+    process (the async analog of ``integration.run_tcp_fedavg``; clients
+    are the unchanged :class:`ResilientFedAvgClient`). Returns the server
+    (``.history``, ``.flush_log``, ``.counters``, ``.failed``)."""
+    import socket
+
+    from fedml_tpu.core.comm.tcp import TcpCommManager
+    from fedml_tpu.resilience.integration import quadratic_trainer
+
+    if port is None:
+        s = socket.socket()
+        s.bind((host, 0))
+        port = s.getsockname()[1]
+        s.close()
+    trainer = trainer or quadratic_trainer()
+
+    def run_client(rank):
+        comm = TcpCommManager(host, port, rank, world_size, timeout=timeout)
+        if fault_plan is not None:
+            comm = fault_plan.wrap(comm, rank)
+        fsm = ResilientFedAvgClient(None, comm, rank, world_size, trainer)
+        fsm.run()
+
+    threads = [threading.Thread(target=run_client, args=(r,), daemon=True,
+                                name=f"async-client-{r}")
+               for r in range(1, world_size)]
+    for t in threads:
+        t.start()
+    comm = TcpCommManager(host, port, 0, world_size, timeout=timeout,
+                          metrics_logger=metrics_logger)
+    server = AsyncBufferedFedAvgServer(
+        None, comm, world_size, init_params, total_updates, async_policy,
+        retry_policy=retry_policy, metrics_logger=metrics_logger)
+    server.register_message_receive_handlers()
+    server.start()
+    if server.agg.version < server.total_updates and server.failed is None:
+        loop = threading.Thread(target=server.com_manager
+                                .handle_receive_message, daemon=True,
+                                name="async-server-loop")
+        loop.start()
+        loop.join(timeout=join_timeout)
+        if loop.is_alive():
+            server.com_manager.stop_receive_message()
+            loop.join(timeout=10.0)
+            raise TimeoutError(
+                f"async server hung past {join_timeout}s "
+                f"(update {server.agg.version}, failed={server.failed})")
+    else:
+        server.com_manager.stop_receive_message()
+    for t in threads:
+        t.join(timeout=10.0)
+    return server
+
+
+__all__ = ["AsyncAggPolicy", "BufferedAggregator", "FlushResult",
+           "staleness_weight", "add_async_args",
+           "AsyncBufferedFedAvgServer", "run_async_tcp_fedavg"]

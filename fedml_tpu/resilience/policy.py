@@ -306,35 +306,71 @@ class RoundController:
             self._decided = True
 
 
+def fold_entries_fp64(entries) -> tuple:
+    """THE canonical weighted fold: sorted-key, float64, normalize-late.
+
+    ``entries``: iterable of ``(sort_key, weight, payload_pytree, scale)``
+    where the entry contributes ``float64(payload) * scale`` to the
+    numerator and ``weight`` to the denominator. Per-client reports use
+    ``scale == weight == n_i`` (a plain weighted average); the bucketed
+    streaming engine feeds PRE-WEIGHTED partial sums with
+    ``scale == staleness_weight`` and ``weight == w_sum * staleness_weight``.
+
+    Returns ``(params_f32, weight_total)``. Folding in sorted-key order
+    (never arrival order) is what makes the result bitwise deterministic:
+    :class:`~fedml_tpu.resilience.async_agg.BufferedAggregator` flushes
+    through this exact function, so the async path with staleness weight 1
+    and one flush reproduces :func:`aggregate_reports` bit-for-bit no
+    matter which order the reports raced in.
+    """
+    import jax
+
+    entries = sorted(entries, key=lambda e: e[0])
+    if not entries:
+        raise ValueError("weighted fold over an empty entry set "
+                         "(abandon/skip instead)")
+    total = 0.0
+    acc = None
+    for _key, weight, payload, scale in entries:
+        total += float(weight)
+        contrib = jax.tree.map(
+            lambda x: np.asarray(x, np.float64) * float(scale), payload)
+        acc = contrib if acc is None else jax.tree.map(np.add, acc, contrib)
+    if total <= 0:
+        raise ValueError("weighted fold has zero total weight")
+    return jax.tree.map(lambda x: (x / total).astype(np.float32), acc), total
+
+
 def aggregate_reports(reports) -> tuple:
     """Weighted average over the *reporting* subset, renormalized.
 
     ``reports``: ``{rank: (num_samples, params_pytree)}`` (numpy leaves --
     this is the host-side control plane). Returns ``(params, total_n)``.
-    Iteration is in sorted-rank order so two runs over the same subset are
-    bitwise identical (the chaos smoke's A/B oracle). Weights divide by the
-    reporters' sample total -- never the selected cohort's -- so a dropped
-    client renormalizes instead of zero-biasing; an empty subset fails fast
-    (parity with the engine's empty-cohort guard, ``engine.py:325``).
+    Delegates to :func:`fold_entries_fp64` -- sorted-rank float64 fold, so
+    two runs over the same subset are bitwise identical (the chaos smoke's
+    A/B oracle) AND the buffered async aggregator (which flushes through
+    the same fold) matches it bit-for-bit under the oracle settings.
+    Weights divide by the reporters' sample total -- never the selected
+    cohort's -- so a dropped client renormalizes instead of zero-biasing;
+    an empty subset fails fast (parity with the engine's empty-cohort
+    guard, ``engine.py:325``).
     """
-    import jax
-
     if not reports:
         raise ValueError("aggregate_reports over an empty reporting subset "
                          "(abandon the round instead)")
-    ranks = sorted(reports)
-    total = float(sum(reports[r][0] for r in ranks))
+    # sorted-rank order for the guard sum too: the returned total must be
+    # arrival-order deterministic, exactly like the fold's denominator
+    total = float(sum(float(reports[r][0]) for r in sorted(reports)))
     if total <= 0:
         raise ValueError("reporting subset has zero total samples")
-    acc = None
-    for r in ranks:
-        n, params = reports[r]
-        contrib = jax.tree.map(
-            lambda x: np.asarray(x, np.float64) * (n / total), params)
-        acc = contrib if acc is None else jax.tree.map(np.add, acc, contrib)
-    return jax.tree.map(lambda x: x.astype(np.float32), acc), total
+    params, fold_total = fold_entries_fp64(
+        (r, float(n), payload, float(n))
+        for r, (n, payload) in reports.items())
+    assert fold_total == total  # same addends, same (sorted) order
+    return params, total
 
 
 __all__ = ["RetryPolicy", "RoundPolicy", "RoundController",
            "PeerUnreachableError", "send_with_retry", "aggregate_reports",
+           "fold_entries_fp64",
            "ROUND_COMPLETE", "ROUND_DEGRADED", "ROUND_ABANDONED"]

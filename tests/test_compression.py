@@ -291,8 +291,11 @@ class TestCompressedFedAvg:
         assert got["compression_ratio"] > 2.5
         assert got["bytes_on_wire"] > 0
         # residuals are live state, not zeros: EF is actually engaged
-        assert any(float(jnp.max(jnp.abs(r))) > 0
-                   for r in jax.tree.leaves(comp._ef_residuals))
+        # (per-client accumulators live in the id-keyed ResidualStore)
+        assert any(
+            float(np.max(np.abs(r))) > 0
+            for c in range(6)
+            for r in jax.tree.leaves(comp._ef_store.peek(c)))
 
     def test_mesh_plus_compressor_rejected(self):
         from fedml_tpu.algorithms.fedavg import FedAvgAPI
@@ -425,3 +428,97 @@ class TestMetricsLoggerWire:
         logger.log({"round": 2, "bytes_on_wire": 123})
         assert logger.summary["bytes_on_wire"] == 123
         logger.close()
+
+
+class TestResidualStore:
+    """EF residuals key by STABLE client id, never cohort slot: re-sampled
+    cohorts (incl. resilience re-attempts with different reporting
+    subsets) must not cross-contaminate per-client accumulators."""
+
+    def _template(self):
+        return {"w": jnp.zeros((3, 2), jnp.float32),
+                "b": jnp.zeros((2,), jnp.float32)}
+
+    def _mark(self, ids):
+        """Stacked update whose rows encode their OWNER id -- any slot-
+        keyed indexing scrambles the values detectably."""
+        return {"w": jnp.stack([jnp.full((3, 2), float(i)) for i in ids]),
+                "b": jnp.stack([jnp.full((2,), float(i)) for i in ids])}
+
+    @pytest.mark.parametrize("dense", [True, False])
+    def test_resampled_cohorts_do_not_cross_contaminate(self, dense):
+        from fedml_tpu.compression import ResidualStore
+        store = ResidualStore(self._template(), num_clients=10, dense=dense)
+        # round 1 samples {3, 7, 1}; round 2 re-samples {7, 2} with client
+        # 7 at a DIFFERENT cohort slot (slot 1 -> slot 0)
+        store.scatter([3, 7, 1], self._mark([3, 7, 1]))
+        store.scatter([7, 2], self._mark([70, 2]))
+        assert float(store.peek(7)["w"][0, 0]) == 70.0   # updated in place
+        assert float(store.peek(3)["w"][0, 0]) == 3.0    # untouched carry
+        assert float(store.peek(1)["w"][0, 0]) == 1.0
+        assert float(store.peek(2)["w"][0, 0]) == 2.0
+        # never-sampled clients stay zero
+        for c in (0, 4, 5, 6, 8, 9):
+            assert float(jnp.max(jnp.abs(store.peek(c)["w"]))) == 0.0
+
+    @pytest.mark.parametrize("dense", [True, False])
+    def test_gather_follows_ids_not_slots(self, dense):
+        from fedml_tpu.compression import ResidualStore
+        store = ResidualStore(self._template(), num_clients=8, dense=dense)
+        store.scatter([5, 0, 6], self._mark([5, 0, 6]))
+        got = store.gather([6, 5])  # reshuffled + subset cohort
+        assert float(got["w"][0, 0, 0]) == 6.0
+        assert float(got["w"][1, 0, 0]) == 5.0
+        # gather of an untouched client materializes zeros (sparse lazily)
+        fresh = store.gather([7])
+        assert float(jnp.max(jnp.abs(fresh["w"]))) == 0.0
+
+    def test_dense_sparse_equivalence(self):
+        from fedml_tpu.compression import ResidualStore
+        dense = ResidualStore(self._template(), num_clients=6, dense=True)
+        sparse = ResidualStore(self._template(), dense=False)
+        for ids in ([1, 4], [4, 2, 0], [5]):
+            upd = self._mark([10 * i + 1 for i in ids])
+            dense.scatter(ids, upd)
+            sparse.scatter(ids, upd)
+        for c in range(6):
+            for a, b in zip(jax.tree.leaves(dense.peek(c)),
+                            jax.tree.leaves(sparse.peek(c))):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_fedavg_compressed_round_uses_id_keying(self):
+        """End-to-end regression: run two compressed rounds whose cohorts
+        re-sample (client_num_per_round < total) and assert every client
+        NOT in a round's cohort kept its residual bytes unchanged."""
+        from fedml_tpu.algorithms.fedavg import FedAvgAPI
+        from fedml_tpu.algorithms.specs import make_classification_spec
+        from fedml_tpu.data.synthetic import load_synthetic_federated
+        from fedml_tpu import models
+
+        spec = make_classification_spec(
+            models.LogisticRegression(num_classes=10, apply_sigmoid=False),
+            jnp.zeros((1, 60)))
+        ds = load_synthetic_federated(client_num=8, n_train=400, n_test=80,
+                                      alpha=0.0, beta=0.0, seed=0)
+        api = FedAvgAPI(ds, spec, _fed_args(compressor="qsgd:8",
+                                            client_num_in_total=8,
+                                            client_num_per_round=3))
+        from fedml_tpu.algorithms.fedavg import client_sampling
+        cohort0 = set(client_sampling(0, 8, 3))
+        api.train_one_round()
+        before = {c: jax.tree.map(np.copy, api._ef_store.peek(c))
+                  for c in range(8)}
+        cohort1 = set(client_sampling(1, 8, 3))
+        api.train_one_round()
+        assert cohort0 != cohort1  # the regression needs a re-sample
+        for c in range(8):
+            after = api._ef_store.peek(c)
+            if c in cohort1:
+                continue
+            for a, b in zip(jax.tree.leaves(before[c]),
+                            jax.tree.leaves(after)):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # and the sampled clients' residuals are live (EF engaged)
+        assert any(float(np.max(np.abs(r))) > 0
+                   for c in cohort1
+                   for r in jax.tree.leaves(api._ef_store.peek(c)))
