@@ -33,6 +33,22 @@ the measured config is not the flagship recipe.
 Usage: python bench.py [--smoke] [--rounds N] [--epochs E] [--flat]
 Prints one JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
+MFU methodology (docs/PERFORMANCE.md round 7): per-sample train FLOPs
+come from the XLA cost model of the actual compiled train step
+(``fedml_tpu.observability.costmodel.train_step_cost``); the analytic
+constant below remains as the cross-checked fallback (``flops_source``
+in the record says which was used; a tier-1 test pins agreement within
+the documented tolerance). When the accelerator probe times out, the
+bench no longer emits a dead ``value: 0.0`` line -- it falls back to the
+CPU-measured smoke and tags the record ``"device": "cpu-fallback"``.
+
+Perf-regression ledger: every perf run appends its record to
+``--ledger`` (default ``bench_results/ledger.jsonl``; empty string
+disables), and ``python bench.py --check-regress`` compares the newest
+record against the median of its same-metric predecessors with a noise
+band (``--regress_band``), exiting non-zero on regression -- gated both
+ways in scripts/ci.sh.
+
 Compression tools (CPU-only, no accelerator needed; see
 docs/COMPRESSION.md):
   python bench.py --compression_sweep [--sweep_model resnet56|cnn]
@@ -65,8 +81,17 @@ FLAGSHIP_EPOCHS = 20
 #   + fc 64x10. Forward FLOPs = 2 x MACs; training step ~= 3 x forward
 #   (fwd + input-grad + weight-grad). Published derivable from
 #   fedml_api/model/cv/resnet.py resnet56 topology.
+# Since round 7 this constant is the FALLBACK (and cross-check anchor)
+# only: the record's MFU uses the XLA cost model of the compiled train
+# step when available, and tests/test_observability.py pins the two
+# within FLOPS_XCHECK_TOL so this constant can never silently rot.
 RESNET56_MACS_PER_SAMPLE = 125.75e6
 TRAIN_FLOPS_PER_SAMPLE = 3 * 2 * RESNET56_MACS_PER_SAMPLE
+#: documented tolerance between the analytic constant and the XLA
+#: cost-model count (the analytic 3x-forward rule over conv/fc MACs vs
+#: XLA's exact HLO op count incl. GroupNorm/activations; measured ratio
+#: ~0.87 at smoke shapes -- docs/PERFORMANCE.md round 7)
+FLOPS_XCHECK_TOL = 0.30
 
 # bf16 peak by device kind (dense, per chip)
 _PEAK_TFLOPS = (("v5 lite", 197.0), ("v5e", 197.0), ("v5p", 459.0),
@@ -185,11 +210,34 @@ def build_api(args, epochs, client_chunk, wave_mode):
     return api
 
 
+def train_step_flops_per_sample(api, image, batch_size):
+    """Per-sample train FLOPs of the compiled train step (XLA cost
+    model), or None when the backend exposes no cost analysis -- the
+    caller then falls back to the analytic constant. Abstract shapes
+    only: the probe compiles but never executes or allocates."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.observability.costmodel import train_step_cost
+
+    batch = {"x": jax.ShapeDtypeStruct((batch_size, image, image, 3),
+                                       jnp.float32),
+             "y": jax.ShapeDtypeStruct((batch_size,), jnp.int32),
+             "mask": jax.ShapeDtypeStruct((batch_size,), jnp.float32)}
+    pc = train_step_cost(api.spec, api.cfg, batch)
+    if pc is None:
+        return None
+    return pc.flops / batch_size
+
+
 def measure(args, epochs, client_chunk, wave_mode):
     """Run warmup + measured rounds. Returns (result dict, error string)."""
+    from fedml_tpu.observability.jaxmon import watch_compiles
+
     api = build_api(args, epochs, client_chunk, wave_mode)
     t0 = time.time()
-    api.train_one_round()  # compile + warmup
+    with watch_compiles() as compile_watch:
+        api.train_one_round()  # compile + warmup
     compile_s = time.time() - t0
 
     rounds = 1 if args.smoke else args.rounds
@@ -222,10 +270,17 @@ def measure(args, epochs, client_chunk, wave_mode):
         raise RuntimeError(err or "no measured rounds")
     phase_s = {name: round(float(np.median(durs)), 4)
                for name, durs in sorted(tracer.durations_by_name().items())}
+    # XLA cost-model probe AFTER the measured rounds (the device is
+    # known-good here); an unavailable cost analysis degrades to the
+    # analytic constant in main(), never fails the bench
+    image = 16 if args.smoke else 32
+    flops_xla = train_step_flops_per_sample(api, image, args.batch_size)
     return {
         "round_s": float(np.median(times)),
         "times": times,
         "compile_s": compile_s,
+        **compile_watch.record_fields(),
+        "flops_per_sample_xla": flops_xla,
         "samples_per_round": float(np.mean(samples)),
         "train_acc": float(metrics["Train/Acc"]),
         "phase_s": phase_s,
@@ -287,18 +342,30 @@ def run_massive_cohort(args):
         async_agg=int(args.massive_async), buffer_k=args.buffer_k,
         staleness_decay=args.staleness_decay, async_window=4,
         device_resident="0")
+    from fedml_tpu.observability.costmodel import CostModel, set_cost_model
+
     api = FedAvgAPI(dataset, spec, run_args)
-    t0 = time.time()
-    with watch_compiles() as watcher:
-        api.train_one_round()  # compile + warmup (one program per bucket)
-    compile_s = time.time() - t0
-    rounds = max(1, args.rounds)
-    times = []
-    with watch_compiles() as steady_watcher:
-        for _ in range(rounds):
-            t0 = time.time()
-            metrics = api.train_one_round()
-            times.append(time.time() - t0)
+    # XLA cost model armed for the whole run: per-bucket-shape FLOPs and
+    # FLOP-weighted padding waste in the record. The per-edge AOT probes
+    # compile during the warmup round (counted by `watcher`, dedup'd by
+    # the persistent compile cache) and never touch the jit dispatch
+    # cache, so steady_compiles and bucket_shapes stay honest.
+    cost_model = CostModel()
+    prev_cm = set_cost_model(cost_model)
+    try:
+        t0 = time.time()
+        with watch_compiles() as watcher:
+            api.train_one_round()  # compile + warmup (one program/bucket)
+        compile_s = time.time() - t0
+        rounds = max(1, args.rounds)
+        times = []
+        with watch_compiles() as steady_watcher:
+            for _ in range(rounds):
+                t0 = time.time()
+                metrics = api.train_one_round()
+                times.append(time.time() - t0)
+    finally:
+        set_cost_model(prev_cm)
     round_s = float(np.median(times))
     out = {
         "metric": f"massive-cohort clients/sec (bucketed streaming, "
@@ -322,10 +389,26 @@ def run_massive_cohort(args):
         "train_loss": round(float(metrics["Train/Loss"]), 4),
         "device": str(jax.devices()[0]),
     }
+    binfo = api._last_bucket_info["bucket"]
+    # per-bucket-shape attribution: step counts always, FLOPs when the
+    # backend exposes cost analysis (flops_source tells which)
+    out["per_bucket"] = [b for b in binfo["per_bucket"] if not b["skipped"]]
+    if "executed_flops" in binfo:
+        out["executed_flops"] = binfo["executed_flops"]
+        out["true_flops"] = binfo["true_flops"]
+        out["flops_waste_frac"] = binfo["flops_waste_frac"]
+        out["flops_source"] = binfo["flops_source"]
+        out["achieved_gflops"] = round(
+            binfo["executed_flops"] / round_s / 1e9, 3)
+    else:
+        out["flops_source"] = "unavailable"
     if args.massive_async:
         out["async"] = {k.split("/", 1)[1]: v for k, v in metrics.items()
                         if k.startswith("async/")}
     print(json.dumps(out), flush=True)
+    if args.ledger:
+        from fedml_tpu.observability.perfmon import append_ledger
+        append_ledger(out, args.ledger)
     return 0
 
 
@@ -474,6 +557,22 @@ def main():
                    help="persistent XLA compilation cache directory "
                         "(default: FEDML_TPU_COMPILE_CACHE env or "
                         "~/.cache/fedml_tpu/xla)")
+    p.add_argument("--ledger", type=str,
+                   default="bench_results/ledger.jsonl",
+                   help="perf-regression ledger: every perf run appends "
+                        "its JSON record here (JSONL, append-only; '' "
+                        "disables). --check-regress reads it")
+    p.add_argument("--check-regress", "--check_regress",
+                   dest="check_regress", action="store_true",
+                   help="perf-regression gate: compare the ledger's "
+                        "newest record against the median of its "
+                        "same-metric predecessors; exit 1 when the "
+                        "headline value drops below median*(1-band). "
+                        "A fresh ledger (no predecessor) passes. Never "
+                        "touches the accelerator")
+    p.add_argument("--regress_band", type=float, default=None,
+                   help="noise band for --check-regress (default 0.15: "
+                        "15%% below the baseline median fails)")
     p.add_argument("--compression_sweep", action="store_true",
                    help="measure each --compressors spec on a "
                         "--sweep_model pytree (encoded bytes + "
@@ -499,6 +598,16 @@ def main():
                         "baseline-comparable")
     args = p.parse_args()
 
+    if args.check_regress:
+        # ledger-only gate: no jax import, runs with the tunnel dead
+        from fedml_tpu.observability.perfmon import (DEFAULT_REGRESS_BAND,
+                                                     check_regression)
+        band = (args.regress_band if args.regress_band is not None
+                else DEFAULT_REGRESS_BAND)
+        ok, detail = check_regression(args.ledger, band=band)
+        print(json.dumps(detail), flush=True)
+        sys.exit(0 if ok else 1)
+
     if args.compression_sweep or args.check:
         # host-side codec measurements: never touch the accelerator (the
         # tunnel can be dead and these must still run in CI)
@@ -519,6 +628,7 @@ def main():
     if args.algo == "fedopt":
         global _FAILURE_METRIC
         _FAILURE_METRIC = "FedOpt rounds/hour (CIFAR-10-scale ResNet-56)"
+    cpu_fallback_err = None
     if args.platform == "cpu":
         import jax
         jax.config.update("jax_platforms", "cpu")
@@ -527,8 +637,17 @@ def main():
     elif "axon" in os.environ.get("JAX_PLATFORMS", "").split(","):
         err = probe_device()
         if err is not None:
-            emit_failure(err)  # ALWAYS print the one JSON line
-            sys.exit(0)
+            # a dead tunnel used to erase the whole record (value 0.0 +
+            # an error string -- the cause of the empty BENCH trajectory,
+            # BENCH_r05.json): fall back to the CPU-measured smoke and
+            # emit a REAL record tagged "device": "cpu-fallback", with
+            # the probe error preserved alongside
+            cpu_fallback_err = err
+            args.smoke = True
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            print(f"# device probe failed ({err}); measuring the CPU "
+                  "smoke instead (device=cpu-fallback)", file=sys.stderr)
     # budget scales with the workload: compile (~5 min worst) + one warmup
     # + measured rounds at a generous 5 min/round ceiling, per rung walked
     rungs = 1 if args.no_degrade else 6
@@ -591,10 +710,19 @@ def main():
 
     round_s = meas["round_s"]
     rph = 3600.0 / round_s
-    # FLOPs for the workload ACTUALLY run: smoke shrinks images to 16x16,
-    # which scales every conv's spatial extent (and hence cost) by (16/32)^2
+    # FLOPs for the workload ACTUALLY run: primary source is the XLA
+    # cost model of the compiled train step (measure() probed it);
+    # fallback is the analytic constant, spatially scaled for the smoke
+    # (16x16 scales every conv's cost by (16/32)^2). The analytic number
+    # always rides the record as the cross-check anchor.
     image = 16 if args.smoke else 32
-    flops_per_sample = TRAIN_FLOPS_PER_SAMPLE * (image / 32) ** 2
+    analytic_flops = TRAIN_FLOPS_PER_SAMPLE * (image / 32) ** 2
+    if meas.get("flops_per_sample_xla"):
+        flops_per_sample = meas["flops_per_sample_xla"]
+        flops_source = "xla-cost-model"
+    else:
+        flops_per_sample = analytic_flops
+        flops_source = "analytic"
     epochs_run = 1 if args.smoke else used["epochs"]
     flops_round = meas["samples_per_round"] * flops_per_sample
     achieved = flops_round / round_s
@@ -618,9 +746,13 @@ def main():
                         if flagship else 0.0),
         "round_time_s": round(round_s, 3),
         "compile_s": round(meas["compile_s"], 1),
+        "compile_count": meas["compile_count"],
+        "compile_seconds": meas["compile_seconds"],
         "samples_per_round": meas["samples_per_round"],
         "ms_per_step_batch": round(1e3 * round_s / max(steps_round, 1), 3),
         "model_train_flops_per_sample": flops_per_sample,
+        "flops_source": flops_source,
+        "analytic_flops_per_sample": analytic_flops,
         "achieved_tflops": round(achieved / 1e12, 2),
         "mfu": round(achieved / peak, 4),
         "assumed_peak_tflops": peak / 1e12,
@@ -641,12 +773,26 @@ def main():
             "epochs": used["epochs"], "client_chunk": used["client_chunk"],
             "wave_mode": used["wave_mode"],
             "flagship_epochs": FLAGSHIP_EPOCHS}
+    if flops_source == "xla-cost-model":
+        result["flops_vs_analytic"] = round(
+            flops_per_sample / analytic_flops, 3)
+    if cpu_fallback_err is not None:
+        result["device"] = "cpu-fallback"
+        result["probe_error"] = cpu_fallback_err
+        # the ledger's regression check groups baselines by the exact
+        # metric string: a CPU-fallback record must stay a visible trend
+        # point WITHOUT ever judging (or dragging the median of) real
+        # accelerator runs of the same metric
+        result["metric"] += " [cpu-fallback]"
     if failures:
         result["failed_configs"] = [f["config"] for f in failures]
     if meas["partial_error"]:
         result["partial_rounds_error"] = meas["partial_error"][-400:]
     watchdog.cancel()
     print(json.dumps(result))
+    if args.ledger:
+        from fedml_tpu.observability.perfmon import append_ledger
+        append_ledger(result, args.ledger)
     print(f"# times={[round(t, 2) for t in meas['times']]} "
           f"train_acc={meas['train_acc']:.3f} "
           f"wave_mode={used['wave_mode']}", file=sys.stderr)

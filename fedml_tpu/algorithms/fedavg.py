@@ -9,6 +9,7 @@ passing a mesh runs the shard_map/psum round (semantics of
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import time
 
@@ -16,6 +17,7 @@ import jax
 import numpy as np
 
 from fedml_tpu.core.trainer import TrainSpec
+from fedml_tpu.observability.perfmon import get_perf_monitor
 from fedml_tpu.observability.tracing import get_tracer
 from fedml_tpu.utils.profiling import end_of_round_sync
 from fedml_tpu.parallel.engine import (
@@ -340,9 +342,19 @@ class FedAvgAPI:
         # "aggregate" -- the end-of-round sync is where the host actually
         # waits for the round's outputs (exactly the FL114 lesson)
         tracer = get_tracer()
+        mon = get_perf_monitor()  # one global read when monitoring is off
         t0 = time.time()
-        with tracer.span("round", round=int(self.round_idx)):
-            train_metrics = self._traced_round_body(tracer, t0)
+        with (mon.xprof(self.round_idx) if mon is not None
+              else contextlib.nullcontext()):
+            with tracer.span("round", round=int(self.round_idx)):
+                train_metrics = self._traced_round_body(tracer, t0)
+        if mon is not None:
+            # true steps are known host-side only on the bucketed path;
+            # elsewhere the per-step histogram is skipped rather than
+            # forcing a device read the disabled path would not do
+            steps = (self._last_bucket_info["bucket"]["true_steps"]
+                     if self.bucket_runner is not None else None)
+            mon.observe_round(train_metrics["round_time_s"], steps=steps)
         self.round_idx += 1
         return train_metrics
 
@@ -454,6 +466,15 @@ class FedAvgAPI:
                 "bucket/true_steps": b["true_steps"],
                 "bucket/waste_frac": b["waste_frac"],
             })
+            if "executed_flops" in b:
+                # XLA cost-model attribution (armed via set_cost_model /
+                # --costmodel): padded waste in FLOPs from the programs
+                # actually compiled, per round
+                train_metrics.update({
+                    "bucket/executed_flops": b["executed_flops"],
+                    "bucket/true_flops": b["true_flops"],
+                    "bucket/flops_waste_frac": b["flops_waste_frac"],
+                })
             # buffer-depth/staleness series ride every round record on
             # async runs (metrics.jsonl observability contract) even when
             # the registry is off

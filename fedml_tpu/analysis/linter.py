@@ -125,6 +125,15 @@ RULES = {
         "`end_of_round_sync`) inside the measured region; value fetches "
         "(`float(...)`, `.item()`, `np.asarray`) also count -- reading "
         "a value blocks on the work producing it."),
+    "FL115": (
+        "unbounded metric label cardinality from a per-client identifier",
+        "a registry counter/gauge/histogram call whose label VALUE derives "
+        "from a per-client identifier (a client id / rank variable, "
+        "msg.get_sender_id(), or a cohort-loop variable) creates one time "
+        "series per client -- at the population scales this repo targets "
+        "(10^4-10^6 clients) that is an unbounded-cardinality leak that "
+        "OOMs the registry and every scrape. Aggregate across clients, "
+        "bucket the value into a histogram, or drop the label."),
     "FL120": (
         "message type sent but unhandled by any counterpart FSM",
         "a `Message(TYPE, ...)` flowing into send_message/send_with_retry "
@@ -253,6 +262,22 @@ _FL107_PATHS = ("*/comm/*", "*transport*", "*codec*", "*compression*",
 _FL108_EXCLUDED = ("*/experiments/*", "*prepare.py", "*/scripts/*",
                    "scripts/*", "*cli.py", "bench.py", "*/bench.py",
                    "__graft_entry__.py", "*/__graft_entry__.py")
+
+#: FL115: the metrics-registry write surface, how a receiver is known to
+#: BE the registry (assigned from these factories, or a `registry`-named
+#: attribute), which keywords are not labels, and what reads as a
+#: per-client identifier. Collection-iter names are matched exactly
+#: (not substring): `for r in sorted(self.alive)` taints `r`, while
+#: `range(0, C, self.client_chunk)` taints nothing.
+_REGISTRY_METHODS = {"inc", "set_gauge", "observe", "declare_histogram"}
+_REGISTRY_FACTORIES = {"get_registry", "MetricsRegistry"}
+_FL115_NON_LABEL_KW = {"help", "buckets", "value"}
+_FL115_ID_RE = re.compile(
+    r"(?:^|_)(?:rank|client|peer|cid|sender)(?:_?(?:id|idx|index|rank))?$",
+    re.IGNORECASE)
+_FL115_COHORT_ITERS = {"clients", "client_indexes", "client_ids", "cohort",
+                       "ranks", "peers", "alive", "alive_ranks"}
+_FL115_ID_CALLS = {"get_sender_id"}
 
 _NP_MODULE_NAMES = {"numpy"}
 _JAX_MODULE_NAMES = {"jax"}
@@ -620,6 +645,7 @@ class _ModuleLinter:
         parents = {id(child): node for node in ast.walk(self.tree)
                    for child in ast.iter_child_nodes(node)}
         self._parents = parents
+        self._collect_fl115_bindings()
         jitted_spans = []
         for site in sites:
             self._check_jit_body(site)
@@ -742,10 +768,106 @@ class _ModuleLinter:
                 self._check_pytree_sink(node)
                 self._check_shard_specs(node)
                 self._check_scan_carry(node)
+                self._check_metric_labels(node)
                 if fl108_scoped:
                     self._check_debug_call(node)
             elif isinstance(node, ast.ExceptHandler) and fl107_scoped:
                 self._check_except(node)
+
+    # FL115: unbounded metric label cardinality
+    def _enclosing_fn(self, node):
+        """The innermost FunctionDef/Lambda containing ``node`` (None at
+        module level)."""
+        p = self._parents.get(id(node))
+        while p is not None:
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                return p
+            p = self._parents.get(id(p))
+        return None
+
+    def _collect_fl115_bindings(self):
+        """Module prepass: which names/attributes hold the metrics
+        registry (assigned from ``get_registry()``/``MetricsRegistry()``)
+        and which loop variables iterate a client/rank collection. Loop
+        taint is scoped to the loop's ENCLOSING FUNCTION: a cohort loop's
+        short `r` in one method must not taint an unrelated `r` used as
+        a label elsewhere in the module."""
+        self._registry_names, self._registry_attrs = set(), set()
+        self._client_loop_vars = {}  # name -> {id(enclosing fn) | None}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                _, fname = _call_root_name(node.value.func)
+                if fname in _REGISTRY_FACTORIES:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self._registry_names.add(t.id)
+                        elif isinstance(t, ast.Attribute):
+                            self._registry_attrs.add(t.attr)
+            elif isinstance(node, ast.For):
+                iter_names = set()
+                for n in ast.walk(node.iter):
+                    if isinstance(n, ast.Name):
+                        iter_names.add(n.id)
+                    elif isinstance(n, ast.Attribute):
+                        iter_names.add(n.attr)
+                if iter_names & _FL115_COHORT_ITERS:
+                    scope = self._enclosing_fn(node)
+                    for n in ast.walk(node.target):
+                        if isinstance(n, ast.Name):
+                            self._client_loop_vars.setdefault(
+                                n.id, set()).add(
+                                None if scope is None else id(scope))
+
+    def _per_client_ident(self, expr, scope_id):
+        """First sub-expression of a label value that reads as a
+        per-client identifier, or None. ``scope_id``: id() of the call
+        site's enclosing function (loop-var taint is function-scoped)."""
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name):
+                if _FL115_ID_RE.search(n.id) \
+                        or scope_id in self._client_loop_vars.get(
+                            n.id, ()):
+                    return n.id
+            elif isinstance(n, ast.Attribute) \
+                    and _FL115_ID_RE.search(n.attr):
+                return n.attr
+            elif isinstance(n, ast.Call):
+                _, fname = _call_root_name(n.func)
+                if fname in _FL115_ID_CALLS:
+                    return fname + "()"
+        return None
+
+    def _check_metric_labels(self, node):
+        f = node.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in _REGISTRY_METHODS):
+            return
+        recv = f.value
+        if isinstance(recv, ast.Name):
+            if recv.id not in self._registry_names:
+                return
+        elif isinstance(recv, ast.Attribute):
+            if recv.attr not in self._registry_attrs \
+                    and recv.attr != "registry":
+                return
+        else:
+            return
+        scope = self._enclosing_fn(node)
+        scope_id = None if scope is None else id(scope)
+        for kw in node.keywords:
+            if kw.arg is None or kw.arg in _FL115_NON_LABEL_KW:
+                continue
+            ident = self._per_client_ident(kw.value, scope_id)
+            if ident is not None:
+                self.add(kw.value, "FL115",
+                         f"metric label `{kw.arg}` derives from the "
+                         f"per-client identifier `{ident}` -- one time "
+                         "series per client/rank is unbounded label "
+                         "cardinality; aggregate, bucket into a "
+                         "histogram, or drop the label")
+                return  # one finding per call site is enough
 
     # FL109: shard_map/pjit whose in_specs partition nothing
     def _check_shard_specs(self, node):

@@ -202,17 +202,19 @@ def audit_scope(args, logger, wired=True):
 
 
 def observability_scope(args, logger):
-    """``--trace/--flightrec`` context for the experiment mains: arms the
-    fedtrace switchboard (``fedml_tpu.observability.enable``) with the
-    run's metrics sink. Exports ``trace.json``/``spans.jsonl`` to
-    ``--trace_dir`` (default ``--run_dir``), flight-recorder dumps and
-    ``metrics.prom`` to ``--run_dir`` (else the trace dir); a run with
-    both flags off gets the no-op tracer and zero observability code on
+    """``--trace/--flightrec/--perfmon/--costmodel`` context for the
+    experiment mains: arms the fedtrace switchboard
+    (``fedml_tpu.observability.enable``) with the run's metrics sink.
+    Exports ``trace.json``/``spans.jsonl`` to ``--trace_dir`` (default
+    ``--run_dir``), flight-recorder dumps, ``metrics.prom`` and
+    ``status.json`` to ``--run_dir`` (else the trace dir); a run with
+    every flag off gets the no-op tracer and zero observability code on
     the hot paths."""
     from fedml_tpu.observability import enable
 
     trace = bool(getattr(args, "trace", 0))
     flightrec = bool(getattr(args, "flightrec", 0))
+    perfmon = bool(getattr(args, "perfmon", 0))
     run_dir = getattr(args, "run_dir", None)
     trace_dir = getattr(args, "trace_dir", None) or run_dir
     if trace and trace_dir is None:
@@ -221,7 +223,12 @@ def observability_scope(args, logger):
                         "trace.json/spans.jsonl to the working directory")
     return enable(trace=trace, trace_dir=trace_dir,
                   flightrec=flightrec, flightrec_dir=run_dir or trace_dir,
-                  metrics_logger=logger)
+                  metrics_logger=logger,
+                  perfmon=perfmon,
+                  status_path=getattr(args, "status_path", None),
+                  xprof_dir=getattr(args, "xprof_dir", None),
+                  xprof_round=getattr(args, "xprof_round", None),
+                  cost_model=bool(getattr(args, "costmodel", 0)))
 
 
 def race_audit_scope(args, logger):

@@ -31,9 +31,14 @@ import os
 import sys
 import threading
 
+from fedml_tpu.observability.costmodel import (CostModel, get_cost_model,
+                                               set_cost_model)
 from fedml_tpu.observability.flightrec import (FlightRecorder,
                                                get_flight_recorder,
                                                set_flight_recorder)
+from fedml_tpu.observability.perfmon import (PerfMonitor, StatusWriter,
+                                             get_perf_monitor,
+                                             set_perf_monitor)
 from fedml_tpu.observability.registry import (MetricsRegistry, get_registry,
                                               set_registry)
 from fedml_tpu.observability.tracing import (NOOP_TRACER, NoopTracer, Span,
@@ -61,27 +66,60 @@ def add_observability_args(parser):
              "send/recv/decision/retry events, dumped to "
              "flightrec_<reason>.jsonl on PEER_LOST, abandoned rounds, "
              "and unhandled crashes")
+    parser.add_argument(
+        "--perfmon", type=int, default=0,
+        help="runtime perf/health monitor (observability/perfmon.py): "
+             "round/step/staleness/buffer-depth/report-latency "
+             "histograms into the metrics registry, a rolling "
+             "fed_rounds_per_hour gauge, and periodic status.json "
+             "health snapshots (--status_path)")
+    parser.add_argument(
+        "--status_path", type=str, default=None,
+        help="status.json path for --perfmon health snapshots "
+             "(default: <run_dir>/status.json when --run_dir is set)")
+    parser.add_argument(
+        "--xprof_round", type=int, default=None,
+        help="with --perfmon: capture a programmatic jax.profiler trace "
+             "of exactly round N into --xprof_dir (no-op when the "
+             "profiler is unavailable; fires at most once)")
+    parser.add_argument(
+        "--xprof_dir", type=str, default=None,
+        help="jax.profiler output dir for --xprof_round "
+             "(default: --run_dir, else '.')")
+    parser.add_argument(
+        "--costmodel", type=int, default=0,
+        help="XLA cost-model performance attribution "
+             "(observability/costmodel.py): per-compiled-program "
+             "FLOPs/bytes from cost_analysis(); the bucketed streaming "
+             "rounds additionally report per-bucket-shape FLOPs and "
+             "FLOP-weighted padding waste")
     return parser
 
 
 @contextlib.contextmanager
 def enable(trace=False, trace_dir=None, flightrec=False, flightrec_dir=None,
            registry=True, compile_events=None, metrics_logger=None,
-           flight_capacity=4096):
+           flight_capacity=4096, perfmon=False, status_path=None,
+           xprof_dir=None, xprof_round=None, cost_model=False):
     """Arm the observability switchboard for a scope.
 
     Yields an object with ``tracer`` / ``registry`` / ``recorder`` /
-    ``compile_watcher`` attributes (None for the pieces left off). On
-    exit: exports ``trace.json`` + ``spans.jsonl`` into ``trace_dir``,
-    dumps the registry to ``metrics.prom`` (in ``flightrec_dir`` or
-    ``trace_dir`` when either is set), pushes the compile report to
-    ``metrics_logger``, and restores the previous globals (scopes nest).
+    ``compile_watcher`` / ``monitor`` / ``cost_model`` attributes (None
+    for the pieces left off). On exit: exports ``trace.json`` +
+    ``spans.jsonl`` into ``trace_dir``, dumps the registry to
+    ``metrics.prom`` (in ``flightrec_dir`` or ``trace_dir`` when either
+    is set), pushes the compile / perf-monitor / cost-model reports to
+    ``metrics_logger``, forces a final ``status.json`` write, and
+    restores the previous globals (scopes nest).
 
     ``compile_events`` defaults to ``trace`` -- the watcher needs jax, so
-    a flight-recorder-only scope stays jax-free.
+    a flight-recorder-only scope stays jax-free. ``perfmon`` arms the
+    registry too (its histograms need a sink); ``status_path`` defaults
+    to ``<flightrec_dir or trace_dir>/status.json`` when perfmon is on
+    and either dir is set.
     """
     state = _Scope()
-    prev_tracer = prev_reg = prev_fr = None
+    prev_tracer = prev_reg = prev_fr = prev_mon = prev_cm = None
     hooks = None
     if compile_events is None:
         compile_events = bool(trace)
@@ -90,6 +128,7 @@ def enable(trace=False, trace_dir=None, flightrec=False, flightrec_dir=None,
     # global is installed, so a setup failure cannot leak a tracer/
     # registry/recorder (or chained excepthooks) past this function --
     # everything below is plain-Python construction that cannot raise
+    # (PerfMonitor/CostModel only touch jax lazily, inside a round)
     if compile_events:
         from fedml_tpu.observability.jaxmon import watch_compiles
         state._watch_cm = watch_compiles()
@@ -97,7 +136,7 @@ def enable(trace=False, trace_dir=None, flightrec=False, flightrec_dir=None,
     if trace:
         state.tracer = Tracer()
         prev_tracer = set_tracer(state.tracer)
-    if registry and (trace or flightrec):
+    if registry and (trace or flightrec or perfmon):
         state.registry = MetricsRegistry()
         prev_reg = set_registry(state.registry)
     if flightrec:
@@ -106,6 +145,17 @@ def enable(trace=False, trace_dir=None, flightrec=False, flightrec_dir=None,
             capacity=flight_capacity)
         prev_fr = set_flight_recorder(state.recorder)
         hooks = _install_crash_hooks(state.recorder)
+    if perfmon:
+        out_dir = flightrec_dir or trace_dir
+        if status_path is None and out_dir is not None:
+            status_path = os.path.join(out_dir, "status.json")
+        state.monitor = PerfMonitor(status_path=status_path,
+                                    xprof_dir=xprof_dir or out_dir,
+                                    xprof_round=xprof_round)
+        prev_mon = set_perf_monitor(state.monitor)
+    if cost_model:
+        state.cost_model = CostModel()
+        prev_cm = set_cost_model(state.cost_model)
     try:
         yield state
     finally:
@@ -115,6 +165,17 @@ def enable(trace=False, trace_dir=None, flightrec=False, flightrec_dir=None,
             logging.info("compile watch: %s", report)
             if metrics_logger is not None:
                 metrics_logger(report)
+        if state.cost_model is not None:
+            set_cost_model(prev_cm)
+            if metrics_logger is not None and state.cost_model.programs:
+                metrics_logger(state.cost_model.record())
+        if state.monitor is not None:
+            set_perf_monitor(prev_mon)
+            state.monitor.status_update(force=True, final=True)
+            if state.monitor.status is not None:
+                state.status_path = state.monitor.status.path
+            if metrics_logger is not None and state.monitor.rounds:
+                metrics_logger(state.monitor.record())
         if state.recorder is not None:
             _uninstall_crash_hooks(hooks)
             set_flight_recorder(prev_fr)
@@ -147,9 +208,12 @@ class _Scope:
         self.registry = None
         self.recorder = None
         self.compile_watcher = None
+        self.monitor = None
+        self.cost_model = None
         self.chrome_path = None
         self.spans_path = None
         self.prom_path = None
+        self.status_path = None
         self._watch_cm = None
 
 
@@ -197,4 +261,7 @@ __all__ = ["Tracer", "NoopTracer", "NOOP_TRACER", "Span", "SpanContext",
            "TRACE_KEY", "get_tracer", "set_tracer",
            "MetricsRegistry", "get_registry", "set_registry",
            "FlightRecorder", "get_flight_recorder", "set_flight_recorder",
+           "PerfMonitor", "StatusWriter", "get_perf_monitor",
+           "set_perf_monitor",
+           "CostModel", "get_cost_model", "set_cost_model",
            "add_observability_args", "enable"]

@@ -103,6 +103,15 @@ class CompileWatcher:
                 "compile/total_traces": self.total_traces,
             }
 
+    def record_fields(self) -> dict:
+        """Flat compile-cost fields for a bench record / ledger entry
+        (count + wall seconds; the per-round lists stay in
+        :meth:`report`)."""
+        with self._lock:
+            return {"compile_count": self.total_compiles,
+                    "compile_seconds":
+                        round(self.total_compile_seconds, 4)}
+
     # -- lifecycle ---------------------------------------------------------
     def start(self):
         from jax import monitoring

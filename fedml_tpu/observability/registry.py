@@ -134,6 +134,16 @@ class MetricsRegistry:
                 h = s[key] = _Hist(buckets)
             h.observe(value)
 
+    def declare_histogram(self, name, buckets=DEFAULT_BUCKETS, help="",
+                          **labels):
+        """Pre-register a histogram series with zero observations, so a
+        dashboard sees the metric (all-zero buckets, ``_count 0``)
+        before -- or even without -- the first event. Idempotent;
+        an existing series keeps its buckets and counts."""
+        with self._lock:
+            s = self._series(name, "histogram", help)["series"]
+            s.setdefault(_label_key(labels), _Hist(buckets))
+
     # -- reads -------------------------------------------------------------
     def get(self, name, **labels):
         """Current value of one series (histograms return (sum, count))."""

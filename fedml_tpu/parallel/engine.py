@@ -39,6 +39,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from fedml_tpu.core import pytree
 from fedml_tpu.core.sharding import shard_map
 from fedml_tpu.core.trainer import TrainSpec
+from fedml_tpu.observability.costmodel import get_cost_model, program_cost
 from fedml_tpu.observability.tracing import get_tracer
 from fedml_tpu.parallel.mesh import CLIENT_AXIS, zero_pad_leading
 
@@ -399,6 +400,12 @@ class BucketedStreamRunner:
         self._chunk_fn = chunk_fn
         self._advance_fn = advance_fn
         self._dtypes = None
+        # per-bucket-edge ProgramCost (or None for "probed, no cost
+        # analysis"), populated lazily ONLY while a CostModel is armed;
+        # the AOT probe compiles once per edge (warm-up round) and never
+        # touches the jit dispatch cache, so compiled_shapes() and the
+        # zero-steady-state-retrace gates stay honest
+        self._edge_costs = {}
 
     def _payload_dtypes(self, global_state):
         if self._dtypes is None:
@@ -455,6 +462,7 @@ class BucketedStreamRunner:
         flush_rng = jax.random.fold_in(rng, 2)
 
         gs, ss = global_state, server_state
+        cm = get_cost_model()  # one global read when attribution is off
         flushes = 0
         metrics_acc = None
         # sync path: incremental canonical fold. Entries are consumed in
@@ -537,13 +545,30 @@ class BucketedStreamRunner:
                     (xb, yb, maskb, n_arr), pad)
                 rngs = np.concatenate([rngs, rngs[:1].repeat(pad, 0)])
             born = aggregator.version if aggregator else 0
+            batches_dev = {"x": jnp.asarray(xb), "y": jnp.asarray(yb),
+                           "mask": jnp.asarray(maskb)}
+            ns_dev, rngs_dev = jnp.asarray(n_arr), jnp.asarray(rngs)
             with tracer.span("bucket-chunk", edge=edge, clients=int(k),
                              trip=trip):
                 pay_sum, w_sum, msum = self._chunk_fn(
-                    gs, {"x": jnp.asarray(xb), "y": jnp.asarray(yb),
-                         "mask": jnp.asarray(maskb)},
-                    jnp.asarray(n_arr), jnp.int32(trip),
-                    jnp.asarray(rngs))
+                    gs, batches_dev, ns_dev, jnp.int32(trip), rngs_dev)
+            if cm is not None:
+                if edge not in self._edge_costs:
+                    # abstract AOT probe of this bucket shape's program
+                    # (the dispatch above runs async meanwhile):
+                    # ShapeDtypeStructs only, so the probe never holds
+                    # or syncs device buffers
+                    abst = lambda t: jax.tree.map(
+                        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                        t)
+                    self._edge_costs[edge] = program_cost(
+                        self._chunk_fn, abst(gs), abst(batches_dev),
+                        abst(ns_dev), jax.ShapeDtypeStruct((), jnp.int32),
+                        abst(rngs_dev))
+                # note() every time (setdefault-idempotent): a CostModel
+                # armed AFTER the runner warmed its edge cache must
+                # still collect the catalog
+                cm.note(f"bucket_chunk_s{edge}", self._edge_costs[edge])
             inflight.append((ordinal, born, k, (pay_sum, w_sum, msum)))
             ordinal += 1
             st = b_stats[edge]
@@ -556,10 +581,25 @@ class BucketedStreamRunner:
             exec_steps += trip * self.client_chunk
             while len(inflight) > max(1, int(async_window)):
                 fold_oldest()
+        flops_exec, flops_true, have_cost = 0.0, 0.0, False
         for e in self.edges:
             st = b_stats[e]
-            per_bucket.append({"edge": int(e), "skipped": int(
-                st["chunks"] == 0), **st})
+            row = {"edge": int(e), "skipped": int(st["chunks"] == 0), **st}
+            pc = self._edge_costs.get(e)
+            if pc is not None and st["chunks"]:
+                # XLA cost analysis charges a dynamic-trip loop body
+                # ONCE: program flops ~= one step across all client_chunk
+                # lanes (+ the per-dispatch aggregation epilogue, which
+                # step-dominated chunks amortize -- docs/OBSERVABILITY.md)
+                per_lane_step = pc.flops / self.client_chunk
+                row["flops_per_step"] = per_lane_step
+                row["executed_flops"] = per_lane_step * st["executed_steps"]
+                row["true_flops"] = per_lane_step * st["true_steps"]
+                row["bytes_accessed"] = pc.bytes_accessed
+                flops_exec += row["executed_flops"]
+                flops_true += row["true_flops"]
+                have_cost = True
+            per_bucket.append(row)
 
         while inflight:
             fold_oldest()
@@ -601,6 +641,15 @@ class BucketedStreamRunner:
                 "per_bucket": per_bucket,
             },
         }
+        if have_cost and flops_exec > 0:
+            # padded waste in FLOPs, from the programs actually compiled
+            # (not step counts): buckets missing a cost probe are
+            # excluded from both numerator and denominator
+            info["bucket"]["executed_flops"] = flops_exec
+            info["bucket"]["true_flops"] = flops_true
+            info["bucket"]["flops_waste_frac"] = round(
+                1.0 - flops_true / flops_exec, 4)
+            info["bucket"]["flops_source"] = "xla"
         if async_info is not None:
             info["async"] = async_info
         return gs, ss, info

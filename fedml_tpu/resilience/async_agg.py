@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from dataclasses import dataclass
 from typing import Optional
 
@@ -55,6 +56,7 @@ from fedml_tpu.core.locks import audited_lock, audited_rlock
 from fedml_tpu.core.comm.base import MSG_TYPE_PEER_LOST
 from fedml_tpu.core.message import Message
 from fedml_tpu.core.managers import ServerManager
+from fedml_tpu.observability.perfmon import get_perf_monitor
 from fedml_tpu.observability.registry import get_registry
 from fedml_tpu.observability.tracing import get_tracer
 from fedml_tpu.resilience.policy import (
@@ -201,6 +203,11 @@ class BufferedAggregator:
             reg.set_gauge("fed_update_staleness", int(staleness),
                           help="staleness (server versions) of the last "
                                "folded update")
+        mon = get_perf_monitor()
+        if mon is not None:
+            # the histogram complement of the point gauges above (pace
+            # steering reads distributions, not last values)
+            mon.observe_fold(staleness, depth)
         return depth
 
     def ready(self, target=None) -> bool:
@@ -340,6 +347,12 @@ class AsyncBufferedFedAvgServer(ServerManager):
                          "clients_dropped": 0, "retries": 0}
         self._timer_factory = timer_factory
         self._timer = None
+        self._last_flush_reason = None
+        self._prev_flush_t = None    # wall time of the previous flush
+        self._pending_flush_dts = []  # flush-to-flush seconds, unconsumed
+        # (a list, drained by _report_health: back-to-back flushes on
+        # different handler threads must not overwrite each other's
+        # sample -- the slow interval is exactly the one pace wants)
         # serializes version turnover/alive/params; all sends happen
         # OUTSIDE it (same discipline as ResilientFedAvgServer: a
         # blocking write to a wedged peer must never pin the lock the
@@ -404,8 +417,10 @@ class AsyncBufferedFedAvgServer(ServerManager):
                               staleness)
         if done:
             self.finish()
+            self._report_health()
             return
         self._send_syncs(syncs)
+        self._report_health()
 
     def _on_peer_lost(self, msg):
         rank = int(msg.get_sender_id())
@@ -436,12 +451,49 @@ class AsyncBufferedFedAvgServer(ServerManager):
                 done, syncs = self._flush_locked("peer_lost")
         if done:
             self.finish()
+            self._report_health()
             return
         self._send_syncs(syncs)
+        self._report_health()
+
+    def _report_health(self):
+        """Push a health snapshot to the perf monitor's status.json (and
+        the update-pace histogram) -- called from handler threads AFTER
+        ``_advance_lock`` is released (the status write is file I/O; the
+        snapshot itself takes the lock only briefly). No-op when the
+        monitor is off."""
+        mon = get_perf_monitor()
+        if mon is None:
+            return
+        with self._advance_lock:
+            fields = {
+                "server": "async-buffered",
+                "round": self.agg.version,
+                "total_updates": self.total_updates,
+                "alive_ranks": sorted(self.alive),
+                "buffer_depth": self.agg.depth,
+                "last_flush_reason": self._last_flush_reason,
+                "reports": self.counters["reports"],
+                "clients_dropped": self.counters["clients_dropped"],
+                "outcome": ("failed" if self.failed is not None else
+                            "complete" if self.agg.version
+                            >= self.total_updates else "running"),
+            }
+            dts, self._pending_flush_dts = self._pending_flush_dts, []
+        for dt in dts:
+            mon.observe_round(dt)  # flush-to-flush pace: the barrier-free
+            # "round" time, feeding the rolling rounds/hour gauge
+        mon.status_update(force=fields["outcome"] != "running", **fields)
 
     # -- flush machinery (runs UNDER _advance_lock) ------------------------
     def _flush_locked(self, reason):
         self._cancel_timer_locked()
+        self._last_flush_reason = reason
+        if get_perf_monitor() is not None:
+            now = time.time()
+            if self._prev_flush_t is not None:
+                self._pending_flush_dts.append(now - self._prev_flush_t)
+            self._prev_flush_t = now
         res = self.agg.flush(reason)
         self.params = res.params
         self.history.append(dict(res.params))
@@ -493,8 +545,10 @@ class AsyncBufferedFedAvgServer(ServerManager):
             done, syncs = self._flush_locked("deadline")
         if done:
             self.finish()
+            self._report_health()
             return
         self._send_syncs(syncs)
+        self._report_health()
 
     def finish(self):
         with self._advance_lock:

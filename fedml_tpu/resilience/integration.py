@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Optional
 
 import numpy as np
@@ -33,6 +34,7 @@ from fedml_tpu.core.locks import audited_rlock
 from fedml_tpu.core.comm.base import MSG_TYPE_PEER_LOST
 from fedml_tpu.core.managers import ClientManager, ServerManager
 from fedml_tpu.core.message import Message
+from fedml_tpu.observability.perfmon import get_perf_monitor
 from fedml_tpu.observability.tracing import get_tracer
 from fedml_tpu.resilience.policy import (
     ROUND_DEGRADED, RetryPolicy, RoundController, RoundPolicy,
@@ -285,6 +287,15 @@ class ResilientFedAvgServer(ServerManager):
         # turnover thread, ended at the decision on a serve/timer thread);
         # its context rides every SYNC so client spans stitch under it
         self._round_span = None
+        # perf-monitor state (all guarded by _advance_lock; written only
+        # while a monitor is armed): attempt-open wall time for the
+        # report-latency/straggler-tail histogram, last decision outcome
+        # + counts for status.json, and the decision's unconsumed round
+        # duration for the rounds/hour pace gauge
+        self._round_t0 = None
+        self._last_outcome = None
+        self._outcomes = {"complete": 0, "degraded": 0, "abandoned": 0}
+        self._pending_round_dt = None
         # serializes round turnover and guards `alive`. Sync sends happen
         # OUTSIDE this lock (_open_round returns them, _send_syncs
         # delivers) so a blocking write to a wedged peer can never pin
@@ -356,6 +367,8 @@ class ResilientFedAvgServer(ServerManager):
                                    self.round_policy.select_count(
                                        target, len(alive)))
         self._controller.begin(self.round_idx, self.attempt, cohort, target)
+        self._round_t0 = (time.time()
+                          if get_perf_monitor() is not None else None)
         tracer = get_tracer()
         self._round_span = tracer.start_span(
             "round", root=True, rank=0, round=self.round_idx,
@@ -391,6 +404,24 @@ class ResilientFedAvgServer(ServerManager):
                     pass  # peer-lost dispatch already told the controller
 
     def _on_report(self, msg):
+        mon = get_perf_monitor()
+        if mon is not None:
+            with self._advance_lock:  # _round_t0 mutates under the lock
+                # only reports for the CURRENTLY open (round, attempt)
+                # are measured against its t0: a straggler whose round
+                # already turned over would otherwise be clocked against
+                # the NEW round's open and land in a LOW bucket --
+                # inverting the straggler tail for exactly the events it
+                # exists to capture (those land in the late counter)
+                t0 = (self._round_t0
+                      if (int(msg.get("round")) == self.round_idx
+                          and int(msg.get("attempt")) == self.attempt)
+                      else None)
+            if t0 is not None:
+                # round-open -> report latency: the distribution whose
+                # upper buckets are the straggler tail (observed outside
+                # the lock -- the registry has its own)
+                mon.observe_report_latency(time.time() - t0)
         # parents under the client's "report" span (context injected into
         # the report message, adopted by the manager dispatch loop)
         with get_tracer().span("report-recv",
@@ -434,6 +465,10 @@ class ResilientFedAvgServer(ServerManager):
             self.reporting_log.append(sorted(reports))
             degraded = outcome == ROUND_DEGRADED
             self.counters["rounds_degraded"] += int(degraded)
+            self._last_outcome = outcome
+            self._outcomes[outcome] = self._outcomes.get(outcome, 0) + 1
+            if self._round_t0 is not None:
+                self._pending_round_dt = time.time() - self._round_t0
             self._log_round(len(reports), degraded)
             if self.recovery is not None:
                 done = self.round_idx + 1 >= self.rounds
@@ -448,8 +483,10 @@ class ResilientFedAvgServer(ServerManager):
             done = done or self.failed is not None
         if done:                    # see start(): no STOP wave under the
             self.finish()           # turnover lock
+            self._report_health()
             return
         self._send_syncs(syncs, span)
+        self._report_health()
 
     def _on_round_abandoned(self, reports):
         syncs, span = [], None
@@ -458,6 +495,8 @@ class ResilientFedAvgServer(ServerManager):
             if rspan is not None:
                 rspan.set(outcome="abandoned", reports=len(reports)).end()
             self.counters["rounds_abandoned"] += 1
+            self._last_outcome = "abandoned"
+            self._outcomes["abandoned"] += 1
             logging.warning("round %d attempt %d abandoned with %d reports",
                             self.round_idx, self.attempt, len(reports))
             self.attempt += 1
@@ -470,8 +509,36 @@ class ResilientFedAvgServer(ServerManager):
             done = self.failed is not None
         if done:  # see start(): finish() outside the lock
             self.finish()
+            self._report_health()
             return
         self._send_syncs(syncs, span)
+        self._report_health()
+
+    def _report_health(self):
+        """Status.json + round-pace snapshot for the perf monitor --
+        called from the turnover/serve threads AFTER ``_advance_lock``
+        is released (the status write is file I/O; the snapshot takes
+        the lock only briefly). No-op when the monitor is off."""
+        mon = get_perf_monitor()
+        if mon is None:
+            return
+        with self._advance_lock:
+            fields = {
+                "server": "resilient",
+                "round": self.round_idx,
+                "attempt": self.attempt,
+                "rounds_total": self.rounds,
+                "last_outcome": ("failed" if self.failed is not None
+                                 else self._last_outcome),
+                "outcome_counts": dict(self._outcomes),
+                "alive_ranks": sorted(self.alive),
+                "clients_dropped": self.counters["clients_dropped"],
+            }
+            dt, self._pending_round_dt = self._pending_round_dt, None
+        if dt is not None:
+            mon.observe_round(dt)
+        mon.status_update(force=True, **fields)  # decision-rate writes:
+        # one per round attempt, never a hot path
 
     def _log_round(self, n_reports, degraded):
         if self.metrics_logger is None:

@@ -99,7 +99,9 @@ echo "   no-fault run over the same subsets), the race audit must report"
 echo "   ZERO lock-order cycles and ZERO held-while-blocking events, the"
 echo "   Chrome trace must parse with balanced B/E events, the kill must"
 echo "   produce exactly one flight-recorder dump holding its PEER_LOST"
-echo "   event, and metrics.prom must match the exposition grammar."
+echo "   event, metrics.prom must match the exposition grammar, and the"
+echo "   perfmon must leave a parseable status.json with the final round"
+echo "   outcome plus live report-latency/rounds-per-hour series."
 echo "   fedlint must stay at zero findings on the resilience +"
 echo "   observability packages =="
 python -m fedml_tpu.analysis fedml_tpu/resilience/ fedml_tpu/observability/ \
@@ -122,7 +124,7 @@ plan = FaultPlan(seed=7, rules=(
 ))
 d = tempfile.mkdtemp(prefix="fedtrace_smoke_")
 with enable(trace=True, trace_dir=d, flightrec=True, flightrec_dir=d,
-            compile_events=False) as obs:
+            compile_events=False, perfmon=True) as obs:
     with race_audit() as ra:
         srv = run_tcp_fedavg(4, 3, RoundPolicy(deadline_s=1.0, quorum=0.3),
                              w0, fault_plan=plan, join_timeout=90)
@@ -162,6 +164,17 @@ for p in obs.recorder.dumps:
 assert len(kill_dumps) == 1, obs.recorder.dumps
 assert any(e["kind"] == "peer_lost" and e.get("peer") == 3
            for e in kill_dumps[0])
+
+# perfmon (PR 10): the chaos run left a parseable status.json carrying
+# the FINAL round outcome (the kill+stall scenario degrades at least one
+# round, visible in the outcome counts), the straggler-tail histogram
+# saw every report, and the rolling rounds/hour gauge is live
+status = json.load(open(obs.status_path))
+assert status["last_outcome"] in ("complete", "degraded"), status
+assert status["round"] == 3 and status["final"] is True, status
+assert status["outcome_counts"]["degraded"] >= 1, status
+assert obs.registry.get("fed_report_latency_seconds")[1] > 0
+assert obs.registry.get("fed_rounds_per_hour") > 0
 
 # metrics.prom: every line matches the exposition grammar
 prom_line = re.compile(
@@ -257,18 +270,54 @@ print("massive-cohort smoke:", C, "clients/round, bucket shapes =", shapes,
       "| async bitwise oracle OK | retrace audit clean")
 EOF
 
-echo "== massive-cohort bench record (clients/sec JSON line) =="
+echo "== massive-cohort bench record (clients/sec JSON line, XLA"
+echo "   cost-model per-bucket FLOPs + FLOP-weighted padding waste;"
+echo "   the record seeds the throwaway perf-regression ledger) =="
+CI_LEDGER=bench_results/ci_ledger.jsonl
+rm -f "$CI_LEDGER"
 timeout -k 10 300 python bench.py --massive_cohort 12000 --rounds 1 \
-    --platform cpu > bench_results/bench_massive_smoke.json
+    --platform cpu --ledger "$CI_LEDGER" \
+    > bench_results/bench_massive_smoke.json
 python - <<'EOF'
 import json
 with open("bench_results/bench_massive_smoke.json") as f:
     rec = json.loads(f.readline())
 assert rec["unit"] == "clients/sec" and rec["value"] > 0, rec
 assert rec["bucket_shapes"] > 0 and rec["steady_compiles"] == 0, rec
+# cost-model attribution (PR 10): per-bucket-shape FLOPs + the padded
+# waste reported in FLOPs, from the compiled programs (flops_source xla)
+assert rec["flops_source"] == "xla", rec.get("flops_source")
+assert rec["executed_flops"] > rec["true_flops"] > 0, rec
+assert 0.0 <= rec["flops_waste_frac"] < 1.0, rec
+used = rec["per_bucket"]
+assert used and all("executed_flops" in b and "flops_per_step" in b
+                    for b in used), used
 print("bench --massive_cohort:", rec["value"], "clients/sec,",
-      rec["bucket_shapes"], "bucket shapes, waste",
-      rec["bucket_waste_frac"])
+      rec["bucket_shapes"], "bucket shapes, step waste",
+      rec["bucket_waste_frac"], "/ flop waste", rec["flops_waste_frac"])
 EOF
+
+echo "== perf-regression ledger gate (bench.py --check-regress, both"
+echo "   ways): the massive smoke's record seeded a throwaway ledger --"
+echo "   the gate must pass GREEN on it (fresh: no same-metric"
+echo "   predecessor), then fail RED after a fixture record with an"
+echo "   injected 2x slowdown is appended =="
+python bench.py --check-regress --ledger "$CI_LEDGER"
+python - <<'EOF'
+import json
+from fedml_tpu.observability.perfmon import append_ledger
+rec = json.loads(open("bench_results/bench_massive_smoke.json").readline())
+slow = dict(rec)
+slow["value"] = rec["value"] / 2.0       # the injected 2x slowdown
+slow["round_s"] = rec["round_s"] * 2.0
+slow["injected_fixture"] = "2x-slowdown"
+append_ledger(slow, "bench_results/ci_ledger.jsonl")
+EOF
+if python bench.py --check-regress --ledger "$CI_LEDGER"; then
+    echo "perf-regression gate FAILED to fire on the 2x-slowdown fixture"
+    exit 1
+fi
+echo "perf-regression gate: green on fresh ledger, red on 2x slowdown OK"
+rm -f "$CI_LEDGER"
 
 echo "ci.sh: all green"

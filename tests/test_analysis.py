@@ -1324,6 +1324,89 @@ class TestFl114WallclockTiming:
         assert codes(src) == ["FL114"]
 
 
+class TestFl115MetricLabelCardinality:
+    REG = ("from fedml_tpu.observability.registry import get_registry\n"
+           "reg = get_registry()\n")
+
+    def test_fl115_rank_label_on_counter(self):
+        src = self.REG + (
+            "def on_report(rank):\n"
+            "    reg.inc('fed_reports_total', rank=rank)\n")
+        assert codes(src) == ["FL115"]
+
+    def test_fl115_client_id_label_on_gauge(self):
+        src = self.REG + (
+            "def note(client_id, s):\n"
+            "    reg.set_gauge('fed_staleness', s, client=client_id)\n")
+        assert codes(src) == ["FL115"]
+
+    def test_fl115_sender_id_call_under_any_label_name(self):
+        # the label NAME is innocuous ('src'); the VALUE derives from
+        # msg.get_sender_id() -- still one series per sender
+        src = self.REG + (
+            "def handler(msg):\n"
+            "    reg.inc('fed_reports_total', src=msg.get_sender_id())\n")
+        assert codes(src) == ["FL115"]
+
+    def test_fl115_cohort_loop_variable(self):
+        src = self.REG + (
+            "def fan_out(self):\n"
+            "    for r in sorted(self.alive):\n"
+            "        reg.inc('fed_syncs_total', target=r)\n")
+        assert codes(src) == ["FL115"]
+
+    def test_fl115_attribute_receiver_and_self_rank(self):
+        src = ("from fedml_tpu.observability.registry import MetricsRegistry\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self.registry = MetricsRegistry()\n"
+               "    def f(self):\n"
+               "        self.registry.observe('lat_seconds', 0.1,\n"
+               "                              worker=self.rank)\n")
+        assert codes(src) == ["FL115"]
+
+    def test_fl115_negative_bounded_labels(self):
+        # transport/direction/outcome/reason: bounded enums, the intended
+        # label idiom -- and per-client values in the VALUE position
+        # (not a label) are fine
+        src = self.REG + (
+            "def ok(n, outcome, staleness):\n"
+            "    reg.inc('comm_bytes_total', n, transport='tcp',\n"
+            "            direction='sent')\n"
+            "    reg.inc('fed_round_attempts_total', outcome=outcome)\n"
+            "    reg.set_gauge('fed_update_staleness', staleness)\n"
+            "    reg.observe('lat_seconds', 0.1, buckets=(1, 2))\n")
+        assert codes(src) == []
+
+    def test_fl115_negative_unrelated_receiver(self):
+        # a non-registry object with an `inc` method is out of scope --
+        # only receivers bound from get_registry()/MetricsRegistry()
+        # (or a `registry` attribute) are judged
+        src = ("def f(counters, rank):\n"
+               "    counters.inc('x_total', rank=rank)\n")
+        assert codes(src) == []
+
+    def test_fl115_negative_loop_taint_is_function_scoped(self):
+        # a cohort loop's short `r` in ONE method must not taint an
+        # unrelated `r` used as a label value in another function
+        src = self.REG + (
+            "def fan_out(self):\n"
+            "    for r in sorted(self.alive):\n"
+            "        send(r)\n"
+            "def elsewhere(r):\n"
+            "    reg.inc('retries_total', route=r)\n")
+        assert codes(src) == []
+
+    def test_fl115_negative_chunk_range_loop_is_not_a_cohort(self):
+        # `range(0, C, self.client_chunk)` iterates chunk offsets, not
+        # clients: exact-name collection matching must not taint c0
+        src = self.REG + (
+            "def stream(self, C):\n"
+            "    for c0 in range(0, C, self.client_chunk):\n"
+            "        reg.inc('fed_chunks_total', offset_bucket=c0 // 512)\n")
+        assert codes(src) == []
+
+
 class TestSarif:
     SRC = TestBaseline.SRC
 
@@ -1821,8 +1904,10 @@ class TestCrossClass:
             "        if done:                    "
             "# see start(): no STOP wave under the\n"
             "            self.finish()           # turnover lock\n"
+            "            self._report_health()\n"
             "            return\n"
             "        self._send_syncs(syncs, span)\n"
+            "        self._report_health()\n"
             "\n"
             "    def _on_round_abandoned")
         reverted = (
@@ -1831,6 +1916,7 @@ class TestCrossClass:
             "                self.finish()\n"
             "                return\n"
             "        self._send_syncs(syncs, span)\n"
+            "        self._report_health()\n"
             "\n"
             "    def _on_round_abandoned")
         assert fixed in src, "integration.py turnover shape changed"
@@ -1844,7 +1930,7 @@ class TestCrossClass:
                "calls `self.finish()`" in msg
         # the cited identity is _advance_lock's creation site -- the
         # same string race_audit()/the flight recorder would report
-        assert "integration.py:296" in msg
+        assert "integration.py:307" in msg
         assert "_send_frame" in msg and "TcpCommManager" in msg
 
 

@@ -9,19 +9,30 @@ bitwise identical to an uninstrumented run.
 """
 
 import json
+import math
 import os
 import re
+import sys
 import threading
 
 import numpy as np
 import pytest
 
 from fedml_tpu.core.message import Message
-from fedml_tpu.observability import (FlightRecorder, MetricsRegistry,
-                                     NOOP_TRACER, TRACE_KEY, Tracer, enable,
-                                     get_flight_recorder, get_registry,
-                                     get_tracer)
+from fedml_tpu.observability import (CostModel, FlightRecorder,
+                                     MetricsRegistry, NOOP_TRACER, PerfMonitor,
+                                     StatusWriter, TRACE_KEY, Tracer, enable,
+                                     get_cost_model, get_flight_recorder,
+                                     get_perf_monitor, get_registry,
+                                     get_tracer, set_cost_model,
+                                     set_registry)
+from fedml_tpu.observability.perfmon import (append_ledger, check_regression,
+                                             ledger_records)
 from fedml_tpu.utils.metrics import MetricsLogger
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # bench.py lives at the repo root
+    sys.path.insert(0, REPO)
 
 
 # -- tracer ----------------------------------------------------------------
@@ -323,12 +334,17 @@ class TestCrossRankTracing:
             r'comm_bytes_total\{direction="sent",transport="tcp"\} \d+',
             prom)
 
-    def test_disabled_path_is_bitwise_identical(self):
+    def test_disabled_path_is_bitwise_identical(self, tmp_path):
         # no faults, generous deadline: a deterministic scenario. The
         # observability-enabled run must not perturb the protocol's
         # arithmetic; the disabled run must equal a plain run bitwise.
+        # The enabled side arms EVERYTHING incl. the PR-10 pieces
+        # (perfmon histograms/status.json + cost model) -- extending
+        # PR 7's noop contract to the new instrumentation points.
         srv_plain = _chaos(fault=False, deadline=30.0)
-        with enable(trace=True, flightrec=True, compile_events=False):
+        with enable(trace=True, flightrec=True, compile_events=False,
+                    perfmon=True, status_path=str(tmp_path / "status.json"),
+                    cost_model=True):
             srv_obs = _chaos(fault=False, deadline=30.0)
         srv_off = _chaos(fault=False, deadline=30.0)
         assert srv_plain.reporting_log == srv_obs.reporting_log \
@@ -358,3 +374,454 @@ class TestCrossRankTracing:
         assert any(e["kind"] == "crash"
                    and "injected worker crash" in e.get("error", "")
                    for e in events)
+
+
+# -- XLA cost model (PR 10) -------------------------------------------------
+
+class TestCostModel:
+    def test_program_cost_counts_matmul_flops_exactly(self):
+        import jax
+        import jax.numpy as jnp
+
+        from fedml_tpu.observability.costmodel import program_cost
+
+        f = jax.jit(lambda a, b: a @ b)
+        pc = program_cost(f, jax.ShapeDtypeStruct((8, 64), jnp.float32),
+                          jax.ShapeDtypeStruct((64, 32), jnp.float32))
+        assert pc is not None and pc.source == "xla"
+        assert pc.flops == 2 * 8 * 64 * 32  # one MAC = 2 flops
+        assert pc.bytes_accessed > 0
+
+    def test_train_step_cost_cross_checks_bench_analytic_constant(self):
+        # THE rot guard for bench.py's hand-derived TRAIN_FLOPS_PER_SAMPLE:
+        # the XLA cost model of the real smoke-shape ResNet-56 train step
+        # (bf16 model, recipe augmentation -- exactly what bench --smoke
+        # compiles) must agree with the analytic constant within the
+        # documented tolerance (FLOPS_XCHECK_TOL, docs/PERFORMANCE.md
+        # round 7). If either side drifts, this fails loudly.
+        import jax
+        import jax.numpy as jnp
+
+        import bench
+        from fedml_tpu import models
+        from fedml_tpu.algorithms.specs import make_classification_spec
+        from fedml_tpu.data.augment import make_cifar_augment
+        from fedml_tpu.observability.costmodel import train_step_cost
+        from fedml_tpu.parallel.engine import ClientUpdateConfig
+
+        image, bs = 16, 8  # the bench --smoke shape (compiles in ~15 s)
+        model = models.resnet56(class_num=10, dtype=jnp.bfloat16)
+        spec = make_classification_spec(
+            model, jnp.zeros((1, image, image, 3)),
+            augment_fn=make_cifar_augment(pad=2, cutout_length=4))
+        cfg = ClientUpdateConfig(optimizer="sgd", lr=0.001,
+                                 weight_decay=0.001)
+        batch = {"x": jax.ShapeDtypeStruct((bs, image, image, 3),
+                                           jnp.float32),
+                 "y": jax.ShapeDtypeStruct((bs,), jnp.int32),
+                 "mask": jax.ShapeDtypeStruct((bs,), jnp.float32)}
+        pc = train_step_cost(spec, cfg, batch)
+        assert pc is not None, "cost analysis unavailable on this backend"
+        per_sample = pc.flops / bs
+        analytic = bench.TRAIN_FLOPS_PER_SAMPLE * (image / 32) ** 2
+        ratio = per_sample / analytic
+        assert abs(ratio - 1.0) <= bench.FLOPS_XCHECK_TOL, (
+            f"cost-model/analytic ratio {ratio:.3f} outside "
+            f"+-{bench.FLOPS_XCHECK_TOL}: the analytic constant (or the "
+            "model) drifted -- update bench.py's derivation and "
+            "docs/PERFORMANCE.md round 7")
+
+    def test_train_step_cost_unknown_optimizer_returns_none(self):
+        import jax
+        import jax.numpy as jnp
+
+        from fedml_tpu import models
+        from fedml_tpu.algorithms.specs import make_classification_spec
+        from fedml_tpu.observability.costmodel import train_step_cost
+        from fedml_tpu.parallel.engine import ClientUpdateConfig
+
+        spec = make_classification_spec(
+            models.LogisticRegression(num_classes=2),
+            jnp.zeros((1, 4)))
+        pc = train_step_cost(
+            spec, ClientUpdateConfig(optimizer="nope"),
+            {"x": jax.ShapeDtypeStruct((2, 4), jnp.float32),
+             "y": jax.ShapeDtypeStruct((2,), jnp.int32),
+             "mask": jax.ShapeDtypeStruct((2,), jnp.float32)})
+        assert pc is None  # degrade to the analytic fallback, never raise
+
+    def test_bucket_runner_attributes_per_bucket_flops(self):
+        # cost model armed: per-bucket FLOPs + FLOP-weighted waste ride
+        # the round record; identical run with it off carries no flops
+        # fields AND produces bitwise-identical params (disabled-path
+        # contract at the engine level)
+        import types
+
+        import jax
+        import jax.numpy as jnp
+
+        import bench
+        from fedml_tpu import models
+        from fedml_tpu.algorithms.fedavg import FedAvgAPI
+        from fedml_tpu.algorithms.specs import make_classification_spec
+
+        C = 300
+        dataset = bench._ragged_lr_clients(C)
+        spec = make_classification_spec(
+            models.LogisticRegression(num_classes=4, apply_sigmoid=False),
+            jnp.zeros((1, 16)))
+        run_args = types.SimpleNamespace(
+            client_num_in_total=C, client_num_per_round=C,
+            comm_round=10 ** 9, epochs=1, batch_size=8, lr=0.05, wd=0.0,
+            client_optimizer="sgd", frequency_of_the_test=10 ** 9, seed=0,
+            client_chunk=64, bucket_edges="geometric", device_resident="0")
+
+        api_off = FedAvgAPI(dataset, spec, run_args)
+        m_off = api_off.train_one_round()
+        assert "bucket/executed_flops" not in m_off
+        p_off = jax.tree.map(np.asarray, api_off.global_state)
+
+        cm = CostModel()
+        prev = set_cost_model(cm)
+        try:
+            api_on = FedAvgAPI(dataset, spec, run_args)
+            m_on = api_on.train_one_round()
+        finally:
+            set_cost_model(prev)
+        assert get_cost_model() is prev
+        p_on = jax.tree.map(np.asarray, api_on.global_state)
+        for a, b in zip(jax.tree.leaves(p_off), jax.tree.leaves(p_on)):
+            assert (a == b).all(), "cost model perturbed the round"
+
+        assert m_on["bucket/executed_flops"] > m_on["bucket/true_flops"] > 0
+        assert 0.0 <= m_on["bucket/flops_waste_frac"] < 1.0
+        info = api_on._last_bucket_info["bucket"]
+        assert info["flops_source"] == "xla"
+        used = [b for b in info["per_bucket"] if not b["skipped"]]
+        assert used and all("flops_per_step" in b and
+                            b["executed_flops"] >= b["true_flops"]
+                            for b in used)
+        # the per-bucket rows sum to the round totals
+        assert math.isclose(sum(b["executed_flops"] for b in used),
+                            info["executed_flops"], rel_tol=1e-9)
+        # the AOT probes never polluted the dispatch cache: compiled
+        # programs still == bucket shapes (the ci.sh massive-gate anchor)
+        assert api_on.bucket_runner.compiled_shapes() == m_on["bucket/shapes"]
+        # catalog rode the armed CostModel
+        rec = cm.record()
+        assert rec["cost/programs"] == len(used)
+
+
+# -- perf monitor (PR 10) ---------------------------------------------------
+
+class TestPerfMonitor:
+    def test_round_histograms_and_rolling_rph_gauge(self):
+        reg = MetricsRegistry()
+        prev = set_registry(reg)
+        try:
+            mon = PerfMonitor(window=8)
+            for _ in range(3):
+                mon.observe_round(0.5, steps=100)
+            mon.observe_report_latency(0.2)
+            mon.observe_fold(staleness=3, depth=7)
+        finally:
+            set_registry(prev)
+        assert reg.get("fed_round_seconds") == (1.5, 3)
+        s, n = reg.get("fed_step_seconds")
+        assert n == 3 and abs(s - 3 * 0.005) < 1e-9
+        assert reg.get("fed_report_latency_seconds") == (0.2, 1)
+        assert reg.get("fed_staleness_levels") == (3.0, 1)
+        assert reg.get("fed_buffer_depth_levels") == (7.0, 1)
+        assert reg.get("fed_rounds_per_hour") > 0
+        rec = mon.record()
+        assert rec["perf/rounds_observed"] == 3
+        assert rec["perf/reports_observed"] == 1
+
+    def test_monitor_without_registry_is_inert(self):
+        # perfmon armed but no registry (programmatic use): observations
+        # must not crash and the rolling record still works
+        assert get_registry() is None
+        mon = PerfMonitor()
+        mon.observe_round(0.1)
+        mon.observe_round(0.1)
+        mon.observe_fold(0, 1)
+        assert mon.record()["perf/rounds_observed"] == 2
+
+    def test_status_writer_throttle_force_and_merge(self, tmp_path):
+        p = str(tmp_path / "status.json")
+        w = StatusWriter(p, min_interval_s=3600)
+        assert w.update(round=1, outcome="running") == p  # first: written
+        assert w.update(round=2) is None  # high-rate update: throttled...
+        assert json.load(open(p))["round"] == 1
+        assert w.update(force=True, round=3) == p  # ...force writes
+        doc = json.load(open(p))
+        # fields MERGE across updates (incl. the throttled one's round=2
+        # -> round=3); the write is atomic (always a full JSON document)
+        assert doc["round"] == 3 and doc["outcome"] == "running"
+        assert doc["status_version"] == 1 and "updated_at" in doc
+        assert w.writes == 2
+
+    def test_status_writer_bad_path_never_raises(self):
+        w = StatusWriter("/proc/definitely/not/writable/status.json",
+                         min_interval_s=0)
+        assert w.update(force=True, round=1) is None  # logged, not fatal
+
+    def test_xprof_fires_only_on_its_round_and_once(self, tmp_path):
+        calls = []
+        mon = PerfMonitor(xprof_dir=str(tmp_path), xprof_round=2)
+        import jax
+        orig_start = jax.profiler.start_trace
+        orig_stop = jax.profiler.stop_trace
+        jax.profiler.start_trace = lambda d: calls.append(("start", d))
+        jax.profiler.stop_trace = lambda: calls.append(("stop",))
+        try:
+            with mon.xprof(0):
+                pass
+            assert calls == []  # wrong round: nullcontext
+            with mon.xprof(2):
+                pass
+            assert [c[0] for c in calls] == ["start", "stop"]
+            with mon.xprof(2):
+                pass
+            assert len(calls) == 2  # one-shot
+        finally:
+            jax.profiler.start_trace = orig_start
+            jax.profiler.stop_trace = orig_stop
+
+    def test_xprof_noops_cleanly_when_profiler_unavailable(self, tmp_path):
+        mon = PerfMonitor(xprof_dir=str(tmp_path), xprof_round=0)
+        import jax
+        orig = jax.profiler.start_trace
+
+        def boom(d):
+            raise RuntimeError("profiler busy / unavailable")
+
+        jax.profiler.start_trace = boom
+        try:
+            with mon.xprof(0):
+                ran = True  # the round body must still run
+        finally:
+            jax.profiler.start_trace = orig
+        assert ran and mon._xprof_done
+
+    def test_async_fold_feeds_histograms_and_flush_status(self, tmp_path):
+        # BufferedAggregator.fold with the monitor armed: staleness/depth
+        # distributions land in the registry next to PR 9's point gauges
+        from fedml_tpu.resilience.async_agg import (AsyncAggPolicy,
+                                                    BufferedAggregator)
+
+        w = {"w": np.ones(2, np.float32)}
+        with enable(perfmon=True, flightrec_dir=str(tmp_path),
+                    compile_events=False) as obs:
+            agg = BufferedAggregator(AsyncAggPolicy(buffer_k=2,
+                                                    staleness_decay=0.0))
+            agg.fold(1, 10.0, w, staleness=0)
+            agg.fold(2, 10.0, w, staleness=5)
+            agg.flush("buffer_k")
+            reg = obs.registry
+            assert reg.get("fed_staleness_levels") == (5.0, 2)
+            _, n = reg.get("fed_buffer_depth_levels")
+            assert n == 2
+        assert get_perf_monitor() is None  # scope restored
+
+    def test_tcp_run_writes_status_with_final_outcome(self, tmp_path):
+        from fedml_tpu.resilience import RoundPolicy, run_tcp_fedavg
+
+        w0 = {"w": np.zeros((2, 2), np.float32)}
+        with enable(perfmon=True, flightrec_dir=str(tmp_path),
+                    compile_events=False) as obs:
+            srv = run_tcp_fedavg(3, 2,
+                                 RoundPolicy(deadline_s=30.0, quorum=0.3),
+                                 w0, join_timeout=60)
+            reg = obs.registry
+            _, nlat = reg.get("fed_report_latency_seconds")
+        assert srv.failed is None and len(srv.history) == 2
+        assert nlat == 4  # 2 clients x 2 rounds: the straggler-tail feed
+        doc = json.load(open(obs.status_path))
+        assert doc["last_outcome"] == "complete"
+        assert doc["round"] == 2 and doc["alive_ranks"] == [1, 2]
+        assert doc["outcome_counts"]["complete"] == 2
+        assert doc["final"] is True  # the scope's forced exit write
+
+
+# -- histogram rendering (PR 10 satellite) ----------------------------------
+
+class TestHistogramRendering:
+    def _grammar_check(self, text):
+        for line in text.strip().split("\n"):
+            assert PROM_LINE.match(line), line
+
+    def test_bucket_sum_count_lines_and_cumulative_monotone(self):
+        r = MetricsRegistry()
+        for v in (0.003, 0.02, 0.02, 9.0, 100.0):
+            r.observe("lat_seconds", v, buckets=(0.01, 0.05, 10.0),
+                      help="latency", route="a")
+        text = r.render_prometheus()
+        self._grammar_check(text)
+        assert 'lat_seconds_bucket{route="a",le="0.01"} 1' in text
+        assert 'lat_seconds_bucket{route="a",le="0.05"} 3' in text
+        assert 'lat_seconds_bucket{route="a",le="10.0"} 4' in text
+        assert 'lat_seconds_bucket{route="a",le="+Inf"} 5' in text
+        assert 'lat_seconds_count{route="a"} 5' in text
+        # cumulative bucket counts never decrease
+        counts = [int(m.group(1)) for m in re.finditer(
+            r'lat_seconds_bucket\{[^}]*\} (\d+)', text)]
+        assert counts == sorted(counts)
+
+    def test_empty_histogram_renders_zero_series(self):
+        # declare_histogram pre-registers a series with no observations:
+        # all-zero buckets, sum 0.0, count 0 -- and still grammar-valid
+        r = MetricsRegistry()
+        r.declare_histogram("fed_round_seconds", buckets=(1.0, 5.0),
+                            help="pre-declared")
+        text = r.render_prometheus()
+        self._grammar_check(text)
+        assert 'fed_round_seconds_bucket{le="+Inf"} 0' in text
+        assert "fed_round_seconds_count 0" in text
+        assert r.get("fed_round_seconds") == (0.0, 0)
+        # idempotent: re-declaring never resets an observed series
+        r.observe("fed_round_seconds", 0.5, buckets=(1.0, 5.0))
+        r.declare_histogram("fed_round_seconds", buckets=(1.0, 5.0))
+        assert r.get("fed_round_seconds") == (0.5, 1)
+
+    def test_nan_observation_stays_grammar_valid(self):
+        # a NaN observation falls through every finite bucket into +Inf
+        # (NaN <= le is False) and poisons the sum -- which must render
+        # as Prometheus's 'NaN', never repr's 'nan'
+        r = MetricsRegistry()
+        r.observe("odd_seconds", float("nan"), buckets=(1.0,))
+        r.observe("odd_seconds", 0.5, buckets=(1.0,))
+        text = r.render_prometheus()
+        self._grammar_check(text)
+        assert 'odd_seconds_bucket{le="1.0"} 1' in text
+        assert 'odd_seconds_bucket{le="+Inf"} 2' in text
+        assert "odd_seconds_sum NaN" in text
+        assert "odd_seconds_count 2" in text
+
+
+# -- perf-regression ledger (PR 10) -----------------------------------------
+
+class TestLedger:
+    REC = {"metric": "m rounds/hour", "value": 100.0, "unit": "rounds/hour"}
+
+    def test_append_stamps_and_roundtrips(self, tmp_path):
+        p = str(tmp_path / "ledger.jsonl")
+        append_ledger(self.REC, p)
+        append_ledger({**self.REC, "value": 101.0}, p)
+        recs = ledger_records(p)
+        assert [r["value"] for r in recs] == [100.0, 101.0]
+        assert all("ledger_ts" in r for r in recs)
+
+    def test_fresh_ledger_passes_and_regression_fails(self, tmp_path):
+        p = str(tmp_path / "ledger.jsonl")
+        ok, d = check_regression(p)
+        assert ok and d["fresh_ledger"]
+        append_ledger(self.REC, p)
+        ok, d = check_regression(p)
+        assert ok and d["fresh_ledger"]  # one record: no baseline yet
+        append_ledger({**self.REC, "value": 97.0}, p)
+        ok, d = check_regression(p)  # -3%: inside the 15% noise band
+        assert ok and not d["fresh_ledger"]
+        append_ledger({**self.REC, "value": 50.0}, p)  # the 2x slowdown
+        ok, d = check_regression(p)
+        assert not ok
+        assert d["latest_value"] == 50.0
+        assert d["baseline_median"] == pytest.approx(98.5)
+
+    def test_other_metrics_never_judge_each_other(self, tmp_path):
+        # a smoke record must not drag a flagship baseline (and vice
+        # versa): baselines group by the exact metric string
+        p = str(tmp_path / "ledger.jsonl")
+        append_ledger({"metric": "flagship", "value": 100.0}, p)
+        append_ledger({"metric": "smoke [SMOKE]", "value": 5.0}, p)
+        ok, d = check_regression(p)
+        assert ok and d["fresh_ledger"]  # no same-metric predecessor
+
+    def test_unparseable_lines_are_skipped_not_fatal(self, tmp_path):
+        p = str(tmp_path / "ledger.jsonl")
+        append_ledger(self.REC, p)
+        with open(p, "a") as f:
+            f.write("not json\n")
+        append_ledger({**self.REC, "value": 40.0}, p)
+        ok, d = check_regression(p)
+        assert not ok and d["records"] == 2
+
+    def test_bench_check_regress_cli_both_ways(self, tmp_path):
+        # the exact ci.sh gate, as subprocesses: green on a fresh ledger,
+        # red after a fixture record with an injected 2x slowdown
+        import subprocess
+
+        p = str(tmp_path / "ledger.jsonl")
+        append_ledger({"metric": "clients/sec", "value": 50000.0}, p)
+        r = subprocess.run(
+            [sys.executable, "bench.py", "--check-regress", "--ledger", p],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert r.returncode == 0, (r.stdout, r.stderr)
+        assert json.loads(r.stdout)["pass"] is True
+        append_ledger({"metric": "clients/sec", "value": 25000.0}, p)
+        r = subprocess.run(
+            [sys.executable, "bench.py", "--check-regress", "--ledger", p],
+            capture_output=True, text=True, cwd=REPO, timeout=120)
+        assert r.returncode == 1, (r.stdout, r.stderr)
+        assert json.loads(r.stdout)["pass"] is False
+
+
+class TestBenchCpuFallback:
+    def test_probe_timeout_falls_back_instead_of_zero_record(self):
+        # the BENCH_r05 bug: a probe timeout must flip the run to the
+        # CPU smoke (real record, device=cpu-fallback), not emit
+        # value 0.0 + an error string. Unit-level: exercise main()'s
+        # fallback branch by faking the axon env + a failing probe, and
+        # stop the run right after the decision (the full smoke is the
+        # slow-marked test_bench_cpu_smoke's job).
+        import types
+
+        import bench
+
+        argv = ["bench.py"]
+        probe_calls = []
+
+        def fake_probe(timeout_s=120.0):
+            probe_calls.append(timeout_s)
+            return "device probe timed out after 120s (fake)"
+
+        class _Stop(Exception):
+            pass
+
+        def stop(*a, **kw):
+            raise _Stop()
+
+        orig = (bench.probe_device, bench.arm_watchdog, sys.argv,
+                os.environ.get("JAX_PLATFORMS"))
+        bench.probe_device = fake_probe
+        bench.arm_watchdog = stop  # first call after the fallback branch
+        sys.argv = argv
+        os.environ["JAX_PLATFORMS"] = "axon"
+        try:
+            import argparse
+            ns = {}
+            real_parse = argparse.ArgumentParser.parse_args
+
+            def capture_parse(self, *a, **kw):
+                args = real_parse(self, *a, **kw)
+                ns["args"] = args
+                return args
+
+            argparse.ArgumentParser.parse_args = capture_parse
+            try:
+                with pytest.raises(_Stop):
+                    bench.main()
+            finally:
+                argparse.ArgumentParser.parse_args = real_parse
+        finally:
+            bench.probe_device, bench.arm_watchdog, sys.argv = orig[:3]
+            if orig[3] is None:
+                os.environ.pop("JAX_PLATFORMS", None)
+            else:
+                os.environ["JAX_PLATFORMS"] = orig[3]
+        assert probe_calls, "probe was skipped"
+        # the fallback flipped the run to the CPU smoke instead of
+        # emitting the dead record
+        assert ns["args"].smoke is True
+        import jax
+        assert jax.config.jax_platforms == "cpu"
