@@ -412,6 +412,60 @@ def run_massive_cohort(args):
     return 0
 
 
+def run_soak_bench(args):
+    """``--soak [N]``: the event-loop control-plane bench. One JSON
+    record: reports/sec headline, connection count, and the
+    ``fed_report_latency_seconds`` tail -- the ledger's evidence that
+    the transport keeps its connections/sec and latency behavior."""
+    import tempfile
+
+    from fedml_tpu.net.soak import run_soak
+    from fedml_tpu.observability import enable
+
+    n = int(args.soak)
+    d = tempfile.mkdtemp(prefix="bench_soak_")
+    status_path = os.path.join(d, "status.json")
+    t0 = time.time()
+    with enable(perfmon=True, status_path=status_path,
+                compile_events=False) as obs:
+        server, summary = run_soak(
+            n, total_updates=int(args.soak_updates),
+            jitter_s=float(args.soak_jitter),
+            join_timeout=max(300.0, n / 10.0))
+    wall_s = time.time() - t0
+    if server.failed is not None:
+        print(json.dumps({"metric": "eventloop-soak", "error":
+                          server.failed}), flush=True)
+        return 1
+    with open(status_path) as f:
+        status = json.load(f)
+    assert status.get("final") is True, status
+    reports = server.counters["reports"]
+    q = obs.registry.histogram_quantile
+    out = {
+        "metric": f"eventloop-soak reports/sec ({n} connections, "
+                  "async buffered)",
+        "value": round(reports / wall_s, 1),
+        "unit": "reports/sec",
+        "connections": summary.get("connections"),
+        "connections_per_sec": round(n / wall_s, 1),
+        "updates": server.agg.version,
+        "reports": reports,
+        "wall_s": round(wall_s, 3),
+        "report_latency_p50_s": q("fed_report_latency_seconds", 0.5),
+        "report_latency_p90_s": q("fed_report_latency_seconds", 0.9),
+        "report_latency_p99_s": q("fed_report_latency_seconds", 0.99),
+        "sheds": getattr(server.com_manager, "sheds", 0),
+        "status_outcome": status.get("outcome"),
+        "transport": "eventloop",
+    }
+    print(json.dumps(out), flush=True)
+    if args.ledger:
+        from fedml_tpu.observability.perfmon import append_ledger
+        append_ledger(out, args.ledger)
+    return 0
+
+
 def _sweep_params(model_name):
     """Model-shaped ``params`` pytree on CPU (shapes are what matter)."""
     import jax
@@ -541,6 +595,21 @@ def main():
                         "simulated LR clients; emits a JSON record with "
                         "clients/sec, bucket-shape count and padded-waste "
                         "fraction (docs/PERFORMANCE.md round 6)")
+    p.add_argument("--soak", nargs="?", const=1000, type=int,
+                   default=None, metavar="N",
+                   help="event-loop soak bench (fedml_tpu.net.soak): one "
+                        "host drives N (default 1,000) swarm connections "
+                        "through a real buffered-async server over the "
+                        "selector transport; emits a JSON record with "
+                        "connections/sec + reports/sec and the "
+                        "fed_report_latency_seconds tail (p50/p90/p99) "
+                        "-- the --check-regress ledger's control-plane "
+                        "metric (docs/NETWORKING.md)")
+    p.add_argument("--soak_updates", type=int, default=3,
+                   help="soak bench: async server updates (flush windows)")
+    p.add_argument("--soak_jitter", type=float, default=0.5,
+                   help="soak bench: max seeded per-report reply jitter "
+                        "in seconds (the latency histogram's tail)")
     p.add_argument("--massive_async", type=int, default=0,
                    help="massive-cohort bench: run the buffered-async "
                         "aggregation path (--buffer_k/--staleness_decay)")
@@ -614,6 +683,13 @@ def main():
         import jax
         jax.config.update("jax_platforms", "cpu")
         sys.exit(run_compression_tools(args))
+
+    if args.soak:
+        # control-plane bench: sockets + numpy (jax only inside the
+        # server's fp64 fold) -- runs with the accelerator tunnel dead
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        sys.exit(run_soak_bench(args))
 
     if args.massive_cohort:
         # the workload is the cohort axis, not the model: runs on any

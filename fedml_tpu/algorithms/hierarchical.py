@@ -80,9 +80,11 @@ class HierarchicalFedAvgAPI(FedAvgAPI):
             self.args.client_num_per_round)
         # round-robin group assignment (reference partitions the cohort into
         # group_num groups); unequal groups are padded with empty client slots
-        # (weight 0, fully masked) so no sampled client is dropped
-        groups = [client_indexes[g::self.group_num] for g in range(self.group_num)]
-        groups = [g for g in groups if g]
+        # (weight 0, fully masked) so no sampled client is dropped. The rule
+        # is shared with the distributed fan-in tier (net/fanin.py), so the
+        # vmapped group axis and the edge-aggregator tree slice identically.
+        from fedml_tpu.net.fanin import round_robin_groups
+        groups = round_robin_groups(client_indexes, self.group_num)
         per_group = max(len(g) for g in groups)
         logging.info("hierarchical groups = %s", groups)
 

@@ -39,6 +39,16 @@ Rules:
   *state* lock: one wedged peer pins every thread that needs the lock.
   Dedicated ``io_lock`` families are exempt -- serializing one pipe's
   blocking writes is their purpose.
+- **FL129** -- event-loop readiness (:func:`check_eventloop`): a blocking
+  call reachable from an *event-loop callback* (a bound method registered
+  as selector/asyncio callback data, or any coroutine) -- the
+  single-thread analog of FL125: where a held lock pins the threads that
+  need it, a blocked loop callback pins EVERY connection the loop
+  multiplexes. Selector-ready non-blocking I/O (``recv_into``,
+  ``accept``, ``connect_ex``, ``send``) is the loop's correct form and
+  deliberately not in this rule's blocking set; bare ``recv``,
+  ``sendall``, joins, sleeps, and the transport-level send entry points
+  are never legal on a loop thread.
 """
 
 from __future__ import annotations
@@ -61,6 +71,23 @@ _BLOCKING_NAMES = {"_send_frame", "_recv_frame", "send_with_retry"}
 #: Methods that transports enter from their receive machinery, treated as
 #: handler-thread roots by protocol convention.
 _NAMED_ROOTS = {"receive_message", "handle_receive_message"}
+
+#: FL129: calls that block the calling thread inside an event-loop
+#: callback/coroutine. A deliberate subset of the FL125 tables:
+#: ``recv_into``/``accept``/``connect`` are absent because on a
+#: selector-ready non-blocking socket they ARE the loop's correct form;
+#: everything here blocks (or dispatches into arbitrary handler code)
+#: regardless of socket mode.
+_EVENTLOOP_BLOCKING_ATTRS = {"sendall", "recv", "join", "sleep",
+                             "send_message", "publish", "loop_forever",
+                             "handle_receive_message"}
+_EVENTLOOP_BLOCKING_NAMES = {"_send_frame", "_recv_frame",
+                             "send_with_retry"}
+#: Calls whose callable arguments become loop-callback roots: selector
+#: registration (``selectors`` protocol) and asyncio's schedulers.
+_LOOP_REGISTER_ATTRS = {"register", "modify", "add_reader", "add_writer",
+                        "call_soon", "call_soon_threadsafe", "call_later",
+                        "call_at"}
 
 #: Public aliases: the cross-class pass (``analysis.crossclass``, FL126)
 #: shares this pass's vocabulary -- lock-constructor classification and
@@ -368,6 +395,124 @@ class _ClassChecker:
                      "`io_lock()` (fedml_tpu.analysis.locks)")
 
 
+def check_eventloop(tree, add):
+    """FL129: event-loop readiness. Roots are (a) bound methods whose
+    ``self.m`` reference appears among the arguments of a selector/
+    asyncio registration call (``register``/``modify``/``add_reader``/
+    ``call_soon``/... -- including inside tuple callback data), and (b)
+    every coroutine (``async def``). The per-class ``self.m()`` call
+    closure from those roots must be free of blocking calls: the loop
+    thread serves every multiplexed connection, so one blocked callback
+    is a whole-transport stall -- FL125's hazard without needing a lock.
+    Findings go to ``add(node, code, message)``."""
+    class_methods = set()  # async METHODS are _EventLoopChecker roots --
+    # the free-coroutine branch below must not double-report them
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for m in node.body:
+                if isinstance(m, ast.AsyncFunctionDef):
+                    class_methods.add(id(m))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            _EventLoopChecker(node, add).run()
+        elif isinstance(node, ast.AsyncFunctionDef) \
+                and id(node) not in class_methods:
+            # free coroutines: direct-body check (no self-closure)
+            for label, call in _blocking_calls(node):
+                add(call, "FL129",
+                    f"blocking call `{label}` inside coroutine "
+                    f"`{node.name}` -- an awaiting event loop cannot run "
+                    "any other task while this blocks; use the loop's "
+                    "non-blocking primitives or hand the work to a "
+                    "dispatcher thread")
+
+
+def _blocking_calls(fn):
+    """(label, Call node) for every FL129-blocking call in ``fn``'s body,
+    excluding nested function/class scopes (they run on other threads)."""
+    out = []
+
+    def visit(node):
+        if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                             ast.AsyncFunctionDef, ast.ClassDef)):
+            return
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) \
+                    and f.attr in _EVENTLOOP_BLOCKING_ATTRS:
+                out.append((f.attr, node))
+            elif isinstance(f, ast.Name) \
+                    and f.id in _EVENTLOOP_BLOCKING_NAMES:
+                out.append((f.id, node))
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for stmt in fn.body:
+        visit(stmt)
+    return out
+
+
+class _EventLoopChecker:
+    """Per-class FL129: loop-callback roots + self-call closure."""
+
+    def __init__(self, cls, add):
+        self.cls = cls
+        self.add = add
+        self.methods = {m.name: m for m in cls.body
+                        if isinstance(m, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))}
+
+    def _roots(self):
+        roots = {name for name, fn in self.methods.items()
+                 if isinstance(fn, ast.AsyncFunctionDef)}
+        for fn in self.methods.values():
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _LOOP_REGISTER_ATTRS):
+                    continue
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    for sub in ast.walk(arg):
+                        attr = _self_attr(sub)
+                        if attr is not None and attr in self.methods:
+                            roots.add(attr)
+        return roots
+
+    def run(self):
+        roots = self._roots()
+        if not roots:
+            return
+        graph = {}
+        for name, fn in self.methods.items():
+            callees = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    attr = _self_attr(node.func)
+                    if attr is not None and attr in self.methods:
+                        callees.add(attr)
+            graph[name] = callees
+        reach, frontier = set(roots), list(roots)
+        while frontier:
+            m = frontier.pop()
+            for callee in graph.get(m, ()):
+                if callee not in reach:
+                    reach.add(callee)
+                    frontier.append(callee)
+        for name in sorted(reach):
+            for label, call in _blocking_calls(self.methods[name]):
+                via = ("" if name in roots else
+                       " (reached from a registered callback)")
+                self.add(call, "FL129",
+                         f"blocking call `{label}` in event-loop callback "
+                         f"path `{self.cls.name}.{name}`{via} -- the loop "
+                         "thread serves EVERY multiplexed connection, so "
+                         "one blocked callback stalls the whole "
+                         "transport. Use non-blocking socket ops "
+                         "(recv_into/send on a ready fd) or queue the "
+                         "work to the dispatcher thread")
+
+
 def find_lock_cycles(edges):
     """Unique cycles in a directed acquisition-order edge set, deduped by
     node set; each returned as ``[n1, ..., nk]`` (closing edge
@@ -437,5 +582,6 @@ def _header_exprs(stmt):
     return []
 
 
-__all__ = ["check_concurrency", "find_lock_cycles", "STATE_CTORS",
-           "IO_CTORS", "BLOCKING_ATTRS", "BLOCKING_NAMES", "NAMED_ROOTS"]
+__all__ = ["check_concurrency", "check_eventloop", "find_lock_cycles",
+           "STATE_CTORS", "IO_CTORS", "BLOCKING_ATTRS", "BLOCKING_NAMES",
+           "NAMED_ROOTS"]

@@ -47,14 +47,30 @@ Rule (two shapes, one code):
   discovered under a hold carried across a class boundary). Purely
   class-local cycles stay FL124.
 
+3. **Container-element typing.** A field assigned a list/set/dict
+   literal is a *container*; its elements are typed by what flows in --
+   directly (``self._peers[rank] = Conn(...)``) or through
+   method-argument flow: when ``self.field.m(x)`` / ``self.m(x)`` binds
+   a resolvable ``x`` (``self``, a ``self.method`` reference, a
+   constructor call) to a parameter that the target method appends/
+   stores into a container, the element type lands on that container.
+   Locals bound by iterating or indexing a container (``for obs in
+   self._observers:``, ``handler = self.handlers.get(t)``) carry the
+   element types, so ``obs.receive_message(...)`` and the handler-dict
+   dispatch ``handler(msg)`` are real call edges: the verifier now walks
+   transport -> ``DistributedManager.receive_message`` -> registered FSM
+   handler chains statically -- dispatching observers under a held state
+   lock is an FL126 finding, not a runtime-sanitizer catch.
+
 Soundness limits (documented, deliberate): locals returned by module
-functions (``get_tracer()``, ``get_flight_recorder()``) and elements of
-containers (the transports' ``_observers`` list) are not typed -- chains
-through them are invisible here and remain the runtime sanitizer's to
-catch; module-level function bodies (``aggregate_reports``) are not
-entered; ``.acquire()`` calls outside a ``with`` do not open a held
-region (the repo's only uses are bounded-timeout acquires, which cannot
-deadlock-by-order).
+functions (``get_tracer()``, ``get_flight_recorder()``) are not typed --
+chains through them are invisible here and remain the runtime
+sanitizer's to catch; module-level function bodies
+(``aggregate_reports``) are not entered; container elements flowing
+through non-``self`` receivers (``comm.add_observer(obs)`` on a bare
+local) or re-exported collections are untyped; ``.acquire()`` calls
+outside a ``with`` do not open a held region (the repo's only uses are
+bounded-timeout acquires, which cannot deadlock-by-order).
 """
 
 from __future__ import annotations
@@ -121,16 +137,34 @@ class _ClassInfo:
         #:   ("param", pname)   -- self.f = <ctor param> (flow-typed)
         #:   ("method", mname)  -- self.f = self.m (bound method)
         self.field_refs = {}
+        #: container fields (list/set/dict literal assigns) + their
+        #: element typing inputs (the container-element pass):
+        #:   elem_refs[attr]  -- direct refs, field_refs grammar plus
+        #:                       ("selfcls", None) for `self`
+        #:   elem_sinks[attr] -- [(method, pname)]: the method stores its
+        #:                       parameter into the container; call-arg
+        #:                       flow resolves the element types
+        self.containers = set()
+        self.elem_refs = {}
+        self.elem_sinks = {}
+        #: method-argument flow seeds: (call descriptor, [per-positional-
+        #: arg ref lists], {kwarg: ref list}) for self./field calls whose
+        #: arguments are resolvable (self / self.m / Ctor())
+        self.call_args = []
         #: method name -> [_Op]
         self.ops = {}
         self._locals = {}
+        self._elem_aliases = {}
         self._collect_families()
+        self._collect_containers()
         for name, fn in self.methods.items():
             self._locals = self._lock_aliases(fn)
+            self._elem_aliases = self._container_aliases(fn)
             out = []
             self._visit(fn.body, out, frozenset())
             self.ops[name] = out
             self._collect_fields(name, fn)
+            self._collect_elems(name, fn)
 
     # -- families / fields -------------------------------------------------
     def _collect_families(self):
@@ -165,6 +199,116 @@ class _ClassInfo:
                     continue
                 for ref in _value_refs(node.value, params, self):
                     self.field_refs.setdefault(attr, []).append(ref)
+
+    def _collect_containers(self):
+        """Fields assigned a list/set/dict literal (or bare collection
+        constructor) anywhere in the class are containers: their element
+        types come from the sinks below, not from field_refs."""
+        ctors = {"list", "set", "dict", "deque", "OrderedDict",
+                 "defaultdict", "SimpleQueue", "Queue"}
+        for fn in self.methods.values():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                v = node.value
+                lit = isinstance(v, (ast.List, ast.Set, ast.Dict))
+                lit = lit or (isinstance(v, ast.Call)
+                              and isinstance(v.func, ast.Name)
+                              and v.func.id in ctors)
+                if not lit:
+                    continue
+                for tgt in node.targets:
+                    attr = _self_attr(tgt)
+                    if attr is not None and attr not in self.families:
+                        self.containers.add(attr)
+
+    def _collect_elems(self, method, fn):
+        """Element sinks of this method: ``self.a.append(x)`` /
+        ``.add(x)`` / ``self.a[k] = x`` with ``a`` a container. A
+        resolvable ``x`` types the elements directly; a parameter
+        ``x`` registers (method, param) for call-argument flow."""
+        params = set(_param_names(fn))
+
+        def sink(attr, value):
+            if isinstance(value, ast.Name) and value.id == "self":
+                self.elem_refs.setdefault(attr, []).append(
+                    ("selfcls", None))
+                return
+            if isinstance(value, ast.Name) and value.id in params:
+                self.elem_sinks.setdefault(attr, []).append(
+                    (method, value.id))
+                return
+            for ref in _value_refs(value, set(), self):
+                self.elem_refs.setdefault(attr, []).append(ref)
+            # local `x = Ctor()` bindings count too (the event loop's
+            # `conn = _Conn(sock); self._peers[rank] = conn` shape)
+            if isinstance(value, ast.Name) \
+                    and value.id in self._ctor_locals(fn):
+                for name in self._ctor_locals(fn)[value.id]:
+                    self.elem_refs.setdefault(attr, []).append(
+                        ("class", name))
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("append", "add", "appendleft"):
+                attr = _self_attr(node.func.value)
+                if attr in self.containers and node.args:
+                    sink(attr, node.args[0])
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        attr = _self_attr(tgt.value)
+                        if attr in self.containers:
+                            sink(attr, node.value)
+
+    def _ctor_locals(self, fn):
+        out = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call) \
+                    and isinstance(node.value.func, ast.Name):
+                out.setdefault(node.targets[0].id,
+                               set()).add(node.value.func.id)
+        return out
+
+    def _container_aliases(self, fn):
+        """Local names carrying a container field's ELEMENTS: loop
+        variables over the container (raw / list() / sorted() /
+        .values()) and ``.get``/subscript reads."""
+        out = {}
+
+        def container_of(expr):
+            attr = _self_attr(expr)
+            if attr in self.containers:
+                return attr
+            if isinstance(expr, ast.Call):
+                if isinstance(expr.func, ast.Name) \
+                        and expr.func.id in ("list", "sorted", "tuple") \
+                        and expr.args:
+                    return container_of(expr.args[0])
+                if isinstance(expr.func, ast.Attribute) \
+                        and expr.func.attr in ("values", "get"):
+                    return container_of(expr.func.value)
+            if isinstance(expr, ast.Subscript):
+                return container_of(expr.value)
+            return None
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.For) \
+                    and isinstance(node.target, ast.Name):
+                attr = container_of(node.iter)
+                if attr is not None:
+                    out[node.target.id] = attr
+            elif isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, (ast.Call, ast.Subscript)):
+                attr = container_of(node.value)
+                if attr is not None:
+                    out[node.targets[0].id] = attr
+        return out
 
     def state_sites(self):
         return {s for (k, s) in self.families.values() if k == "state"}
@@ -236,6 +380,13 @@ class _ClassInfo:
         if isinstance(f, ast.Name):
             if f.id in BLOCKING_NAMES:
                 out.append(_Op("block", f.id, held, node))
+            elif f.id in self._elem_aliases:
+                # direct call of a container ELEMENT (`handler(msg)`
+                # where handler came off the handler dict): resolves
+                # through the container's element types
+                out.append(_Op("call",
+                               ("elem", self._elem_aliases[f.id], None),
+                               held, node))
             return
         if not isinstance(f, ast.Attribute):
             return
@@ -246,16 +397,45 @@ class _ClassInfo:
             # self.m(...): own/inherited method (resolved later via MRO)
             # or a callable field (MethodRef-typed) invoked directly
             out.append(_Op("call", ("self", sattr, None), held, node))
+            self._record_call_args(("self", sattr, None), node)
             return
         if isinstance(f.value, ast.Call) \
                 and isinstance(f.value.func, ast.Name) \
                 and f.value.func.id == "super":
             out.append(_Op("call", ("super", f.attr, None), held, node))
             return
+        if isinstance(f.value, ast.Name) \
+                and f.value.id in self._elem_aliases:
+            # method on a container element (`obs.receive_message(...)`
+            # with obs iterating the _observers list)
+            out.append(_Op("call",
+                           ("elem", self._elem_aliases[f.value.id],
+                            f.attr), held, node))
+            return
         fattr = _self_attr(f.value)
         if fattr is not None and fattr not in self.families:
             # self.field.m(...): resolved through the field's types
             out.append(_Op("call", ("field", fattr, f.attr), held, node))
+            self._record_call_args(("field", fattr, f.attr), node)
+
+    def _arg_ref(self, value):
+        """Resolvable method-call argument: the element-flow seeds."""
+        if isinstance(value, ast.Name) and value.id == "self":
+            return [("selfcls", None)]
+        attr = _self_attr(value)
+        if attr is not None and attr in self.methods:
+            return [("method", attr)]
+        if isinstance(value, ast.Call) \
+                and isinstance(value.func, ast.Name):
+            return [("class", value.func.id)]
+        return []
+
+    def _record_call_args(self, data, node):
+        argrefs = [self._arg_ref(a) for a in node.args]
+        kwrefs = {kw.arg: self._arg_ref(kw.value)
+                  for kw in node.keywords if kw.arg}
+        if any(argrefs) or any(kwrefs.values()):
+            self.call_args.append((data, argrefs, kwrefs))
 
 
 def _base_name(node):
@@ -319,9 +499,11 @@ class CrossClassIndex:
     def __init__(self):
         self.modules = {}       # dotted module -> {"imports", "classes"}
         self._flows = {}        # (module, class, param) -> set of targets
+        self._elem_flows = {}   # (class key, container attr) -> targets
         self._finalized = False
         self._method_cache = {}  # (class key, name) -> (owner, fn)
         self._field_cache = {}   # (class key, attr) -> target set
+        self._elem_cache = {}    # (class key, attr) -> element target set
 
     @staticmethod
     def module_name(path):
@@ -349,6 +531,7 @@ class CrossClassIndex:
         self._finalized = False
         self._method_cache.clear()
         self._field_cache.clear()
+        self._elem_cache.clear()
 
     # -- name resolution ---------------------------------------------------
     def _candidates(self, src_mod):
@@ -439,6 +622,120 @@ class CrossClassIndex:
                     changed = True
             if not changed:
                 break
+        self._compute_elem_flows()
+
+    # -- container-element flow (pass 1.75) --------------------------------
+    def _resolve_ref(self, cls, ref):
+        kind, val = ref[0], ref[1]
+        if kind == "selfcls":
+            return ("cls", cls.key)
+        if kind == "method":
+            return ("mref", cls.key, val)
+        if kind == "class":
+            tcls = self.resolve_class(cls.module, val)
+            return ("cls", tcls.key) if tcls is not None else None
+        return None
+
+    def _call_arg_targets(self, cls, data):
+        """(search class, method name) candidates for one recorded
+        call-args descriptor."""
+        kind, a, b = data
+        if kind == "self":
+            owner, fn = self.find_method(cls, a)
+            return [(cls, a)] if owner is not None else []
+        if kind == "field":
+            out = []
+            for ref in self.field_types(cls, a):
+                if ref[0] == "cls":
+                    tcls = self.class_by_key(ref[1])
+                    if tcls is not None:
+                        out.append((tcls, b))
+            return out
+        return []
+
+    def _compute_elem_flows(self):
+        """Flow resolvable method-call arguments into the container
+        sinks of the called methods: ``self.com_manager.add_observer(
+        self)`` lands the manager class on the transport's
+        ``_observers``; ``self.register_message_receive_handler(T,
+        self._on_x)`` lands the handler mref on the handler dict.
+        ``__init__``-parameter sinks reuse the constructor-argument
+        flows. Fixpoint because a flow can unlock a field resolution."""
+        self._elem_flows = {}
+        for cls in self.all_classes():
+            for attr, sinks in cls.elem_sinks.items():
+                for (m, p) in sinks:
+                    if m != "__init__":
+                        continue
+                    for t in self._flows.get((cls.key, p), ()):
+                        self._elem_flows.setdefault(
+                            (cls.key, attr), set()).add(t)
+        for _ in range(4):  # observer/handler chains are depth 1-2
+            changed = False
+            for cls in self.all_classes():
+                for (data, argrefs, kwrefs) in cls.call_args:
+                    for (search, mname) in self._call_arg_targets(cls,
+                                                                  data):
+                        owner, fn = self.find_method(search, mname)
+                        if owner is None or not owner.elem_sinks:
+                            continue
+                        sinkmap = {}
+                        for attr, sinks in owner.elem_sinks.items():
+                            for (m, p) in sinks:
+                                if m == mname:
+                                    sinkmap.setdefault(p, set()).add(attr)
+                        if not sinkmap:
+                            continue
+                        params = [p for p in _param_names(fn)
+                                  if p != "self"]
+                        bound = list(zip(params, argrefs))
+                        bound += [(k, v) for k, v in kwrefs.items()
+                                  if k in params]
+                        for pname, refs in bound:
+                            attrs = sinkmap.get(pname)
+                            if not attrs or not refs:
+                                continue
+                            for ref in refs:
+                                t = self._resolve_ref(cls, ref)
+                                if t is None:
+                                    continue
+                                for attr in attrs:
+                                    cur = self._elem_flows.setdefault(
+                                        (owner.key, attr), set())
+                                    if t not in cur:
+                                        cur.add(t)
+                                        changed = True
+            if not changed:
+                break
+        self._elem_cache = {}
+
+    def container_elem_types(self, cls, attr):
+        """Element types of container field ``self.attr`` along the MRO:
+        direct refs + flowed method-argument refs, same target grammar
+        as :meth:`field_types`."""
+        self.finalize()
+        key = (cls.key, attr)
+        if key in self._elem_cache:
+            return self._elem_cache[key]
+        out = set()
+        cur, seen = cls, set()
+        while cur is not None and cur.key not in seen:
+            seen.add(cur.key)
+            for ref in cur.elem_refs.get(attr, ()):
+                t = self._resolve_ref(cur, ref)
+                if t is not None:
+                    out.add(t)
+            out |= self._elem_flows.get((cur.key, attr), set())
+            nxt = None
+            for base in cur.bases:
+                if base is None:
+                    continue
+                nxt = self.resolve_class(cur.module, base)
+                if nxt is not None:
+                    break
+            cur = nxt
+        self._elem_cache[key] = out
+        return out
 
     def _scan_instantiations(self, mod, tree, super_edges):
         # enclosing-context walk: track current class + function so `self`
@@ -615,11 +912,20 @@ class _Checker:
             return [(owner, a)] if owner is not None else []
         if kind == "field":
             return self._field_targets(cls, a, b)
+        if kind == "elem":
+            # call on (or of) a container ELEMENT: the observer fan-outs
+            # and the handler-dict dispatch
+            return self._refs_targets(
+                self.index.container_elem_types(cls, a), b)
         return []
 
     def _field_targets(self, cls, attr, method):
+        return self._refs_targets(self.index.field_types(cls, attr),
+                                  method)
+
+    def _refs_targets(self, refs, method):
         out = []
-        for ref in self.index.field_types(cls, attr):
+        for ref in refs:
             if ref[0] == "cls":
                 tcls = self.index.class_by_key(ref[1])
                 if tcls is None:
@@ -629,7 +935,7 @@ class _Checker:
                 if owner is not None:
                     out.append((owner, name))
             elif ref[0] == "mref" and method is None:
-                # direct call of a bound-method-typed field
+                # direct call of a bound-method-typed value
                 tcls = self.index.class_by_key(ref[1])
                 if tcls is not None:
                     owner, fn = self.index.find_method(tcls, ref[2])
@@ -785,6 +1091,9 @@ def _describe_target(data):
         return f"`self.{a}()`"
     if kind == "super":
         return f"`super().{a}()`"
+    if kind == "elem":
+        return (f"`.{b}()` on an element of `self.{a}`" if b is not None
+                else f"an element of `self.{a}` (called directly)")
     return f"`self.{a}.{b}()`"
 
 

@@ -197,6 +197,16 @@ RULES = {
         "silently; a set key no counterpart handler reads is dead "
         "bytes in every wire frame. Renamed keys produce both findings "
         "as a pair."),
+    "FL129": (
+        "blocking call inside an event-loop callback or coroutine",
+        "a method registered as selector/asyncio callback data (or any "
+        "coroutine) reaches a blocking call (sendall, bare recv, join, "
+        "sleep, send_with_retry, a transport send): the loop thread "
+        "serves every multiplexed connection, so one blocked callback "
+        "stalls the whole transport -- FL125's hazard without a lock in "
+        "sight. Use non-blocking ops on ready fds (recv_into/send) or "
+        "queue the work to the dispatcher thread "
+        "(fedml_tpu/net/eventloop.py is the reference shape)."),
 }
 
 #: SARIF rule metadata: which analysis pass owns each rule (rendered as
@@ -207,6 +217,7 @@ RULE_PASS = {
     "FL128": "fedcheck-protocol",
     "FL123": "fedcheck-concurrency", "FL124": "fedcheck-concurrency",
     "FL125": "fedcheck-concurrency", "FL126": "fedcheck-concurrency",
+    "FL129": "fedcheck-concurrency",
 }
 
 
@@ -1300,8 +1311,10 @@ def _lint_module(path, src, tree, index, select=None, ignore=None):
     per_line, per_file = _parse_suppressions(src)
     linter = _ModuleLinter(path, src, tree)
     linter.run()
-    from fedml_tpu.analysis.concurrency import check_concurrency
+    from fedml_tpu.analysis.concurrency import (check_concurrency,
+                                                check_eventloop)
     check_concurrency(tree, linter.add)
+    check_eventloop(tree, linter.add)
     if index is not None:
         from fedml_tpu.analysis.dataflow import (ProjectIndex,
                                                  check_use_after_donate)

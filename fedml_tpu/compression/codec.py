@@ -78,7 +78,21 @@ def array_wire_nbytes(shape, dtype) -> int:
     return 1 + len(name) + 1 + _DIM.size * len(shape) + _DIM.size + payload
 
 
-def encode_array(x) -> bytes:
+def encode_array_views(x) -> list:
+    """Zero-copy array frame as ``[header_bytes, payload_buffer]``.
+
+    The payload buffer is a read-only ``memoryview`` over the array's own
+    memory whenever the in-memory layout already matches the wire
+    (C-contiguous, little-endian, non-bool) -- the hot encode path never
+    copies the tensor bytes; transports with scatter-gather writes (the
+    event-loop write queue, ``fedml_tpu.net.eventloop``) send the views
+    directly and :func:`encode_tree` joins them exactly once. Layouts the
+    wire cannot alias (bool bit-packing, byte-swaps, non-contiguous
+    inputs) degrade to the inherent one conversion copy. NOTE: a view
+    aliases the caller's array until the bytes are written -- senders must
+    not mutate a payload between enqueue and flush (the FSMs build fresh
+    report/sync payloads per send, so this holds by construction).
+    """
     a = _as_host_array(x)
     # wire is little-endian: swap explicit-BE arrays, and native arrays
     # when the host itself is big-endian
@@ -88,14 +102,21 @@ def encode_array(x) -> bytes:
         a = a.byteswap().view(a.dtype.newbyteorder("<"))
     name = a.dtype.name.encode("ascii")
     if a.dtype == np.bool_:
-        payload = np.packbits(a.reshape(-1)).tobytes()
+        payload = np.packbits(a.reshape(-1)).data.cast("B")
     else:
-        payload = a.tobytes()
+        try:
+            payload = a.data.cast("B")  # zero-copy: aliases the array
+        except (ValueError, TypeError, BufferError):
+            payload = a.tobytes()  # exotic layout: pure-Python fallback
     parts = [struct.pack("!B", len(name)), name,
              struct.pack("!B", a.ndim)]
     parts += [_DIM.pack(d) for d in a.shape]
-    parts += [_DIM.pack(len(payload)), payload]
-    return b"".join(parts)
+    parts.append(_DIM.pack(len(payload)))
+    return [b"".join(parts), payload]
+
+
+def encode_array(x) -> bytes:
+    return b"".join(encode_array_views(x))
 
 
 def decode_array(buf: bytes, offset: int = 0):
@@ -174,13 +195,26 @@ def _restore(value, arrays: list):
     return value
 
 
-def encode_tree(tree) -> bytes:
-    """Pytree (nested dict/list/tuple of arrays + scalars) -> wire bytes."""
+def encode_tree_views(tree) -> list:
+    """Pytree -> list of wire buffers (bytes/memoryviews) whose
+    concatenation is exactly :func:`encode_tree`'s output. Array payloads
+    stay zero-copy views over the caller's arrays (see
+    :func:`encode_array_views`); a vectored-write transport sends the
+    list as-is and skips frame assembly entirely."""
     arrays: list = []
     header = json.dumps(_extract(tree, arrays)).encode()
-    parts = [bytes((MAGIC, VERSION)), _HDR_LEN.pack(len(header)), header]
-    parts += [encode_array(a) for a in arrays]
-    return b"".join(parts)
+    views = [bytes((MAGIC, VERSION)) + _HDR_LEN.pack(len(header)) + header]
+    for a in arrays:
+        views.extend(encode_array_views(a))
+    return views
+
+
+def encode_tree(tree) -> bytes:
+    """Pytree (nested dict/list/tuple of arrays + scalars) -> wire bytes.
+    One join over the zero-copy views: each tensor's bytes are copied
+    exactly once, into the final frame (the old per-array ``tobytes`` +
+    per-frame join copied every payload twice)."""
+    return b"".join(encode_tree_views(tree))
 
 
 def decode_tree(data: bytes):
@@ -236,6 +270,12 @@ def message_to_wire(msg) -> bytes:
     return encode_tree(msg.get_params())
 
 
+def message_to_wire_views(msg) -> list:
+    """``Message`` -> list of wire buffers (zero-copy array payloads);
+    ``b"".join(...)`` of the list equals :func:`message_to_wire`."""
+    return encode_tree_views(msg.get_params())
+
+
 def message_from_wire(data: bytes):
     """Binary OR legacy-JSON frame -> ``Message`` (first-byte sniff: 0x9E
     is the binary magic and cannot start a JSON document)."""
@@ -253,6 +293,8 @@ def message_from_wire(data: bytes):
     return msg
 
 
-__all__ = ["MAGIC", "VERSION", "encode_array", "decode_array",
-           "encode_tree", "decode_tree", "array_wire_nbytes",
-           "tree_wire_nbytes", "message_to_wire", "message_from_wire"]
+__all__ = ["MAGIC", "VERSION", "encode_array", "encode_array_views",
+           "decode_array", "encode_tree", "encode_tree_views",
+           "decode_tree", "array_wire_nbytes", "tree_wire_nbytes",
+           "message_to_wire", "message_to_wire_views",
+           "message_from_wire"]

@@ -1,0 +1,45 @@
+"""fedml_tpu.net: the massive-connection control plane.
+
+The threaded TCP transport (``core/comm/tcp.py``) spends one serve thread
+and two locks per connection -- honest at 8 ranks, dead at 10k (the
+thread stacks alone are gigabytes, and the scheduler thrashes long before
+that). This package is the Bonawitz (MLSys'19 S3) control plane at its
+intended scale:
+
+- :mod:`fedml_tpu.net.eventloop` -- a single-threaded selector event-loop
+  transport implementing the same ``BaseCommunicationManager`` contract
+  (same star topology, HELLO/GOODBYE/STOP frames, PEER_LOST synthesis,
+  ``abort()``, wire metrics) with connection multiplexing, per-connection
+  write-queue backpressure (high/low watermarks; slow peers are shed into
+  the resilience layer's PEER_LOST path) and zero-copy frame assembly
+  over the binary codec's buffer views.
+- :mod:`fedml_tpu.net.fanin` -- a hierarchical fan-in tier: edge
+  aggregators each own a leaf star and forward one pre-aggregated report
+  upstream, so the coordinator's :class:`~fedml_tpu.resilience.async_agg.
+  BufferedAggregator` folds E edge reports instead of holding N client
+  sockets -- the distributed analog of ``algorithms/hierarchical.py``'s
+  two-tier averaging (the round-robin grouping rule is shared).
+- :mod:`fedml_tpu.net.soak` -- the many-connection soak harness: one
+  client-side event loop drives thousands of protocol-complete swarm
+  clients (HELLO -> SYNC -> train -> REPORT) from a subprocess, against a
+  real async server in the parent. Evidence = ``status.json`` +
+  ``fed_report_latency_seconds`` tails (docs/NETWORKING.md).
+
+The existing FSMs (``ResilientFedAvgServer``, ``AsyncBufferedFedAvgServer``,
+``ResilientFedAvgClient``) run unchanged over either transport, selected
+by the drivers' ``transport=`` parameter (``run_tcp_fedavg`` /
+``run_async_tcp_fedavg`` / ``run_fanin_fedavg``; ``--transport`` is the
+flag form). Deliberately NO transport factory lives here: the drivers
+construct ``TcpCommManager`` / ``EventLoopCommManager`` inline, because
+fedcheck's cross-class pass (FL126) types ``com_manager`` from
+instantiation sites and a factory-returned local is untyped -- routing
+construction through a helper would silently remove the transport from
+every FSM's held-lock chain analysis.
+"""
+
+from __future__ import annotations
+
+#: The ``--transport`` flag's choices on the distributed drivers.
+TRANSPORTS = ("tcp", "eventloop")
+
+__all__ = ["TRANSPORTS"]

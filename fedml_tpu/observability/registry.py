@@ -156,6 +156,29 @@ class MetricsRegistry:
                 return (v.total, v.count)
             return v
 
+    def histogram_quantile(self, name, q, **labels):
+        """Approximate quantile of one histogram series from its bucket
+        counts: the upper edge of the first bucket whose cumulative count
+        reaches ``q * count`` (Prometheus' ``histogram_quantile`` without
+        interpolation -- conservative, never under-reports a tail).
+        Returns None for a missing/empty series; observations past the
+        last bucket return ``inf`` (the tail escaped the layout)."""
+        if not 0.0 <= float(q) <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            m = self._metrics.get(name)
+            v = (m["series"].get(_label_key(labels))
+                 if m is not None else None)
+            if not isinstance(v, _Hist) or v.count == 0:
+                return None
+            target = float(q) * v.count
+            cum = 0
+            for le, c in zip(v.buckets, v.counts):
+                cum += c
+                if cum >= target:
+                    return float(le)
+            return math.inf
+
     def collect(self):
         """Flat ``{"name{label=v}": value}`` of every scalar series
         (histograms expose ``_sum`` and ``_count``)."""

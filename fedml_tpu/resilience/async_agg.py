@@ -348,6 +348,10 @@ class AsyncBufferedFedAvgServer(ServerManager):
         self._timer_factory = timer_factory
         self._timer = None
         self._last_flush_reason = None
+        self._window_t0 = None       # wall time the current flush window
+        # opened (start / previous flush): the async analog of the sync
+        # server's per-attempt t0, feeding fed_report_latency_seconds so
+        # the straggler-tail evidence is transport- AND paradigm-agnostic
         self._prev_flush_t = None    # wall time of the previous flush
         self._pending_flush_dts = []  # flush-to-flush seconds, unconsumed
         # (a list, drained by _report_health: back-to-back flushes on
@@ -368,6 +372,8 @@ class AsyncBufferedFedAvgServer(ServerManager):
 
     def start(self):
         with self._advance_lock:
+            if get_perf_monitor() is not None:
+                self._window_t0 = time.time()
             syncs = [self._make_sync_locked(r) for r in sorted(self.alive)]
             done = self.total_updates <= 0 or not self.alive
         if done:  # finish() = transport STOP wave, never under the lock
@@ -393,6 +399,16 @@ class AsyncBufferedFedAvgServer(ServerManager):
     # -- handler threads ---------------------------------------------------
     def _on_report(self, msg):
         rank = int(msg.get_sender_id())
+        mon = get_perf_monitor()
+        if mon is not None:
+            with self._advance_lock:  # _window_t0 mutates under the lock
+                t0 = self._window_t0
+            if t0 is not None:
+                # window-open -> report latency: the barrier-free analog
+                # of the sync server's straggler-tail distribution (a
+                # stale report measures against the CURRENT window --
+                # that is its true lateness under flush-time re-sync)
+                mon.observe_report_latency(time.time() - t0)
         syncs, done = [], False
         with self._advance_lock:
             if self.failed is not None \
@@ -494,6 +510,7 @@ class AsyncBufferedFedAvgServer(ServerManager):
             if self._prev_flush_t is not None:
                 self._pending_flush_dts.append(now - self._prev_flush_t)
             self._prev_flush_t = now
+            self._window_t0 = now  # next window's report-latency origin
         res = self.agg.flush(reason)
         self.params = res.params
         self.history.append(dict(res.params))
@@ -560,14 +577,17 @@ def run_async_tcp_fedavg(world_size, total_updates, async_policy,
                          init_params, fault_plan=None, retry_policy=None,
                          trainer=None, metrics_logger=None,
                          host="localhost", port=None, timeout=60.0,
-                         join_timeout=90.0):
+                         join_timeout=90.0, transport="tcp"):
     """Drive a multi-rank TCP buffered-async FedAvg scenario in one
     process (the async analog of ``integration.run_tcp_fedavg``; clients
-    are the unchanged :class:`ResilientFedAvgClient`). Returns the server
-    (``.history``, ``.flush_log``, ``.counters``, ``.failed``)."""
+    are the unchanged :class:`ResilientFedAvgClient`). ``transport``
+    selects the byte layer ("tcp" | "eventloop") with identical FSMs.
+    Returns the server (``.history``, ``.flush_log``, ``.counters``,
+    ``.failed``)."""
     import socket
 
     from fedml_tpu.core.comm.tcp import TcpCommManager
+    from fedml_tpu.net.eventloop import EventLoopCommManager
     from fedml_tpu.resilience.integration import quadratic_trainer
 
     if port is None:
@@ -576,9 +596,17 @@ def run_async_tcp_fedavg(world_size, total_updates, async_policy,
         port = s.getsockname()[1]
         s.close()
     trainer = trainer or quadratic_trainer()
+    # inline construction, not a factory: see run_tcp_fedavg -- fedcheck
+    # FL126 types com_manager from these instantiation sites
+    evloop = transport == "eventloop"
 
     def run_client(rank):
-        comm = TcpCommManager(host, port, rank, world_size, timeout=timeout)
+        if evloop:
+            comm = EventLoopCommManager(host, port, rank, world_size,
+                                        timeout=timeout)
+        else:
+            comm = TcpCommManager(host, port, rank, world_size,
+                                  timeout=timeout)
         if fault_plan is not None:
             comm = fault_plan.wrap(comm, rank)
         fsm = ResilientFedAvgClient(None, comm, rank, world_size, trainer)
@@ -589,8 +617,13 @@ def run_async_tcp_fedavg(world_size, total_updates, async_policy,
                for r in range(1, world_size)]
     for t in threads:
         t.start()
-    comm = TcpCommManager(host, port, 0, world_size, timeout=timeout,
-                          metrics_logger=metrics_logger)
+    if evloop:
+        comm = EventLoopCommManager(host, port, 0, world_size,
+                                    timeout=timeout,
+                                    metrics_logger=metrics_logger)
+    else:
+        comm = TcpCommManager(host, port, 0, world_size, timeout=timeout,
+                              metrics_logger=metrics_logger)
     server = AsyncBufferedFedAvgServer(
         None, comm, world_size, init_params, total_updates, async_policy,
         retry_policy=retry_policy, metrics_logger=metrics_logger)

@@ -67,6 +67,20 @@ def add_resilience_args(parser):
              "(seed, round, attempt, client) -- reproducible chaos for the "
              "vmapped rounds")
     parser.add_argument(
+        "--transport", type=str, default="tcp",
+        choices=("tcp", "eventloop"),
+        help="distributed control-plane transport: 'tcp' = the thread-"
+             "per-client hub (core/comm/tcp.py, honest at tens of "
+             "ranks), 'eventloop' = the single-threaded selector event "
+             "loop (fedml_tpu.net.eventloop: connection multiplexing, "
+             "write-queue backpressure with slow-peer shedding -- the "
+             "10k-connection path). Same FSMs, same wire schema. On "
+             "these mains the flag is configuration only (their rounds "
+             "are simulated; no transport is opened) -- pass the value "
+             "through to the distributed drivers' transport= parameter "
+             "(run_tcp_fedavg / run_async_tcp_fedavg / run_fanin_fedavg)"
+             " when driving a real multi-rank run")
+    parser.add_argument(
         "--race_audit", type=int, default=0,
         help="arm the concurrency race sanitizer "
              "(fedml_tpu.analysis.runtime.race_audit): control-plane "
@@ -603,17 +617,21 @@ def run_tcp_fedavg(world_size, rounds, round_policy, init_params,
                    fault_plan=None, retry_policy=None, cohort_target=None,
                    cohort_override=None, trainer=None, recovery=None,
                    metrics_logger=None, host="localhost", port=None,
-                   timeout=60.0, join_timeout=90.0):
+                   timeout=60.0, join_timeout=90.0, transport="tcp"):
     """Drive a full multi-rank TCP FedAvg scenario in one process.
 
     Clients run in daemon threads (rank r wrapped by ``fault_plan`` when
     given); the server FSM runs its receive loop on the caller thread.
-    Returns the server (``.history``, ``.reporting_log``, ``.counters``,
-    ``.failed``). Used by the ci.sh chaos smoke and test_resilience.py.
+    ``transport`` selects the byte layer (``--transport``: "tcp" =
+    thread-per-client hub, "eventloop" = selector loop) -- the FSMs are
+    identical either way. Returns the server (``.history``,
+    ``.reporting_log``, ``.counters``, ``.failed``). Used by the ci.sh
+    chaos smokes and test_resilience.py / test_net.py.
     """
     import socket
 
     from fedml_tpu.core.comm.tcp import TcpCommManager
+    from fedml_tpu.net.eventloop import EventLoopCommManager
 
     if port is None:
         s = socket.socket()
@@ -621,9 +639,20 @@ def run_tcp_fedavg(world_size, rounds, round_policy, init_params,
         port = s.getsockname()[1]
         s.close()
     trainer = trainer or quadratic_trainer()
+    # transport construction stays INLINE (no factory indirection):
+    # fedcheck's cross-class pass (FL126) types `com_manager` from
+    # constructor-argument flow at instantiation sites, and a
+    # factory-returned local is untyped -- these bindings are what keep
+    # BOTH transports inside every FSM's held-lock chain analysis
+    evloop = transport == "eventloop"
 
     def run_client(rank):
-        comm = TcpCommManager(host, port, rank, world_size, timeout=timeout)
+        if evloop:
+            comm = EventLoopCommManager(host, port, rank, world_size,
+                                        timeout=timeout)
+        else:
+            comm = TcpCommManager(host, port, rank, world_size,
+                                  timeout=timeout)
         if fault_plan is not None:
             comm = fault_plan.wrap(comm, rank)
         fsm = ResilientFedAvgClient(None, comm, rank, world_size, trainer)
@@ -634,8 +663,13 @@ def run_tcp_fedavg(world_size, rounds, round_policy, init_params,
                for r in range(1, world_size)]
     for t in threads:
         t.start()
-    comm = TcpCommManager(host, port, 0, world_size, timeout=timeout,
-                          metrics_logger=metrics_logger)
+    if evloop:
+        comm = EventLoopCommManager(host, port, 0, world_size,
+                                    timeout=timeout,
+                                    metrics_logger=metrics_logger)
+    else:
+        comm = TcpCommManager(host, port, 0, world_size, timeout=timeout,
+                              metrics_logger=metrics_logger)
     server = ResilientFedAvgServer(
         None, comm, world_size, init_params, rounds, round_policy,
         retry_policy=retry_policy, cohort_target=cohort_target,

@@ -200,6 +200,100 @@ print("chaos smoke: degraded completion + exact subset average + clean "
        "race_acquisitions": race["race/acquisitions"], **srv.counters})
 EOF
 
+echo "== event-loop transport chaos smoke (fedml_tpu.net): the SAME"
+echo "   kill+stall scenario over --transport eventloop under the"
+echo "   --race_audit sanitizer -- must complete DEGRADED with ZERO"
+echo "   lock-order cycles and ZERO held-while-blocking events, the"
+echo "   final model must equal the reporting-subset weighted average"
+echo "   exactly, small-rank trajectories must be BITWISE-equal to the"
+echo "   threaded-tcp transport under oracle settings, client spans"
+echo "   must stitch under server round spans THROUGH the new"
+echo "   transport, and the kill's flight-recorder dump + the"
+echo "   comm_bytes_total{transport=eventloop} series must exist."
+echo "   fedlint/fedcheck (incl. the new FL129 event-loop readiness"
+echo "   rule and container-element FL126 chains) must stay at zero"
+echo "   findings on fedml_tpu/net/ =="
+python -m fedml_tpu.analysis fedml_tpu/net/ > /dev/null \
+    && echo "fedlint on net/: 0 findings"
+timeout -k 10 180 python - <<'EOF'
+import json, tempfile
+import numpy as np
+from fedml_tpu.analysis.runtime import race_audit
+from fedml_tpu.observability import enable
+from fedml_tpu.resilience import (FaultPlan, FaultRule, RoundPolicy,
+                                  run_tcp_fedavg)
+
+w0 = {"w": np.zeros((4, 4), np.float32), "b": np.ones(4, np.float32)}
+plan = FaultPlan(seed=7, rules=(
+    FaultRule("kill", rank=3, msg_type="res_report", nth=2),
+    FaultRule("stall", rank=2, msg_type="res_report", nth=1, delay_s=4.0),
+))
+d = tempfile.mkdtemp(prefix="evloop_smoke_")
+with enable(trace=True, trace_dir=d, flightrec=True, flightrec_dir=d,
+            compile_events=False) as obs:
+    with race_audit() as ra:
+        srv = run_tcp_fedavg(4, 3, RoundPolicy(deadline_s=1.0, quorum=0.3),
+                             w0, fault_plan=plan, join_timeout=90,
+                             transport="eventloop")
+    spans = obs.tracer.finished_spans()
+assert srv.failed is None and len(srv.history) == 3, (
+    srv.failed, len(srv.history))
+assert srv.counters["rounds_degraded"] >= 1, srv.counters
+race = ra.report()
+assert race["race/locks_created"] > 0, race
+assert race["race/lock_order_cycles"] == [], race
+assert race["race/held_while_blocking"] == [], race
+
+# cross-rank stitching works through the event loop (same __trace__)
+rounds = {s.span_id: s for s in spans if s.name == "round"}
+lts = [s for s in spans if s.name == "local-train"]
+assert lts and all(s.parent_id in rounds and
+                   s.trace_id == rounds[s.parent_id].trace_id
+                   for s in lts), "span stitching broken over eventloop"
+
+# the kill's dump exists and its PEER_LOST names the new transport
+kill = []
+for p in obs.recorder.dumps:
+    events = [json.loads(l) for l in open(p)]
+    info = [e for e in events if e["kind"] == "dump_info"]
+    if info and info[-1].get("peer") == 3:
+        kill.append(events)
+assert len(kill) == 1, obs.recorder.dumps
+assert any(e["kind"] == "peer_lost" and e.get("peer") == 3
+           and e.get("transport") == "eventloop" for e in kill[0])
+sent = obs.registry.get("comm_bytes_total", transport="eventloop",
+                        direction="sent")
+assert sent and sent > 0
+
+# degraded-round exactness (A/B over the same reporting subsets)
+ref = run_tcp_fedavg(4, 3, RoundPolicy(deadline_s=10.0, quorum=0.3), w0,
+                     cohort_override=lambda r, a: srv.reporting_log[r],
+                     join_timeout=90, transport="eventloop")
+for got, want in zip(srv.history, ref.history):
+    for k in got:
+        assert (got[k] == want[k]).all(), k
+
+# small-rank bitwise transport A/B: same FSMs, same trajectory, both
+# paradigms (oracle settings: no faults / unbounded buffer, decay 0)
+from fedml_tpu.resilience.async_agg import AsyncAggPolicy, run_async_tcp_fedavg
+a = run_tcp_fedavg(4, 2, RoundPolicy(), w0, transport="tcp", join_timeout=60)
+b = run_tcp_fedavg(4, 2, RoundPolicy(), w0, transport="eventloop",
+                   join_timeout=60)
+pol = AsyncAggPolicy(buffer_k=10 ** 9, staleness_decay=0.0)
+c = run_async_tcp_fedavg(4, 2, pol, w0, transport="tcp", join_timeout=60)
+e = run_async_tcp_fedavg(4, 2, pol, w0, transport="eventloop",
+                         join_timeout=60)
+for x, y in ((a, b), (c, e)):
+    assert x.failed is None and y.failed is None
+    for gx, gy in zip(x.history, y.history):
+        for k in gx:
+            assert (gx[k] == gy[k]).all(), ("transport A/B bitwise", k)
+print("eventloop chaos smoke: degraded + exact subset average + clean "
+      "race audit + stitched spans + eventloop PEER_LOST dump + "
+      "sync/async tcp-vs-eventloop bitwise A/B OK",
+      {"reporting": srv.reporting_log, **srv.counters})
+EOF
+
 echo "== massive-cohort smoke (bucketed ragged streaming + buffered async"
 echo "   aggregation): one chip runs 2 rounds of 50,000 ragged simulated"
 echo "   clients (honest per-client n_i weighting); the async path under"
@@ -297,8 +391,31 @@ print("bench --massive_cohort:", rec["value"], "clients/sec,",
       rec["bucket_waste_frac"], "/ flop waste", rec["flops_waste_frac"])
 EOF
 
+echo "== event-loop soak smoke (bench.py --soak): 1,000 swarm"
+echo "   connections through a real buffered-async server over the"
+echo "   selector transport, 3 async windows -- the record (reports/sec"
+echo "   headline + fed_report_latency_seconds p50/p90/p99 tail) feeds"
+echo "   the same throwaway perf-regression ledger. The 10k headline"
+echo "   soak is the slow-marked tests/test_net.py::TestSoak::"
+echo "   test_soak_10k (evidence in docs/NETWORKING.md) =="
+timeout -k 10 300 python bench.py --soak 1000 --ledger "$CI_LEDGER" \
+    > bench_results/bench_soak_smoke.json
+python - <<'EOF'
+import json
+with open("bench_results/bench_soak_smoke.json") as f:
+    rec = json.loads(f.readline())
+assert rec["unit"] == "reports/sec" and rec["value"] > 0, rec
+assert rec["connections"] == 1000 and rec["updates"] == 3, rec
+assert rec["status_outcome"] == "complete", rec
+assert rec["report_latency_p99_s"] is not None, rec
+print("bench --soak:", rec["value"], "reports/sec over",
+      rec["connections"], "connections;",
+      "p50/p99 report latency", rec["report_latency_p50_s"], "/",
+      rec["report_latency_p99_s"], "s")
+EOF
+
 echo "== perf-regression ledger gate (bench.py --check-regress, both"
-echo "   ways): the massive smoke's record seeded a throwaway ledger --"
+echo "   ways): the massive + soak smokes seeded a throwaway ledger --"
 echo "   the gate must pass GREEN on it (fresh: no same-metric"
 echo "   predecessor), then fail RED after a fixture record with an"
 echo "   injected 2x slowdown is appended =="

@@ -1930,7 +1930,9 @@ class TestCrossClass:
                "calls `self.finish()`" in msg
         # the cited identity is _advance_lock's creation site -- the
         # same string race_audit()/the flight recorder would report
-        assert "integration.py:307" in msg
+        # (line shifts when integration.py grows above __init__; PR 11
+        # moved it 307 -> 321 adding the --transport flag)
+        assert "integration.py:321" in msg
         assert "_send_frame" in msg and "TcpCommManager" in msg
 
 
@@ -2508,3 +2510,276 @@ class TestReviewHardening:
         assert fedlint_main([str(mod), "--fix",
                              "--max-seconds", "300"]) == 0
         capsys.readouterr()
+
+
+class TestEventLoopReadiness:
+    """FL129: blocking calls reachable from event-loop callbacks (the
+    single-thread analog of FL125) -- shipped AHEAD of the transport it
+    guards (fedml_tpu/net/eventloop.py), per docs/ANALYSIS.md's former
+    'Future rules' entry."""
+
+    def test_blocking_in_registered_callback_and_closure(self):
+        # sleep in the registered callback itself AND sendall one
+        # self-call deep: both flagged (closure, not just roots). The
+        # callback rides selector-style tuple data.
+        src = (
+            "import selectors, time\n"
+            "class Loop:\n"
+            "    def __init__(self):\n"
+            "        self._sel = selectors.DefaultSelector()\n"
+            "        self._sel.register(0, selectors.EVENT_READ,\n"
+            "                           (self._on_read, None))\n"
+            "    def _on_read(self, conn, mask):\n"
+            "        time.sleep(0.1)\n"
+            "        self._drain(conn)\n"
+            "    def _drain(self, conn):\n"
+            "        conn.sock.sendall(b'x')\n")
+        assert codes(src) == ["FL129", "FL129"]
+
+    def test_nonblocking_loop_shape_passes(self):
+        # recv_into/accept/send on ready fds ARE the loop's correct
+        # form; a dispatcher-thread method (not registered) may block.
+        src = (
+            "import selectors, time\n"
+            "class Loop:\n"
+            "    def __init__(self):\n"
+            "        self._sel = selectors.DefaultSelector()\n"
+            "        self._sel.register(0, selectors.EVENT_READ,\n"
+            "                           self._on_read)\n"
+            "    def _on_read(self, conn, mask):\n"
+            "        conn.sock.recv_into(conn.buf)\n"
+            "        conn.sock.send(b'x')\n"
+            "    def handle_receive_message(self):\n"
+            "        time.sleep(1)\n")
+        assert codes(src) == []
+
+    def test_unregistered_class_out_of_scope(self):
+        # no selector registration, no coroutine: plain threaded code
+        # blocking freely is FL125's business (when locks are held),
+        # never FL129's
+        src = (
+            "import time\n"
+            "class Worker:\n"
+            "    def run(self):\n"
+            "        time.sleep(1)\n"
+            "        self.sock.sendall(b'x')\n")
+        assert codes(src) == []
+
+    def test_coroutine_blocking_flagged(self):
+        # module-level coroutine: time.sleep instead of asyncio.sleep
+        src = (
+            "import time\n"
+            "async def pump(q):\n"
+            "    time.sleep(1)\n")
+        assert codes(src) == ["FL129"]
+        # async method on a class: rooted without any registration
+        src = (
+            "import time\n"
+            "class S:\n"
+            "    async def pump(self):\n"
+            "        self._step()\n"
+            "    def _step(self):\n"
+            "        time.sleep(1)\n")
+        assert codes(src) == ["FL129"]
+        # blocking DIRECTLY in an async method: exactly ONE finding --
+        # the class checker owns it; the free-coroutine branch must not
+        # double-report class-nested AsyncFunctionDefs (review finding)
+        src = (
+            "import time\n"
+            "class S:\n"
+            "    async def pump(self):\n"
+            "        time.sleep(1)\n")
+        assert codes(src) == ["FL129"]
+
+    def test_asyncio_scheduler_args_root(self):
+        src = (
+            "class S:\n"
+            "    def arm(self, loop):\n"
+            "        loop.call_soon(self._tick)\n"
+            "    def _tick(self):\n"
+            "        self.q.join()\n")
+        assert codes(src) == ["FL129"]
+
+    def test_mutation_eventloop_sendall(self):
+        # revert-mutation fixture over the REAL transport: swapping the
+        # loop's non-blocking send for sendall must produce exactly one
+        # FL129; the committed source is clean.
+        path = os.path.join(REPO_ROOT, "fedml_tpu/net/eventloop.py")
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        assert [f for f in lint_source(src, path=path)
+                if f.code == "FL129"] == []
+        good = "                n = conn.sock.send(buf)"
+        assert src.count(good) == 1, "eventloop _flush_conn shape changed"
+        mutated = src.replace(
+            good, "                n = len(buf); conn.sock.sendall(buf)")
+        found = [f for f in lint_source(mutated, path=path)
+                 if f.code == "FL129"]
+        assert len(found) == 1, found
+        assert "sendall" in found[0].message
+        assert "_flush_conn" in found[0].message
+
+
+class TestContainerElementTyping:
+    """Cross-class container-element typing (the former 'Future rules'
+    entry): `_observers`-style lists and handler dicts carry element
+    types, so FL126 walks transport -> manager dispatch -> registered
+    handler chains statically."""
+
+    DRIVER = (
+        "from fedml_tpu.core.locks import audited_lock\n"
+        "class Manager:\n"
+        "    def __init__(self, comm):\n"
+        "        self.com_manager = comm\n"
+        "        self.com_manager.add_observer(self)\n"
+        "        self.handlers = {}\n"
+        "    def register_handler(self, t, fn):\n"
+        "        self.handlers[t] = fn\n"
+        "    def receive_message(self, t, msg):\n"
+        "        handler = self.handlers.get(t)\n"
+        "        handler(msg)\n"
+        "class Fsm(Manager):\n"
+        "    def __init__(self, comm):\n"
+        "        super().__init__(comm)\n"
+        "        self.register_handler('sync', self._on_sync)\n"
+        "    def _on_sync(self, msg):\n"
+        "        self.com_manager.send_message(msg)\n"
+        "class Transport:\n"
+        "    def __init__(self):\n"
+        "        self._lock = audited_lock()\n"
+        "        self._observers = []\n"
+        "    def add_observer(self, obs):\n"
+        "        self._observers.append(obs)\n"
+        "    def send_message(self, msg):\n"
+        "        self._socket.sendall(msg)\n"
+        "    def dispatch(self, msg):\n"
+        "%s"
+        "def driver():\n"
+        "    t = Transport()\n"
+        "    fsm = Fsm(t)\n")
+
+    def test_observer_dispatch_under_lock_flagged(self):
+        # the full statically-walked chain: Transport.dispatch (holding
+        # its state lock) -> element of _observers (Manager, via the
+        # add_observer(self) argument flow) -> receive_message ->
+        # handler-dict element (Fsm._on_sync, via register_handler's
+        # argument flow) -> com_manager.send_message -> blocking sendall
+        src = self.DRIVER % (
+            "        with self._lock:\n"
+            "            for obs in list(self._observers):\n"
+            "                obs.receive_message('sync', msg)\n")
+        found = [f for f in lint_source(src, path=LIB_PATH)
+                 if f.code == "FL126"]
+        assert len(found) == 1, found
+        assert "element of `self._observers`" in found[0].message
+        assert "Transport.dispatch" in found[0].message
+
+    def test_dispatch_outside_lock_clean(self):
+        src = self.DRIVER % (
+            "        with self._lock:\n"
+            "            pending = list(self._observers)\n"
+            "        for obs in pending:\n"
+            "            obs.receive_message('sync', msg)\n")
+        assert [f for f in lint_source(src, path=LIB_PATH)
+                if f.code == "FL126"] == []
+
+    def test_elem_types_resolved(self):
+        # introspection: the index types _observers' elements as the
+        # Manager subclass family and the handler dict's as the bound
+        # handler -- the two hops the docstring promises
+        import ast as ast_mod
+
+        from fedml_tpu.analysis.crossclass import CrossClassIndex
+        src = self.DRIVER % (
+            "        for obs in list(self._observers):\n"
+            "            obs.receive_message('sync', msg)\n")
+        idx = CrossClassIndex()
+        idx.add_module(LIB_PATH, ast_mod.parse(src))
+        mod = CrossClassIndex.module_name(LIB_PATH)
+        transport = idx.modules[mod]["classes"]["Transport"]
+        manager = idx.modules[mod]["classes"]["Manager"]
+        obs_types = idx.container_elem_types(transport, "_observers")
+        assert ("cls", (mod, "Manager")) in obs_types
+        handler_types = idx.container_elem_types(manager, "handlers")
+        assert ("mref", (mod, "Fsm"), "_on_sync") in handler_types
+
+    def test_init_param_sink_reuses_ctor_flow(self):
+        # an __init__ parameter appended into a container resolves
+        # through the existing constructor-argument flows
+        import ast as ast_mod
+
+        from fedml_tpu.analysis.crossclass import CrossClassIndex
+        src = (
+            "class Sink:\n"
+            "    def __init__(self, first):\n"
+            "        self.items = []\n"
+            "        self.items.append(first)\n"
+            "class Payload:\n"
+            "    def go(self):\n"
+            "        pass\n"
+            "def driver():\n"
+            "    s = Sink(Payload())\n")
+        idx = CrossClassIndex()
+        idx.add_module(LIB_PATH, ast_mod.parse(src))
+        mod = CrossClassIndex.module_name(LIB_PATH)
+        sink = idx.modules[mod]["classes"]["Sink"]
+        assert ("cls", (mod, "Payload")) in idx.container_elem_types(
+            sink, "items")
+
+    def _subset_paths(self, tmp_path, eventloop_src):
+        import shutil
+        files = ["fedml_tpu/core/managers.py",
+                 "fedml_tpu/core/comm/base.py",
+                 "fedml_tpu/core/comm/tcp.py",
+                 "fedml_tpu/core/locks.py",
+                 "fedml_tpu/core/message.py",
+                 "fedml_tpu/resilience/policy.py",
+                 "fedml_tpu/resilience/integration.py"]
+        for f in files:
+            dst = tmp_path / f
+            dst.parent.mkdir(parents=True, exist_ok=True)
+            shutil.copy(os.path.join(REPO_ROOT, f), dst)
+        dst = tmp_path / "fedml_tpu/net/eventloop.py"
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text(eventloop_src)
+        return str(tmp_path)
+
+    def test_mutation_eventloop_observer_dispatch_under_lock(self,
+                                                             tmp_path):
+        # THE acceptance fixture for container typing: moving the event
+        # loop's peer-lost observer dispatch under its state lock must
+        # produce exactly one FL126 over the real control-plane sources
+        # -- the chain (transport -> DistributedManager.receive_message
+        # -> registered handler -> send_with_retry) only exists through
+        # container elements. The committed tree is clean.
+        path = os.path.join(REPO_ROOT, "fedml_tpu/net/eventloop.py")
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        fixed = (
+            "        with self._lock:\n"
+            "            if peer_rank in self._lost_notified:\n"
+            "                return\n"
+            "            self._lost_notified.add(peer_rank)\n")
+        assert fixed in src, "eventloop _notify_peer_lost shape changed"
+        clean_root = self._subset_paths(tmp_path, src)
+        assert [f.code for f in lint_paths([clean_root])] == []
+        # revert: run the observer fan-out back under the state lock
+        tail = (
+            "        lost = Message(MSG_TYPE_PEER_LOST, peer_rank, "
+            "self.rank)\n"
+            "        for obs in list(self._observers):\n"
+            "            obs.receive_message(MSG_TYPE_PEER_LOST, lost)\n")
+        assert tail in src, "eventloop _notify_peer_lost tail changed"
+        mutated = src.replace(tail, (
+            "        lost = Message(MSG_TYPE_PEER_LOST, peer_rank, "
+            "self.rank)\n"
+            "        with self._lock:\n"
+            "            for obs in list(self._observers):\n"
+            "                obs.receive_message(MSG_TYPE_PEER_LOST, "
+            "lost)\n"))
+        assert mutated != src
+        found = lint_paths([self._subset_paths(tmp_path, mutated)])
+        assert [f.code for f in found] == ["FL126"], found
+        msg = found[0].message
+        assert "element of `self._observers`" in msg
+        assert "EventLoopCommManager._notify_peer_lost" in msg

@@ -522,3 +522,71 @@ class TestResidualStore:
         assert any(float(np.max(np.abs(r))) > 0
                    for c in cohort1
                    for r in jax.tree.leaves(api._ef_store.peek(c)))
+
+
+class TestZeroCopyViews:
+    """The binary codec's zero-copy encode path (PR 11): buffer views
+    whose concatenation IS the wire frame, with tensor payloads aliasing
+    the source arrays (no copy until -- unless -- a transport joins)."""
+
+    def test_views_join_equals_encode_tree(self):
+        import ml_dtypes
+        from fedml_tpu.compression.codec import (encode_tree,
+                                                 encode_tree_views)
+        rng = np.random.default_rng(0)
+        tree = {
+            "w": rng.standard_normal((17, 9)).astype(np.float32),
+            "h": rng.standard_normal((4, 3)).astype(ml_dtypes.bfloat16),
+            "mask": rng.random(37) > 0.5,          # bit-packed payload
+            "scale": np.float32(0.125),            # numpy scalar -> JSON
+            "zero_d": np.asarray(3.5, np.float64),  # framed 0-d leaf
+            "nested": {"ids": np.arange(11, dtype=np.int32)},
+            "note": "control",
+        }
+        views = encode_tree_views(tree)
+        assert len(views) > 1
+        assert b"".join(views) == encode_tree(tree)
+
+    def test_payload_views_alias_source_arrays(self):
+        # the hot property: a contiguous little-endian array's payload
+        # buffer is a VIEW over the array's own memory, not a copy
+        from fedml_tpu.compression.codec import encode_array_views
+        a = np.arange(24, dtype=np.float32).reshape(4, 6)
+        header, payload = encode_array_views(a)
+        assert isinstance(payload, memoryview)
+        assert np.shares_memory(np.frombuffer(payload, np.float32), a)
+        # bool arrays bit-pack (inherent conversion copy) but still
+        # concatenate to the exact wire bytes
+        from fedml_tpu.compression.codec import encode_array
+        b = np.array([True, False, True] * 5)
+        assert b"".join(bytes(p) for p in
+                        encode_array_views(b)) == encode_array(b)
+
+    def test_message_views_roundtrip(self):
+        from fedml_tpu.compression.codec import (message_from_wire,
+                                                 message_to_wire,
+                                                 message_to_wire_views)
+        from fedml_tpu.core.message import Message
+        msg = Message("res_report", 3, 0)
+        msg.add("params", {"w": np.ones((5, 2), np.float32)})
+        msg.add("num_samples", 30.0)
+        views = message_to_wire_views(msg)
+        wire = b"".join(views)
+        assert wire == message_to_wire(msg)
+        back = message_from_wire(wire)
+        assert back.get_type() == "res_report"
+        assert (back.get("params")["w"] == 1.0).all()
+        assert back.get("num_samples") == 30.0
+
+    def test_noncontiguous_and_bigendian_fall_back_exactly(self):
+        from fedml_tpu.compression.codec import (decode_array,
+                                                 encode_array,
+                                                 encode_array_views)
+        base = np.arange(40, dtype=np.float32).reshape(5, 8)
+        strided = base[:, ::2]                     # non-contiguous
+        be = np.arange(6, dtype=">i4")             # explicit big-endian
+        for a in (strided, be):
+            wire = b"".join(bytes(p) for p in encode_array_views(a))
+            assert wire == encode_array(a)
+            out, _ = decode_array(wire)
+            np.testing.assert_array_equal(out, np.ascontiguousarray(a))

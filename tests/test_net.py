@@ -1,0 +1,517 @@
+"""fedml_tpu.net: event-loop transport, backpressure, fan-in, soak.
+
+The contract under test is drop-in equivalence with the threaded TCP
+transport PLUS the scale behaviors it cannot have: the unchanged FSMs
+produce bitwise-identical trajectories over either transport, slow
+readers are shed through the ordinary PEER_LOST path (and the round
+completes degraded around them), the fan-in tier composes the two-tier
+weighted fold exactly, and one host drives thousands of connections
+(tier-1 smoke here; the 10k headline soak is slow-marked -- evidence in
+docs/NETWORKING.md).
+"""
+
+import json
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fedml_tpu.core.comm.base import MSG_TYPE_PEER_LOST
+from fedml_tpu.core.message import Message
+from fedml_tpu.net.eventloop import EventLoopCommManager
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class Recorder:
+    def __init__(self):
+        self.messages = []
+        self.event = threading.Event()
+
+    def receive_message(self, msg_type, msg):
+        self.messages.append((msg_type, msg.get_sender_id(),
+                              msg.get("payload")))
+        self.event.set()
+
+
+class TestEventLoopTransport:
+    """BaseCommunicationManager parity: the test_comm_tcp scenarios over
+    the selector transport."""
+
+    def test_full_star_protocol(self):
+        port = _free_port()
+        world = 3
+        recorders = {r: Recorder() for r in range(world)}
+        managers = {}
+
+        def client(rank):
+            m = EventLoopCommManager("localhost", port, rank, world,
+                                     timeout=30.0)
+            m.add_observer(recorders[rank])
+            managers[rank] = m
+            msg = Message("client_ready", rank, 0)
+            msg.add("payload", f"hi from {rank}")
+            m.send_message(msg)
+            m.handle_receive_message()
+
+        threads = [threading.Thread(target=client, args=(r,), daemon=True)
+                   for r in (1, 2)]
+        for t in threads:
+            t.start()
+        server = EventLoopCommManager("localhost", port, 0, world,
+                                      timeout=30.0)
+        server.add_observer(recorders[0])
+        st = threading.Thread(target=server.handle_receive_message,
+                              daemon=True)
+        st.start()
+        deadline = time.time() + 20
+        while len(recorders[0].messages) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert sorted(m[1] for m in recorders[0].messages) == [1, 2]
+
+        out = Message("sync_model", 0, 1)
+        out.add("payload", np.arange(4, dtype=np.float32))
+        server.send_message(out)
+        assert recorders[1].event.wait(20)
+        t_, s_, payload = recorders[1].messages[0]
+        assert (t_, s_) == ("sync_model", 0)
+        assert (payload == np.arange(4, dtype=np.float32)).all()
+
+        # client -> client routes through the hub as a raw-frame relay
+        p2p = Message("gossip", 1, 2)
+        p2p.add("payload", "relay")
+        managers[1].send_message(p2p)
+        assert recorders[2].event.wait(20)
+        assert recorders[2].messages[0] == ("gossip", 1, "relay")
+
+        server.stop_receive_message()
+        for t in threads:
+            t.join(timeout=20)
+        st.join(timeout=20)
+        assert not any(t.is_alive() for t in threads)
+        assert not st.is_alive()
+
+    def test_client_death_surfaces_at_server(self):
+        port = _free_port()
+        rec = Recorder()
+
+        def client():
+            m = EventLoopCommManager("localhost", port, 1, 2, timeout=30.0)
+            m.send_message(Message("client_ready", 1, 0))
+            time.sleep(0.2)
+            m.abort()  # crash: no GOODBYE
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        server = EventLoopCommManager("localhost", port, 0, 2,
+                                      timeout=30.0)
+        server.add_observer(rec)
+        st = threading.Thread(target=server.handle_receive_message,
+                              daemon=True)
+        st.start()
+        t.join(timeout=20)
+        deadline = time.time() + 20
+        while len(rec.messages) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert [m[0] for m in rec.messages] == ["client_ready",
+                                                MSG_TYPE_PEER_LOST]
+        assert rec.messages[1][1] == 1
+        with pytest.raises(KeyError, match="no connected peer"):
+            server.send_message(Message("sync_model", 0, 1))
+        # every peer gone: the hub dispatcher ends like tcp's loop
+        st.join(timeout=20)
+        assert not st.is_alive()
+
+    def test_server_death_surfaces_at_client(self):
+        port = _free_port()
+        rec = Recorder()
+        done = threading.Event()
+
+        def client():
+            m = EventLoopCommManager("localhost", port, 1, 2, timeout=30.0)
+            m.add_observer(rec)
+            m.handle_receive_message()
+            done.set()
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        server = EventLoopCommManager("localhost", port, 0, 2,
+                                      timeout=30.0)
+        server.close()
+        assert done.wait(20), "client loop did not exit on server death"
+        assert [m[0] for m in rec.messages] == [MSG_TYPE_PEER_LOST]
+        assert rec.messages[0][1] == 0
+        t.join(timeout=20)
+
+    def test_clean_goodbye_is_not_a_crash(self):
+        port = _free_port()
+        rec = Recorder()
+
+        def client():
+            m = EventLoopCommManager("localhost", port, 1, 2, timeout=30.0)
+            m.send_message(Message("client_ready", 1, 0))
+            m.stop_receive_message()
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        server = EventLoopCommManager("localhost", port, 0, 2,
+                                      timeout=30.0)
+        server.add_observer(rec)
+        st = threading.Thread(target=server.handle_receive_message,
+                              daemon=True)
+        st.start()
+        t.join(timeout=20)
+        st.join(timeout=20)
+        assert not st.is_alive()
+        assert [m[0] for m in rec.messages] == ["client_ready"]
+
+    def test_constructor_times_out_without_peers(self):
+        port = _free_port()
+        with pytest.raises(TimeoutError, match="0/1 peers"):
+            EventLoopCommManager("localhost", port, 0, 2, timeout=0.5)
+
+
+class TestBackpressure:
+    """Write-queue watermarks: a slow reader is shed into the PEER_LOST
+    path, and the resilience layer completes the round degraded."""
+
+    def _wedged_reader(self, port, rank, hold):
+        """Protocol-complete HELLO (retry-dialed: the listener may not
+        be up yet), then never read -- the slow-peer shape keepalive can
+        never detect (its probes are ACKed by a full-buffer peer)."""
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                s = socket.create_connection(("localhost", port),
+                                             timeout=10)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.05)
+        hello = json.dumps({"rank": rank}).encode()
+        s.sendall(struct.pack("!I", len(hello)) + hello)
+        hold.wait(90)
+        s.close()
+
+    def test_wedged_reader_shed_via_peer_lost(self):
+        port = _free_port()
+        rec = Recorder()
+        hold = threading.Event()
+        t = threading.Thread(target=self._wedged_reader,
+                             args=(port, 1, hold), daemon=True)
+        t.start()
+        server = EventLoopCommManager(
+            "localhost", port, 0, 2, timeout=30.0,
+            high_watermark=256 * 1024, low_watermark=64 * 1024,
+            drain_grace_s=0.5)
+        server.add_observer(rec)
+        st = threading.Thread(target=server.handle_receive_message,
+                              daemon=True)
+        st.start()
+        big = Message("sync", 0, 1)
+        big.add("params", {"w": np.zeros((512, 1024), np.float32)})
+        for _ in range(4):  # ~2 MB/frame vs a 256 KB high watermark
+            try:
+                server.send_message(big)
+            except KeyError:
+                break
+            time.sleep(0.05)
+        deadline = time.time() + 20
+        while not rec.messages and time.time() < deadline:
+            time.sleep(0.02)
+        assert rec.messages and rec.messages[0][0] == MSG_TYPE_PEER_LOST
+        assert server.sheds == 1
+        hold.set()
+        st.join(timeout=20)
+        assert not st.is_alive()
+
+    def test_round_completes_degraded_around_shed_peer(self):
+        """Chaos-style: rank 3 is a wedged reader inside a real
+        resilient round; the shed must re-cohort the round, which then
+        completes DEGRADED over the live subset with the exact
+        renormalized partial aggregate."""
+        from fedml_tpu.resilience import RoundPolicy
+        from fedml_tpu.resilience.integration import (
+            ResilientFedAvgClient, ResilientFedAvgServer,
+            quadratic_trainer)
+        from fedml_tpu.resilience.policy import aggregate_reports
+
+        port = _free_port()
+        world = 4
+        hold = threading.Event()
+        wt = threading.Thread(target=self._wedged_reader,
+                              args=(port, 3, hold), daemon=True)
+        wt.start()
+        trainer = quadratic_trainer()
+
+        def run_client(rank):
+            comm = EventLoopCommManager("localhost", port, rank, world,
+                                        timeout=30.0)
+            ResilientFedAvgClient(None, comm, rank, world, trainer).run()
+
+        threads = [threading.Thread(target=run_client, args=(r,),
+                                    daemon=True) for r in (1, 2)]
+        for t in threads:
+            t.start()
+        # params big enough (8 MB/sync) that the wedged rank 3 blows the
+        # watermark on the FIRST broadcast even after the kernel socket
+        # buffers (loopback tcp_wmem autotunes to ~4 MB) absorb their fill
+        w0 = {"w": np.zeros((2048, 1024), np.float32)}
+        comm = EventLoopCommManager(
+            "localhost", port, 0, world, timeout=30.0,
+            high_watermark=128 * 1024, low_watermark=32 * 1024,
+            drain_grace_s=0.5)
+        server = ResilientFedAvgServer(
+            None, comm, world, w0, 2, RoundPolicy(quorum=0.3))
+        server.register_message_receive_handlers()
+        server.start()
+        loop = threading.Thread(target=comm.handle_receive_message,
+                                daemon=True)
+        loop.start()
+        loop.join(timeout=60)
+        hold.set()
+        assert not loop.is_alive(), "server hung on the wedged peer"
+        assert server.failed is None
+        assert len(server.history) == 2
+        assert server.counters["rounds_degraded"] >= 1
+        assert server.reporting_log[0] == [1, 2]  # rank 3 shed, not slow
+        assert comm.sheds == 1
+        # exactness: the degraded round IS the renormalized partial
+        # aggregate over the reporting subset
+        expected = dict(w0)
+        for rnd, subset in enumerate(server.reporting_log):
+            reports = {}
+            for r in subset:
+                p, n = trainer(expected, rnd, r)
+                reports[r] = (n, p)
+            expected, _ = aggregate_reports(reports)
+            for k in expected:
+                assert (expected[k] == server.history[rnd][k]).all()
+        for t in threads:
+            t.join(timeout=20)
+
+
+class TestTransportEquivalence:
+    """The headline A/B: the unchanged FSMs produce bitwise-identical
+    trajectories over the threaded hub and the event loop."""
+
+    def test_sync_fsm_bitwise_ab(self):
+        from fedml_tpu.resilience import RoundPolicy, run_tcp_fedavg
+
+        w0 = {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+              "b": np.ones(4, np.float32)}
+        a = run_tcp_fedavg(4, 3, RoundPolicy(), w0, transport="tcp",
+                           join_timeout=60)
+        b = run_tcp_fedavg(4, 3, RoundPolicy(), w0, transport="eventloop",
+                           join_timeout=60)
+        assert a.failed is None and b.failed is None
+        assert a.reporting_log == b.reporting_log
+        assert len(a.history) == len(b.history) == 3
+        for ga, gb in zip(a.history, b.history):
+            for k in ga:
+                assert (ga[k] == gb[k]).all(), k
+
+    def test_async_fsm_bitwise_ab(self):
+        from fedml_tpu.resilience.async_agg import (AsyncAggPolicy,
+                                                    run_async_tcp_fedavg)
+
+        w0 = {"w": np.arange(8, dtype=np.float32).reshape(2, 4)}
+        pol = AsyncAggPolicy(buffer_k=10 ** 9, staleness_decay=0.0)
+        a = run_async_tcp_fedavg(4, 3, pol, w0, transport="tcp",
+                                 join_timeout=60)
+        b = run_async_tcp_fedavg(4, 3, pol, w0, transport="eventloop",
+                                 join_timeout=60)
+        assert a.failed is None and b.failed is None
+        assert a.flush_log == b.flush_log
+        for ga, gb in zip(a.history, b.history):
+            for k in ga:
+                assert (ga[k] == gb[k]).all(), k
+
+    def test_chaos_kill_stall_with_stitched_observability(self):
+        """The ci.sh chaos scenario over the event loop: kill + stall
+        completes degraded; the race audit is clean; client local-train
+        spans stitch under server round spans THROUGH the new transport
+        (same __trace__ envelope); the kill's flight-recorder dump and
+        the transport="eventloop" wire series exist -- fedtrace/fedmon
+        evidence is transport-agnostic."""
+        import tempfile
+
+        from fedml_tpu.analysis.runtime import race_audit
+        from fedml_tpu.observability import enable
+        from fedml_tpu.resilience import (FaultPlan, FaultRule,
+                                          RoundPolicy, run_tcp_fedavg)
+
+        w0 = {"w": np.zeros((4, 4), np.float32)}
+        plan = FaultPlan(seed=7, rules=(
+            FaultRule("kill", rank=3, msg_type="res_report", nth=2),
+            FaultRule("stall", rank=2, msg_type="res_report", nth=1,
+                      delay_s=3.0),
+        ))
+        d = tempfile.mkdtemp(prefix="evl_chaos_")
+        with enable(trace=True, trace_dir=d, flightrec=True,
+                    flightrec_dir=d, compile_events=False) as obs:
+            with race_audit() as ra:
+                srv = run_tcp_fedavg(
+                    4, 3, RoundPolicy(deadline_s=1.0, quorum=0.3), w0,
+                    fault_plan=plan, join_timeout=90,
+                    transport="eventloop")
+            spans = obs.tracer.finished_spans()
+        assert srv.failed is None and len(srv.history) == 3
+        assert srv.counters["rounds_degraded"] >= 1
+        race = ra.report()
+        assert race["race/locks_created"] > 0
+        assert race["race/lock_order_cycles"] == []
+        assert race["race/held_while_blocking"] == []
+        rounds = {s.span_id: s for s in spans if s.name == "round"}
+        lts = [s for s in spans if s.name == "local-train"]
+        assert lts and all(
+            s.parent_id in rounds
+            and s.trace_id == rounds[s.parent_id].trace_id for s in lts)
+        kill_dumps = []
+        for p in obs.recorder.dumps:
+            events = [json.loads(line) for line in open(p)]
+            info = [e for e in events if e["kind"] == "dump_info"]
+            if info and info[-1].get("peer") == 3:
+                kill_dumps.append(events)
+        assert len(kill_dumps) == 1
+        assert any(e["kind"] == "peer_lost" and e.get("peer") == 3
+                   and e.get("transport") == "eventloop"
+                   for e in kill_dumps[0])
+        assert any(e["kind"] == "send"
+                   and e.get("transport") == "eventloop"
+                   for e in kill_dumps[0])
+        sent = obs.registry.get("comm_bytes_total",
+                                transport="eventloop", direction="sent")
+        recv = obs.registry.get("comm_bytes_total",
+                                transport="eventloop",
+                                direction="received")
+        assert sent and recv and sent > 0 and recv > 0
+
+
+class TestFanIn:
+    """Hierarchical fan-in: edges own leaf stars, the coordinator's
+    BufferedAggregator folds edge aggregates -- exactly."""
+
+    def test_round_robin_groups_matches_hierarchical_rule(self):
+        from fedml_tpu.net.fanin import round_robin_groups
+        ids = list(range(7))
+        # the HierarchicalFedAvgAPI slicing, verbatim
+        want = [ids[g::3] for g in range(3)]
+        want = [g for g in want if g]
+        assert round_robin_groups(ids, 3) == want
+        assert round_robin_groups([1, 2], 4) == [[1], [2]]
+
+    @pytest.mark.parametrize("transport", ["tcp", "eventloop"])
+    def test_two_tier_fold_bitwise(self, transport):
+        from fedml_tpu.net.fanin import round_robin_groups, run_fanin_fedavg
+        from fedml_tpu.resilience.async_agg import AsyncAggPolicy
+        from fedml_tpu.resilience.integration import quadratic_trainer
+        from fedml_tpu.resilience.policy import aggregate_reports
+
+        w0 = {"w": np.arange(8, dtype=np.float32).reshape(2, 4),
+              "b": np.zeros(4, np.float32)}
+        pol = AsyncAggPolicy(buffer_k=10 ** 9, staleness_decay=0.0)
+        srv, edges = run_fanin_fedavg(2, 3, 2, pol, w0,
+                                      transport=transport,
+                                      join_timeout=90)
+        assert srv.failed is None
+        assert len(srv.history) == 2
+        assert [e.rounds_forwarded for e in edges] == [2, 2]
+        # replicate the two-tier weighted fold host-side, bitwise
+        trainer = quadratic_trainer()
+        groups = round_robin_groups(range(1, 7), 2)
+        params = {k: np.asarray(v) for k, v in w0.items()}
+        for rnd in range(2):
+            edge_reports = {}
+            for e, gids in enumerate(groups, start=1):
+                leaf = {}
+                for local, gid in enumerate(gids, start=1):
+                    p, n = trainer(params, rnd, gid)
+                    leaf[local] = (n, p)
+                ep, et = aggregate_reports(leaf)
+                edge_reports[e] = (et, ep)
+            params, _ = aggregate_reports(edge_reports)
+            for k in params:
+                assert (params[k] == srv.history[rnd][k]).all(), (rnd, k)
+
+
+class TestSoak:
+    """Many-connection soak: swarm subprocess + real async server."""
+
+    def test_soak_smoke(self):
+        """Tier-1-sized soak: 200 connections, 2 async windows, with
+        the perfmon armed -- status.json and the report-latency
+        histogram are the acceptance artifacts."""
+        import tempfile
+
+        from fedml_tpu.observability import enable
+        from fedml_tpu.net.soak import run_soak
+
+        d = tempfile.mkdtemp(prefix="soak_smoke_")
+        with enable(perfmon=True, status_path=d + "/status.json",
+                    compile_events=False) as obs:
+            server, summary = run_soak(200, total_updates=2,
+                                       jitter_s=0.2, join_timeout=180)
+        assert server.failed is None
+        assert server.agg.version == 2
+        assert summary.get("connections") == 200
+        assert server.counters["reports"] == 400
+        status = json.load(open(d + "/status.json"))
+        assert status["final"] is True and status["outcome"] == "complete"
+        assert status["round"] == 2
+        total, count = obs.registry.get("fed_report_latency_seconds")
+        assert count >= 400 and total > 0
+        assert obs.registry.histogram_quantile(
+            "fed_report_latency_seconds", 0.99) is not None
+
+    @pytest.mark.slow
+    def test_soak_10k(self):
+        """The headline acceptance: a 10k-connection soak on one host
+        completes >= 3 async rounds with a parseable final status.json
+        and a populated fed_report_latency_seconds straggler tail."""
+        import tempfile
+
+        from fedml_tpu.observability import enable
+        from fedml_tpu.net.soak import run_soak
+
+        d = tempfile.mkdtemp(prefix="soak_10k_")
+        with enable(perfmon=True, status_path=d + "/status.json",
+                    compile_events=False) as obs:
+            server, summary = run_soak(10_000, total_updates=3,
+                                       jitter_s=1.0, join_timeout=480)
+        assert server.failed is None
+        assert server.agg.version == 3
+        assert summary.get("connections") == 10_000
+        assert server.counters["reports"] == 30_000
+        status = json.load(open(d + "/status.json"))
+        assert status["final"] is True and status["outcome"] == "complete"
+        _total, count = obs.registry.get("fed_report_latency_seconds")
+        assert count >= 30_000
+        assert obs.registry.histogram_quantile(
+            "fed_report_latency_seconds", 0.99) is not None
+
+
+class TestRegistryQuantile:
+    def test_histogram_quantile(self):
+        from fedml_tpu.observability.registry import MetricsRegistry
+        reg = MetricsRegistry()
+        assert reg.histogram_quantile("missing", 0.5) is None
+        for v in (0.004, 0.02, 0.02, 0.3):
+            reg.observe("lat_seconds", v, buckets=(0.005, 0.05, 0.5))
+        assert reg.histogram_quantile("lat_seconds", 0.25) == 0.005
+        assert reg.histogram_quantile("lat_seconds", 0.75) == 0.05
+        assert reg.histogram_quantile("lat_seconds", 1.0) == 0.5
+        reg.observe("lat_seconds", 99.0, buckets=(0.005, 0.05, 0.5))
+        assert reg.histogram_quantile("lat_seconds", 1.0) == float("inf")
+        with pytest.raises(ValueError):
+            reg.histogram_quantile("lat_seconds", 1.5)
