@@ -1,0 +1,40 @@
+"""fedwarm: ahead-of-time round-program warmup through the persistent
+XLA compilation cache.
+
+The compile problem this closes (docs/OBSERVABILITY.md measured it,
+ROADMAP names it): every flagship config costs 155-193 s of XLA compile
+before the first measured round, and a recovered server (the
+``fedml_tpu.resilience`` restart path) used to stall the fleet for the
+same 3 minutes recompiling programs it had already run. PR 10's cost
+model proved the mechanism -- ``lowered.compile()`` at
+``ShapeDtypeStruct`` args is a REAL compile that the persistent cache
+dedupes against the dispatch path -- and this package turns it into the
+fix:
+
+- :func:`~fedml_tpu.compile.warmup.enumerate_round_programs` walks a
+  constructed ``FedAvgAPI`` and names every jitted round function the
+  run will dispatch (sim / device-resident waves / packed lanes /
+  bucketed-stream chunk programs per bucket edge / server advance /
+  eval) at the exact arg shapes round 0 will use.
+- :func:`~fedml_tpu.compile.warmup.warmup_api` AOT-compiles them all,
+  serializing each executable through the persistent compilation cache
+  (``utils/compile_cache.py``), and reports per-program wall seconds
+  plus the CompileWatcher's cache-hit/miss split.
+- :func:`~fedml_tpu.compile.warmup.warm_restart` is the recovery-path
+  hook: enable the cache over the run's ``--compile_cache_dir``, warm
+  every program, return the report -- a restarted server reloads
+  executables (cache hits, deserialization-time "compiles") instead of
+  recompiling.
+
+Exposed as ``--warmup`` on the FedAvg-family mains and ``bench.py``;
+gated in tests/test_compile.py and the scripts/ci.sh warm-restart smoke
+(second run over a warmed cache dir: 0 steady compiles, 0 warmup cache
+misses).
+"""
+
+from fedml_tpu.compile.warmup import (RoundProgram, enumerate_round_programs,
+                                      warm_restart, warmup_api,
+                                      warmup_programs)
+
+__all__ = ["RoundProgram", "enumerate_round_programs", "warmup_programs",
+           "warmup_api", "warm_restart"]

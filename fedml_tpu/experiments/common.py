@@ -121,6 +121,16 @@ def add_base_args(parser: argparse.ArgumentParser):
                         "155-193 s per-config compile item -- warm-cache "
                         "restarts skip compilation entirely, measured by "
                         "the CompileWatcher per-round compile events)")
+    p.add_argument("--warmup", type=int, default=0,
+                   help="AOT round-program warmup (fedml_tpu.compile): "
+                        "enumerate every jitted round function this run "
+                        "will dispatch and compile them up front through "
+                        "the persistent compilation cache, so a restarted "
+                        "server (--resume) reloads executables in "
+                        "cache-load time instead of recompiling 155-193 s "
+                        "per config; the warmup report (programs, "
+                        "seconds, cache hits/misses) goes to the metrics "
+                        "sink")
     # resilience knobs (fedml_tpu.resilience): over-selection, report
     # deadline, quorum, simulated stragglers; --resume above is the
     # recovery half
@@ -351,6 +361,14 @@ def run_fedavg_family(api, args, logger):
                       metric=metrics.get(
                           getattr(api_, "checkpoint_metric", "Test/Acc")),
                       data_rng=api_._data_rng)
+
+    if getattr(args, "warmup", 0):
+        # AFTER any restore (a resumed server is exactly the warm-restart
+        # case), BEFORE the round loop: every jitted round program is
+        # AOT-compiled through the persistent cache, so over a warmed
+        # --compile_cache_dir the run starts in cache-load time
+        from fedml_tpu.compile import warm_restart
+        logger(warm_restart(api, getattr(args, "compile_cache_dir", None)))
 
     with observability_scope(args, logger):
         with profile_trace(args.profile_dir,

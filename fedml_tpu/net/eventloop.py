@@ -49,7 +49,7 @@ import struct
 import threading
 import time
 from collections import deque
-from queue import SimpleQueue
+from queue import Empty, SimpleQueue
 
 from fedml_tpu.core.locks import audited_lock
 from fedml_tpu.observability.flightrec import get_flight_recorder
@@ -69,6 +69,14 @@ _TICK_S = 0.2
 #: Seconds the graceful-stop flush (STOP wave / GOODBYE drain) may take
 #: before the loop force-closes everything -- the Timer(5.0) analog.
 _STOP_FLUSH_S = 5.0
+#: Frames the dispatcher decodes per FIFO wakeup. At soak rates the
+#: dispatcher is the single-threaded decode bottleneck (~1.7k reports/s
+#: at 10k connections on one core, docs/NETWORKING.md) and a blocking
+#: ``get()`` per frame pays the queue's wait/notify machinery every
+#: time; draining a chunk per wakeup amortizes it while the per-peer
+#: frame/EOF order within the drained list is exactly the queue order,
+#: so the GOODBYE-vs-crash reasoning is untouched.
+_DISPATCH_BATCH = 256
 
 
 class _Conn:
@@ -323,29 +331,42 @@ class EventLoopCommManager(BaseCommunicationManager):
             self._serve_client()
 
     # -- dispatcher thread -------------------------------------------------
+    def _drain_inbox(self):
+        """One dispatcher wakeup's worth of work: block for the first
+        item, then drain up to ``_DISPATCH_BATCH`` already-queued items
+        without re-entering the queue's wait machinery. Order is the
+        FIFO's order -- batching changes wakeup count, never sequencing."""
+        items = [self._inbox.get()]
+        try:
+            while len(items) < _DISPATCH_BATCH:
+                items.append(self._inbox.get_nowait())
+        except Empty:
+            pass
+        return items
+
     def _serve_hub(self):
         while True:
-            item = self._inbox.get()
-            kind = item[0]
-            if kind == "stopped":
-                return
-            if kind == "frame":
-                if not self._dispatch_hub_frame(item[1], item[2]):
+            for item in self._drain_inbox():
+                kind = item[0]
+                if kind == "stopped":
                     return
-            elif kind in ("eof", "shed"):
-                rank = item[1]
-                clean = rank in self._goodbye and kind != "shed"
-                if not clean and not self._stopping:
-                    self._notify_peer_lost(rank)
-                with self._lock:
-                    n_left = len(self._peers)
-                if n_left == 0:
-                    # every peer gone with no STOP: mirror tcp -- release
-                    # the listener, quench late notifications
-                    self._running = False
-                    self._stopping = True
-                    self.close()
-                    return
+                if kind == "frame":
+                    if not self._dispatch_hub_frame(item[1], item[2]):
+                        return
+                elif kind in ("eof", "shed"):
+                    rank = item[1]
+                    clean = rank in self._goodbye and kind != "shed"
+                    if not clean and not self._stopping:
+                        self._notify_peer_lost(rank)
+                    with self._lock:
+                        n_left = len(self._peers)
+                    if n_left == 0:
+                        # every peer gone with no STOP: mirror tcp --
+                        # release the listener, quench late notifications
+                        self._running = False
+                        self._stopping = True
+                        self.close()
+                        return
 
     def _dispatch_hub_frame(self, rank, frame) -> bool:
         self._count_in(len(frame))
@@ -401,32 +422,34 @@ class EventLoopCommManager(BaseCommunicationManager):
     def _serve_client(self):
         try:
             while True:
-                item = self._inbox.get()
-                kind = item[0]
-                if kind == "stopped":
-                    return
-                if kind == "frame":
-                    if not self._running:
-                        continue  # GOODBYE sent: draining until EOF
-                    frame = item[2]
-                    self._count_in(len(frame))
-                    msg = message_from_wire(frame)
-                    fr = get_flight_recorder()
-                    if fr is not None:
-                        fr.record("recv", type=msg.get_type(),
-                                  src=msg.get_sender_id(), dst=self.rank,
-                                  bytes=len(frame), transport="eventloop")
-                    if msg.get_type() == MSG_TYPE_PEER_LOST:
-                        logging.warning("eventloop client: dropping "
-                                        "in-band reserved %s frame",
-                                        MSG_TYPE_PEER_LOST)
-                        continue
-                    if not self._dispatch(msg):
+                for item in self._drain_inbox():
+                    kind = item[0]
+                    if kind == "stopped":
                         return
-                elif kind in ("eof", "shed"):
-                    if self._running and not self._stopping:
-                        self._notify_peer_lost(0)
-                    return
+                    if kind == "frame":
+                        if not self._running:
+                            continue  # GOODBYE sent: draining until EOF
+                        frame = item[2]
+                        self._count_in(len(frame))
+                        msg = message_from_wire(frame)
+                        fr = get_flight_recorder()
+                        if fr is not None:
+                            fr.record("recv", type=msg.get_type(),
+                                      src=msg.get_sender_id(),
+                                      dst=self.rank,
+                                      bytes=len(frame),
+                                      transport="eventloop")
+                        if msg.get_type() == MSG_TYPE_PEER_LOST:
+                            logging.warning("eventloop client: dropping "
+                                            "in-band reserved %s frame",
+                                            MSG_TYPE_PEER_LOST)
+                            continue
+                        if not self._dispatch(msg):
+                            return
+                    elif kind in ("eof", "shed"):
+                        if self._running and not self._stopping:
+                            self._notify_peer_lost(0)
+                        return
         finally:
             self._running = False
             if not self._stopping:

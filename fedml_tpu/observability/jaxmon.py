@@ -26,6 +26,13 @@ import threading
 #: pins; see fedml_tpu.analysis.runtime).
 TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
 COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+#: persistent-compilation-cache outcomes. Measured (jax 0.4.37): a cache
+#: HIT still fires COMPILE_EVENT -- its duration is the cache-load time,
+#: not an XLA compile -- so the warm-restart gate is "zero cache MISSES"
+#: (every compile served from the warmed cache), not "zero compile
+#: events" (docs/OBSERVABILITY.md, fedwarm).
+CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
 
 _current = None
 
@@ -51,6 +58,13 @@ class CompileWatcher:
         self.total_compiles = 0
         self.total_compile_seconds = 0.0
         self.total_traces = 0
+        # persistent-compilation-cache outcomes (plain jax.monitoring
+        # events): a warmed cache turns every compile into a HIT whose
+        # COMPILE_EVENT duration is the deserialization time -- the
+        # warm-restart gate asserts cache_misses == 0, since compile
+        # COUNT stays nonzero even when nothing XLA-compiles
+        self.cache_hits = 0
+        self.cache_misses = 0
 
     def _on_event(self, event, duration_secs, **kwargs):
         if not self._active:
@@ -78,6 +92,16 @@ class CompileWatcher:
                 reg.inc("jax_traces_total",
                         help="jaxpr traces observed")
 
+    def _on_plain_event(self, event, **kwargs):
+        if not self._active:
+            return
+        if event == CACHE_HIT_EVENT:
+            with self._lock:
+                self.cache_hits += 1
+        elif event == CACHE_MISS_EVENT:
+            with self._lock:
+                self.cache_misses += 1
+
     def mark_round(self):
         """Close the current round's bucket (round 0 holds warm-up)."""
         with self._lock:
@@ -101,22 +125,32 @@ class CompileWatcher:
                 "compile/total_seconds":
                     round(self.total_compile_seconds, 4),
                 "compile/total_traces": self.total_traces,
+                "compile/cache_hits": self.cache_hits,
+                "compile/cache_misses": self.cache_misses,
             }
 
     def record_fields(self) -> dict:
         """Flat compile-cost fields for a bench record / ledger entry
-        (count + wall seconds; the per-round lists stay in
-        :meth:`report`)."""
+        (count + wall seconds + persistent-cache outcomes; the per-round
+        lists stay in :meth:`report`)."""
         with self._lock:
             return {"compile_count": self.total_compiles,
                     "compile_seconds":
-                        round(self.total_compile_seconds, 4)}
+                        round(self.total_compile_seconds, 4),
+                    "compile_cache_hits": self.cache_hits,
+                    "compile_cache_misses": self.cache_misses}
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
         from jax import monitoring
         self._active = True
         monitoring.register_event_duration_secs_listener(self._on_event)
+        try:  # plain-event listener: the cache-outcome feed (older jax
+            # may lack it; durations still work without)
+            monitoring.register_event_listener(self._on_plain_event)
+            self._plain_registered = True
+        except AttributeError:
+            self._plain_registered = False
         return self
 
     def stop(self):
@@ -125,6 +159,13 @@ class CompileWatcher:
         # best-effort dereg (leaving the inert listener on API drift)
         from fedml_tpu.analysis.runtime import _unregister
         _unregister(self._on_event)
+        if getattr(self, "_plain_registered", False):
+            try:
+                from jax._src import monitoring as _mon
+                _mon._unregister_event_listener_by_callback(
+                    self._on_plain_event)
+            except (ImportError, AttributeError, AssertionError):
+                pass  # inert listener stays registered on API drift
 
 
 @contextlib.contextmanager
@@ -143,4 +184,5 @@ def watch_compiles():
 
 
 __all__ = ["CompileWatcher", "watch_compiles", "current_watcher",
-           "TRACE_EVENT", "COMPILE_EVENT"]
+           "TRACE_EVENT", "COMPILE_EVENT", "CACHE_HIT_EVENT",
+           "CACHE_MISS_EVENT"]

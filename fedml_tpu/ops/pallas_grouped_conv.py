@@ -1,0 +1,144 @@
+"""Pallas TPU kernel for the backward-dW of per-lane (grouped) convs.
+
+Why (docs/PERFORMANCE.md round 5): packed lanes run 1.56x above the
+single-model ceiling, and the measured cost center is the backward
+weight gradient of the per-lane convolutions. XLA's dW for the
+block-diagonal lowering computes a DENSE ``[kh, kw, g*Ci, G*g*Co]``
+gradient and gathers the diagonal blocks -- ``g``x redundant FLOPs in
+the one pass where the redundancy is NOT riding otherwise-idle MXU
+tiles; the ``batch_group_count`` lowering avoids the redundancy but
+lowers dW through a grouped conv whose per-group K is the model's
+channel count (16/32/64 for ResNet-56) against the MXU's 128-wide
+systolic passes.
+
+This kernel computes the per-lane dW directly as ``kh*kw`` tall-skinny
+matmuls whose CONTRACTION axis is the flattened ``batch*H*W`` sample
+axis -- thousands long at the flagship shapes, so every systolic pass
+streams a full 128-deep K block regardless of channel count:
+
+    dW[l, dh, dw, i, o] = sum_{b,h,w} x_pad[l, b, h+dh, w+dw, i]
+                                      * dy[l, b, h, w, o]
+
+One grid step per filter tap; the lane axis rides the same leading-axis
+``vmap`` the flash-attention kernels use (Mosaic turns it into a
+squeezed block dim). fp32 accumulation via ``preferred_element_type``.
+
+Scope (documented, enforced in code): stride-1 convs only -- ResNet-56
+has 4 strided convs out of 57 (stage-boundary + 1x1 downsamples), which
+fall back to XLA's dW; dX always stays with XLA (it was never the cost
+center, and the conv transpose is already well-lowered). Off-TPU the
+kernel runs in interpret mode so CPU tier-1 pins numerics against the
+XLA reference lowering (``tests/test_lane_packed.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from fedml_tpu.ops.pallas_attention import _use_interpret
+
+
+def _dw_tap_kernel(x_ref, dy_ref, out_ref, *, kw, h_out, w_out):
+    """One filter tap's ``[Ci, Co]`` gradient: slice the tap's input
+    window and contract over the flattened ``[B*Ho*Wo]`` sample axis."""
+    t = pl.program_id(0)
+    dh, dw = t // kw, t % kw
+    xt = x_ref[:, pl.dslice(dh, h_out), pl.dslice(dw, w_out), :]
+    b, ci = xt.shape[0], xt.shape[-1]
+    co = dy_ref.shape[-1]
+    a = xt.reshape(b * h_out * w_out, ci)
+    g = dy_ref[:].reshape(b * h_out * w_out, co)
+    acc = jax.lax.dot_general(a, g, (((0,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    out_ref[0, 0] = acc.astype(out_ref.dtype)
+
+
+def _dw_one_lane(x_pad, dy, *, kh, kw, interpret):
+    """``x_pad [B, Hp, Wp, Ci]``, ``dy [B, Ho, Wo, Co]`` ->
+    ``dW [kh, kw, Ci, Co]`` (stride 1)."""
+    B, Hp, Wp, Ci = x_pad.shape
+    _, Ho, Wo, Co = dy.shape
+    kernel = functools.partial(_dw_tap_kernel, kw=kw, h_out=Ho, w_out=Wo)
+    return pl.pallas_call(
+        kernel,
+        grid=(kh * kw,),
+        in_specs=[
+            # full-array blocks, same block for every tap: the operands
+            # stay resident in VMEM across the whole grid
+            pl.BlockSpec((B, Hp, Wp, Ci), lambda t: (0, 0, 0, 0)),
+            pl.BlockSpec((B, Ho, Wo, Co), lambda t: (0, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, Ci, Co),
+                               lambda t, kw_=kw: (t // kw_, t % kw_, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((kh, kw, Ci, Co), jnp.float32),
+        interpret=interpret,
+    )(x_pad, dy)
+
+
+def grouped_conv_dw(x_lanes, dy_lanes, kh, kw, padding):
+    """Per-lane conv weight gradient (stride 1) as a Pallas kernel.
+
+    ``x_lanes [L, B, H, W, Ci]`` raw (unpadded) inputs, ``dy_lanes
+    [L, B, Ho, Wo, Co]`` output cotangents, ``padding``
+    ``((pt, pb), (pl, pr))``. Returns ``dW [L, kh, kw, Ci, Co]`` in
+    float32 (callers cast to the weight dtype)."""
+    (pt, pb), (pl_, pr) = padding
+    x_pad = jnp.pad(x_lanes, ((0, 0), (0, 0), (pt, pb), (pl_, pr), (0, 0)))
+    fn = functools.partial(_dw_one_lane, kh=kh, kw=kw,
+                           interpret=_use_interpret())
+    return jax.vmap(fn)(x_pad, dy_lanes)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def lane_conv_pallas(x, w, L, strides, padding):
+    """Per-lane conv, ``batch_group_count`` forward + Pallas dW backward.
+
+    Same contract as :func:`fedml_tpu.models.lane_packed.lane_conv_bgc`:
+    ``x [L*B, H, W, Ci]`` batch-stacked lane-major, ``w [L, kh, kw, Ci,
+    Co]``, returns merged ``[B, H', W', L*Co]``. The forward IS the
+    zero-redundancy bgc conv (bitwise, same XLA program); only the
+    weight-gradient rule changes -- dX keeps XLA's transpose conv, dW
+    goes through :func:`grouped_conv_dw` when ``strides == (1, 1)`` and
+    falls back to XLA's dW otherwise (the 4 strided ResNet convs)."""
+    from fedml_tpu.models.lane_packed import lane_conv_bgc
+
+    return lane_conv_bgc(x, w, L, strides=strides, padding=padding)
+
+
+def _lcp_fwd(x, w, L, strides, padding):
+    return lane_conv_pallas(x, w, L, strides, padding), (x, w)
+
+
+def _lcp_bwd(L, strides, padding, res, g):
+    from fedml_tpu.models.lane_packed import lane_conv_bgc, lane_unmerge
+
+    x, w = res
+    # dX: XLA's conv transpose (never the cost center). The conv is
+    # linear in x, so the primal recompute inside vjp is dead code XLA
+    # removes -- only the transpose conv remains in the program.
+    _, vjp_x = jax.vjp(
+        lambda xx: lane_conv_bgc(xx, w, L, strides=strides,
+                                 padding=padding), x)
+    (dx,) = vjp_x(g)
+    _, kh, kw, ci, _ = w.shape
+    if strides == (1, 1):
+        B = x.shape[0] // L
+        x_lanes = x.reshape((L, B) + x.shape[1:])
+        dy_lanes = lane_unmerge(g, L)
+        dw = grouped_conv_dw(x_lanes, dy_lanes, kh, kw,
+                             padding).astype(w.dtype)
+    else:
+        _, vjp_w = jax.vjp(
+            lambda ww: lane_conv_bgc(x, ww, L, strides=strides,
+                                     padding=padding), w)
+        (dw,) = vjp_w(g)
+    return dx, dw
+
+
+lane_conv_pallas.defvjp(_lcp_fwd, _lcp_bwd)
+
+__all__ = ["lane_conv_pallas", "grouped_conv_dw"]

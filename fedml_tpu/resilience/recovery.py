@@ -43,7 +43,8 @@ class RoundRecovery:
       max_to_keep: orbax GC horizon.
     """
 
-    def __init__(self, directory: str, save_every: int = 1, max_to_keep: int = 3):
+    def __init__(self, directory: str, save_every: int = 1, max_to_keep: int = 3,
+                 warmup_fn=None):
         # synchronous saves: round turnover happens on whichever transport
         # serve thread delivered the last report, and orbax's async
         # finalize thread cannot be handed between threads
@@ -52,6 +53,13 @@ class RoundRecovery:
         self.save_every = max(1, int(save_every))
         self.resumes = 0
         self.saves = 0
+        # fedwarm hook (fedml_tpu.compile.warm_restart partial): invoked
+        # after a successful restore so the recovered server AOT-reloads
+        # its round executables from the persistent compilation cache
+        # BEFORE re-entering the round loop -- the Bonawitz requirement
+        # that a restarted server must not stall the fleet recompiling
+        self.warmup_fn = warmup_fn
+        self.last_warmup = None
 
     def maybe_save(self, round_idx: int, global_state, server_state=(),
                    rng=None, data_rng=None, last: bool = False) -> bool:
@@ -75,7 +83,25 @@ class RoundRecovery:
         self.resumes += 1
         logging.info("resilience: resuming from round %d snapshot",
                      saved["round_idx"])
+        if self.warmup_fn is not None:
+            self.warm_restart()  # stores its report in self.last_warmup
         return saved
+
+    def warm_restart(self):
+        """Run the configured warmup hook now (also called automatically
+        after a successful :meth:`restore_latest` when ``warmup_fn`` is
+        set). Returns the fedwarm report, or None without a hook."""
+        if self.warmup_fn is None:
+            return None
+        report = self.warmup_fn()
+        self.last_warmup = report
+        logging.info("resilience: warm restart -- %s programs, %.2fs, "
+                     "%s cache hits / %s misses",
+                     report.get("warmup/programs"),
+                     report.get("warmup/seconds", 0.0),
+                     report.get("warmup/cache_hits"),
+                     report.get("warmup/cache_misses"))
+        return report
 
     def latest_round(self) -> Optional[int]:
         return self._ckpt.latest_round()

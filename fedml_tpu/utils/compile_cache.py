@@ -19,27 +19,61 @@ import os
 DEFAULT_DIR = os.path.expanduser("~/.cache/fedml_tpu/xla")
 
 
-def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
+#: Default persistence gate: programs compiling faster than this are not
+#: written to the cache (they recompile cheaper than they deserialize on
+#: TPU-scale hosts). The warm-restart path and tier-1 tests pass 0.0 so
+#: real small programs round-trip the cache on a CPU host -- without the
+#: override, nothing sub-1s ever persists and the warm-restart machinery
+#: is untestable off-TPU (PR 9 note, closed by fedwarm).
+DEFAULT_MIN_COMPILE_TIME_S = 1.0
+
+
+def enable_compilation_cache(cache_dir: str | None = None,
+                             min_compile_time_secs: float | None = None,
+                             ) -> str | None:
     """Enable jax's persistent compilation cache. Returns the directory in
-    use, or None when disabled/unsupported. Safe to call more than once."""
+    use, or None when disabled/unsupported. Safe to call more than once.
+
+    ``min_compile_time_secs`` overrides the persistence gate (default
+    :data:`DEFAULT_MIN_COMPILE_TIME_S`); the env var
+    ``FEDML_TPU_COMPILE_MIN_S`` overrides the default when no explicit
+    argument is given (the knob tests and the warm-restart smoke use to
+    persist sub-second CPU programs)."""
     if cache_dir is None:  # an explicit caller argument beats the env
         env = os.environ.get("FEDML_TPU_COMPILE_CACHE")
         if env == "0":
             return None
         cache_dir = env or DEFAULT_DIR
+    if min_compile_time_secs is None:
+        min_compile_time_secs = float(
+            os.environ.get("FEDML_TPU_COMPILE_MIN_S",
+                           DEFAULT_MIN_COMPILE_TIME_S))
     import jax
 
     try:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        # default min-compile-time gate (1 s) would skip tiny programs --
-        # fine; but cache every size of entry once it qualifies
+        # cache every size of entry once it qualifies
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_time_secs))
     except Exception as e:  # jax version without the knobs: run uncached
         logging.info("compilation cache unavailable: %s", e)
         return None
+    try:
+        # jax memoizes its cache-in-use decision at the FIRST compile:
+        # a process that compiled anything before this call would
+        # silently never read or write the cache (measured, jax 0.4.37
+        # -- it broke the warm-restart gate under the shared-process
+        # test tier). Reset the memo so (re)enabling takes effect; on
+        # private-API drift the memo simply stays, which is the old
+        # behavior.
+        from jax._src.compilation_cache import reset_cache
+        reset_cache()
+    except (ImportError, AttributeError):
+        logging.debug("compilation cache: no reset hook in this jax")
     return cache_dir
 
 
-__all__ = ["enable_compilation_cache", "DEFAULT_DIR"]
+__all__ = ["enable_compilation_cache", "DEFAULT_DIR",
+           "DEFAULT_MIN_COMPILE_TIME_S"]

@@ -62,6 +62,7 @@ def main():
     import optax
 
     from fedml_tpu.models.transformer import TransformerLM, lm_loss
+    from fedml_tpu.observability.jaxmon import watch_compiles
 
     d, L, T, B, V = (args.d_model, args.n_layers, args.seq, args.batch,
                      args.vocab)
@@ -72,7 +73,6 @@ def main():
     rng = jax.random.PRNGKey(0)
     idx = jax.random.randint(rng, (B, T), 0, V)
     tgt = jnp.roll(idx, -1, axis=1)
-    t0 = time.time()
     params = model.init(rng, idx)["params"]
     tx = optax.adamw(3e-4)
     opt = tx.init(params)
@@ -98,11 +98,14 @@ def main():
         return jax.lax.fori_loop(0, args.inner, body,
                                  (p, o, jnp.float32(0.0)))
 
-    params, opt, l = step(params, opt)
-    # trace + XLA compile happen synchronously inside the first call;
-    # only the execution tail is async, so this delta honestly measures
-    # compile time (the measured-loop timings below fetch-sync via float)
-    compile_s = time.time() - t0  # fedlint: disable=FL114
+    # CompileWatcher measures the compile directly off jax.monitoring's
+    # backend-compile events -- no wall-clock delta around an async
+    # dispatch, so the old FL114 suppression is gone (the bench.py --lm
+    # flagship path measures the same way)
+    with watch_compiles() as compile_watch:
+        params, opt, l = step(params, opt)
+        float(l)  # value-fetch: the first call's execution tail completes
+    compile_s = compile_watch.total_compile_seconds
     ts = []
     for _ in range(args.repeats):
         t0 = time.perf_counter()
@@ -128,6 +131,8 @@ def main():
         "n_params": n_params,
         "inner_steps_per_dispatch": args.inner,
         "compile_s": round(compile_s, 1),
+        "compile_count": compile_watch.total_compiles,
+        "compile_cache_hits": compile_watch.cache_hits,
         "device": str(dev),
     }))
 

@@ -437,4 +437,67 @@ fi
 echo "perf-regression gate: green on fresh ledger, red on 2x slowdown OK"
 rm -f "$CI_LEDGER"
 
+echo "== fedwarm + federated-LM flagship smoke (bench.py --lm --warmup):"
+echo "   a tiny TransformerLM federated run through FedAvgAPI + the"
+echo "   bucketed streaming engine, TWICE over one --compile_cache_dir."
+echo "   Gates: (a) the LM record carries cost-model-sourced MFU"
+echo "   (flops_source: xla-cost-model); (b) THE warm-restart gate --"
+echo "   the second run's AOT warmup takes ZERO persistent-cache misses"
+echo "   (every compile event is a cache load; jax fires the compile"
+echo "   event on hits too, with the deserialization time) and zero"
+echo "   steady-state compiles, with warmup compile seconds collapsed"
+echo "   to cache-load time; (c) the LM ledger gate fires both ways --"
+echo "   green on the two real runs, red on a planted 2x MFU drop."
+echo "   fedlint must stay at zero findings on the new compile/ + ops/"
+echo "   kernel files =="
+python -m fedml_tpu.analysis fedml_tpu/compile/ fedml_tpu/ops/ > /dev/null \
+    && echo "fedlint on compile/ + ops/: 0 findings"
+LM_LEDGER=bench_results/ci_lm_ledger.jsonl
+WARM_CACHE=$(mktemp -d)
+rm -f "$LM_LEDGER"
+# FEDML_TPU_COMPILE_MIN_S=0: sub-1s CPU programs must persist or the
+# warm-restart path is untestable off-TPU (the exposed threshold)
+timeout -k 10 300 env FEDML_TPU_COMPILE_MIN_S=0 python bench.py --lm \
+    --smoke --platform cpu --warmup 1 --compile_cache_dir "$WARM_CACHE" \
+    --ledger "$LM_LEDGER" > bench_results/bench_lm_smoke_cold.json
+timeout -k 10 300 env FEDML_TPU_COMPILE_MIN_S=0 python bench.py --lm \
+    --smoke --platform cpu --warmup 1 --compile_cache_dir "$WARM_CACHE" \
+    --ledger "$LM_LEDGER" > bench_results/bench_lm_smoke_warm.json
+python - <<'EOF'
+import json
+cold = json.loads(open("bench_results/bench_lm_smoke_cold.json").readline())
+warm = json.loads(open("bench_results/bench_lm_smoke_warm.json").readline())
+for rec in (cold, warm):
+    assert rec["unit"] == "rounds/hour" and rec["value"] > 0, rec
+    assert rec["flops_source"] == "xla-cost-model", rec
+    assert rec["mfu"] > 0 and rec["lm_rounds_per_hour"] > 0, rec
+    assert rec["steady_compiles"] == 0, rec
+    assert rec["warmup_programs"] >= 3, rec
+assert cold["warmup_cache_misses"] > 0, cold  # fresh cache: real compiles
+assert warm["warmup_cache_misses"] == 0, warm
+assert warm["warmup_compile_s"] < cold["warmup_compile_s"], (warm, cold)
+print("fedwarm warm-restart gate: cold", cold["warmup_compile_s"], "s ->",
+      "warm", warm["warmup_compile_s"], "s, 0 warm cache misses, 0 steady",
+      "compiles | LM MFU", warm["mfu"], f"({warm['flops_source']})")
+EOF
+python bench.py --check-regress --ledger "$LM_LEDGER" --regress_band 0.4
+python - <<'EOF'
+import json
+from fedml_tpu.observability.perfmon import append_ledger
+rec = json.loads(open("bench_results/bench_lm_smoke_warm.json").readline())
+slow = dict(rec)
+slow["value"] = rec["value"] / 2.0          # the planted 2x MFU drop
+slow["lm_rounds_per_hour"] = rec["lm_rounds_per_hour"] / 2.0
+slow["mfu"] = rec["mfu"] / 2.0
+slow["injected_fixture"] = "2x-mfu-drop"
+append_ledger(slow, "bench_results/ci_lm_ledger.jsonl")
+EOF
+if python bench.py --check-regress --ledger "$LM_LEDGER" --regress_band 0.4; then
+    echo "LM perf-regression gate FAILED to fire on the 2x MFU drop"
+    exit 1
+fi
+echo "LM ledger gate: green on real runs, red on 2x MFU drop OK"
+rm -f "$LM_LEDGER"
+rm -rf "$WARM_CACHE"
+
 echo "ci.sh: all green"

@@ -1,0 +1,77 @@
+#!/bin/bash
+# Round-8 (fedwarm) TPU window plan. What this PR can only stage on CPU
+# and the next hardware window must measure, in value order:
+#   1. warmup/warm-restart at flagship shapes: bench.py --warmup twice
+#      over one --compile_cache_dir -- the 155-193 s per-config compile
+#      (CompileWatcher-measured, docs/OBSERVABILITY.md) must collapse to
+#      cache-load time on the second run (warmup_cache_misses == 0).
+#   2. the --lane_lowering A/B the r5b watcher left unfinished, now with
+#      the third candidate: pallas (bgc forward + the Pallas grouped-conv
+#      dW kernel, ops/pallas_grouped_conv.py -- backward dW is the
+#      measured lane-penalty cost center). The bench default only moves
+#      on a full-model win vs the committed blockdiag 114.5 rph.
+#   3. the federated LM flagship (bench.py --lm): first hardware
+#      lm_rounds_per_hour + cost-model MFU ledger rows at d512 and the
+#      d1024/T1024 MXU-saturating shape (bench_lm.py measured 41.9%
+#      single-step MFU at d1024 -- the federated number shows what the
+#      round engine keeps).
+set -u
+cd "$(dirname "$0")/.."
+OUT=bench_results/r08_measured
+mkdir -p "$OUT"
+log() { echo "$(date -u +%FT%TZ) $*" >> "$OUT/watch_r8.log"; }
+
+log "watcher started (pid $$)"
+while pgrep -f "scripts/bench_lane_conv.py" > /dev/null; do
+  log "prior shoot-out process still holds the device; sleeping 120s"
+  sleep 120
+done
+while true; do
+  if timeout 300 python -c "import jax; print(jax.devices()[0])" \
+      > "$OUT/probe_r8.log" 2>&1; then
+    log "tunnel ALIVE: $(tail -1 "$OUT/probe_r8.log")"
+    break
+  fi
+  log "probe dead/timeout; sleeping 120s"
+  sleep 120
+done
+
+run_step() {  # run_step <name> <timeout_s> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  log "START $name: $*"
+  timeout "$tmo" "$@" > "$OUT/$name.out" 2> "$OUT/$name.err"
+  local rc=$?
+  log "DONE $name rc=$rc"
+  return $rc
+}
+
+WARM_CACHE="$OUT/xla_cache"
+mkdir -p "$WARM_CACHE"
+
+# 1. warm-restart at flagship shapes: cold then warm. The second run's
+#    record must show warmup_cache_misses == 0 and compile_s at
+#    cache-load scale (vs the 155-193 s cold number).
+run_step bench_warm_cold 7200 python bench.py --warmup 1 \
+  --compile_cache_dir "$WARM_CACHE"
+run_step bench_warm_hot 5400 python bench.py --warmup 1 \
+  --compile_cache_dir "$WARM_CACHE"
+
+# 2. lane-lowering A/B, warm cache (compile latency out of the
+#    measurement): committed blockdiag vs bgc vs the Pallas dW kernel.
+run_step bench_blockdiag 5400 python bench.py \
+  --compile_cache_dir "$WARM_CACHE"
+run_step bench_bgc 5400 python bench.py --lane_lowering bgc \
+  --compile_cache_dir "$WARM_CACHE"
+run_step bench_pallas_dw 5400 python bench.py --lane_lowering pallas \
+  --compile_cache_dir "$WARM_CACHE"
+
+# 3. federated LM flagship: the Shakespeare-shaped recipe and the
+#    MXU-saturating shape; both rows land in the ledger beside CIFAR.
+run_step bench_lm_fed 5400 python bench.py --lm --warmup 1 \
+  --compile_cache_dir "$WARM_CACHE"
+run_step bench_lm_fed_d1024 7200 python bench.py --lm --warmup 1 \
+  --lm_d_model 1024 --lm_layers 8 --lm_seq 1024 --lm_batch 8 \
+  --compile_cache_dir "$WARM_CACHE"
+
+log "r8 window plan complete"
+touch "$OUT/DONE_r8"
