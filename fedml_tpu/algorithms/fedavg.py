@@ -240,6 +240,21 @@ class FedAvgAPI:
         from fedml_tpu.resilience.integration import SimResilience
         self.resilience = SimResilience.from_args(args)
         self._last_res_record = None
+        # closed-loop pace steering for the simulation rounds
+        # (--pace_steering, resilience/steering.py): adapts the
+        # over-selection eps from the previous round's observed loss
+        # fraction -- the sim has no wall clock, so the deadline knobs
+        # stay put and the decision stream is a pure function of
+        # (seed, trace), bitwise-reproducible across runs. None (the
+        # default) is exactly today's sampling path.
+        from fedml_tpu.resilience.steering import PaceController
+        self.pace = PaceController.from_args(args)
+        if self.pace is not None and self.resilience is None:
+            logging.warning(
+                "--pace_steering without --overselect/--straggler_p: the "
+                "simulation rounds have no sampling loop to steer; "
+                "ignoring the flag")
+            self.pace = None
 
         seed = getattr(args, "seed", 0)
         self.rng = jax.random.PRNGKey(seed)
@@ -308,11 +323,31 @@ class FedAvgAPI:
                 return client_sampling(round_idx,
                                        len(self.train_data_local_dict),
                                        self.args.client_num_per_round)
+        if self.pace is not None and self._last_res_record is not None:
+            # steer BEFORE sampling: the previous round's loss fraction
+            # decides this round's over-selection (within bounds); the
+            # decision rides this round's record as pace/* fields
+            import dataclasses
+            prev = self._last_res_record
+            # loss is the shortfall vs the aggregation target C (surplus
+            # over-selection trimmed by "first C win" must not read as
+            # loss, or eps ratchets on its own success)
+            target = min(self.args.client_num_per_round,
+                         len(self.train_data_local_dict))
+            dec = self.pace.decide(
+                outcome=("degraded" if prev["res/degraded"]
+                         else "complete"),
+                selected=target,
+                reporting=min(prev["res/reporting"], target))
+            self.resilience.policy = dataclasses.replace(
+                self.resilience.policy, overselect=dec.overselect)
         # SimResilience.sample opens its own cohort-select span (carrying
         # the per-attempt selected/reporting attrs)
         client_indexes, record = self.resilience.sample(
             round_idx, len(self.train_data_local_dict),
             self.args.client_num_per_round)
+        if self.pace is not None:
+            record.update(self.pace.record())
         self._last_res_record = record
         return client_indexes
 

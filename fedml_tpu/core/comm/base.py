@@ -20,6 +20,14 @@ import abc
 #: instead.
 MSG_TYPE_PEER_LOST = "__peer_lost__"
 
+#: Synthesized by transports when a previously-known rank's fresh HELLO is
+#: accepted *after* the initial join (the rejoin protocol: a shed or
+#: crashed client dialing back in). ``sender_id`` is the rejoined rank.
+#: FSMs may register a handler to re-admit the rank to the alive set and
+#: future cohorts; without one the event is logged and dropped (rejoin
+#: then only restores the transport route, not cohort membership).
+MSG_TYPE_PEER_JOIN = "__peer_join__"
+
 
 class Observer(abc.ABC):
     @abc.abstractmethod

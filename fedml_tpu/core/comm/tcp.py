@@ -34,12 +34,14 @@ import logging
 import socket
 import struct
 import threading
+import time
 
 from fedml_tpu.core.locks import audited_lock, io_lock
 from fedml_tpu.observability.flightrec import get_flight_recorder
 from fedml_tpu.observability.registry import get_registry
 from fedml_tpu.compression.codec import message_from_wire
 from fedml_tpu.core.comm.base import (BaseCommunicationManager,
+                                      MSG_TYPE_PEER_JOIN,
                                       MSG_TYPE_PEER_LOST)
 from fedml_tpu.core.message import Message
 
@@ -142,6 +144,9 @@ class TcpCommManager(BaseCommunicationManager):
         self._ctr_lock = audited_lock()
         self._send_locks = {}
         self._lost_notified = set()  # see _notify_peer_lost
+        self._serve_threads = []   # rank 0: live + finished serve threads
+        # (guarded by _lock; grows when a shed/crashed rank REJOINS --
+        # the accept loop keeps running for the life of the receive loop)
         self._loop_active = False  # client receive loop running?
         self._stopping = False  # our own teardown (quenches PEER_LOST)
         if self.rank == 0:
@@ -171,7 +176,6 @@ class TcpCommManager(BaseCommunicationManager):
         else:
             # retry the dial until the server is up (launch order between
             # hosts is not coordinated) or the timeout elapses
-            import time
             deadline = time.monotonic() + timeout
             while True:
                 try:
@@ -255,7 +259,7 @@ class TcpCommManager(BaseCommunicationManager):
                 # the peer died between lookup and write: unroute it and
                 # dispatch PEER_LOST (dedup'd against its serve thread),
                 # then surface a typed error to the direct caller
-                self._drop_peer(receiver, lost=True)
+                self._drop_peer(receiver, lost=True, conn=dest)
                 raise ConnectionError(
                     f"peer rank {receiver} transport died mid-send "
                     "(MSG_TYPE_PEER_LOST dispatched)") from e
@@ -284,16 +288,38 @@ class TcpCommManager(BaseCommunicationManager):
             # startup) must not mutate the dict mid-iteration
             with self._lock:
                 peers = list(self._peers.items())
-            threads = [threading.Thread(target=self._serve_peer,
-                                        args=(conn, rank), daemon=True)
-                       for rank, conn in peers]
+                self._serve_threads = [
+                    threading.Thread(target=self._serve_peer,
+                                     args=(conn, rank), daemon=True,
+                                     name=f"tcp-serve-{rank}")
+                    for rank, conn in peers]
+                threads = list(self._serve_threads)
             for t in threads:
                 t.start()
-            for t in threads:
-                t.join()
-            # mirror the client branch: when the loop ends because every
-            # peer died (no STOP ever arrived), release the listener and
-            # quench late notifications instead of leaking the port
+            # rejoin protocol: keep accepting HELLOs for the life of the
+            # loop -- a shed/crashed rank that dials back in is re-routed
+            # and announced to the FSM via MSG_TYPE_PEER_JOIN
+            accept_thread = threading.Thread(target=self._accept_rejoins,
+                                             daemon=True,
+                                             name="tcp-accept-rejoins")
+            accept_thread.start()
+            # dynamic join: rejoins add serve threads after startup, so a
+            # fixed join list would miss them. Exit when no serve thread
+            # is live AND the run stopped (or every peer is gone with no
+            # STOP -- the pre-rejoin semantics, preserved).
+            while True:
+                with self._lock:
+                    threads = list(self._serve_threads)
+                live = [t for t in threads if t.is_alive()]
+                if live:
+                    live[0].join(timeout=0.2)
+                    continue
+                with self._lock:
+                    has_peers = bool(self._peers)
+                if not self._running or not has_peers:
+                    break
+                time.sleep(0.05)  # zero live threads but a rejoin is
+                # mid-admission: give its serve thread a tick to appear
             self._running = False
             self._stopping = True
             self.close()
@@ -330,13 +356,69 @@ class TcpCommManager(BaseCommunicationManager):
                 self._loop_active = False
                 self.close()  # release the server's serve thread promptly
 
+    def _accept_rejoins(self):
+        """Rejoin protocol (rank 0): accept HELLOs after the initial
+        join, for the life of the receive loop. A fresh HELLO from a
+        rank that is *not currently routed* (it crashed, was shed, or
+        said goodbye) is re-admitted: routed, given a serve thread, its
+        peer-lost dedup cleared (a second death must notify again), and
+        announced to the observers as ``MSG_TYPE_PEER_JOIN`` so the FSM
+        can return it to the alive set. Invalid or duplicate HELLOs
+        close the connection -- the loop itself must never die to one
+        bad dialer."""
+        try:
+            self._listener.settimeout(0.25)
+        except OSError:
+            return  # already closed: teardown won the race
+        while self._running:
+            try:
+                conn, _addr = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed: teardown
+            try:
+                conn.settimeout(10.0)
+                hello = json.loads(_recv_frame(conn).decode())
+                peer_rank = int(hello["rank"])
+                conn.settimeout(None)  # see __init__: idle != dead
+                _enable_keepalive(conn)
+            except (ValueError, KeyError, TypeError, UnicodeDecodeError,
+                    ConnectionError, OSError):
+                logging.warning("tcp hub: undecodable rejoin HELLO -- "
+                                "closing")
+                _hard_close(conn)
+                continue
+            with self._lock:
+                bad = (peer_rank <= 0 or peer_rank >= self.world_size
+                       or peer_rank in self._peers)
+                if not bad:
+                    self._peers[peer_rank] = conn
+                    self._send_locks[peer_rank] = io_lock()
+                    self._lost_notified.discard(peer_rank)
+            if bad:
+                logging.warning(
+                    "tcp hub: rejected rejoin HELLO rank %s (duplicate "
+                    "or out-of-range for world size %s)", peer_rank,
+                    self.world_size)
+                _hard_close(conn)
+                continue
+            t = threading.Thread(target=self._serve_peer,
+                                 args=(conn, peer_rank), daemon=True,
+                                 name=f"tcp-serve-{peer_rank}")
+            with self._lock:
+                self._serve_threads.append(t)
+            t.start()
+            logging.warning("tcp hub: rank %d rejoined", peer_rank)
+            self._notify_peer_join(peer_rank)
+
     def _serve_peer(self, conn, peer_rank):
         while self._running:
             try:
                 frame = _recv_frame(conn)
             except (ConnectionError, OSError):
                 # dead peer (no GOODBYE, no STOP): unroute + tell the FSM
-                self._drop_peer(peer_rank, lost=True)
+                self._drop_peer(peer_rank, lost=True, conn=conn)
                 return
             except ValueError:
                 # oversized frame header: a desynchronized or hostile
@@ -345,7 +427,7 @@ class TcpCommManager(BaseCommunicationManager):
                 # routed with nobody reading its pipe)
                 logging.exception("tcp hub: unframeable stream from rank "
                                   "%s", peer_rank)
-                self._drop_peer(peer_rank, lost=True)
+                self._drop_peer(peer_rank, lost=True, conn=conn)
                 return
             self._count_in(len(frame))
             try:
@@ -359,7 +441,7 @@ class TcpCommManager(BaseCommunicationManager):
                 # codec bug and should crash this serve thread.
                 logging.exception("tcp hub: undecodable frame from rank "
                                   "%s", peer_rank)
-                self._drop_peer(peer_rank, lost=True)
+                self._drop_peer(peer_rank, lost=True, conn=conn)
                 return
             fr = get_flight_recorder()
             if fr is not None:
@@ -367,7 +449,7 @@ class TcpCommManager(BaseCommunicationManager):
                           dst=self.rank, bytes=len(frame), transport="tcp")
             if msg.get_type() == MSG_TYPE_GOODBYE:
                 # clean hang-up: unroute WITHOUT a peer-lost dispatch
-                self._drop_peer(peer_rank, lost=False)
+                self._drop_peer(peer_rank, lost=False, conn=conn)
                 return
             if msg.get_type() == MSG_TYPE_PEER_LOST:
                 # reserved: transport-synthesized only. An in-band frame
@@ -419,17 +501,30 @@ class TcpCommManager(BaseCommunicationManager):
                         # DESTINATION died mid-relay; its own serve thread
                         # may race to report it -- _drop_peer dedups. The
                         # sender's pipe is healthy: keep serving it.
-                        self._drop_peer(receiver, lost=True)
+                        self._drop_peer(receiver, lost=True, conn=dest)
 
-    def _drop_peer(self, peer_rank, lost):
+    def _drop_peer(self, peer_rank, lost, conn=None):
         """Unroute a peer; when ``lost`` (EOF/send-failure, not GOODBYE)
         also dispatch MSG_TYPE_PEER_LOST. The pop doubles as dedup: two
         threads can observe the same death (the peer's serve thread and a
-        relaying sibling), only the one that wins the pop notifies."""
+        relaying sibling), only the one that wins the pop notifies.
+
+        ``conn`` is the socket the caller observed failing. Since the
+        rejoin protocol, a rank can be RE-admitted while a stale send on
+        its old socket is still blocked — popping by rank alone would
+        then evict (and hard-close) the healthy rejoined connection and
+        fire a spurious PEER_LOST. The pop only proceeds when the routed
+        connection IS the one that failed; a stale socket is just closed."""
         with self._lock:
-            was = self._peers.pop(peer_rank, None)
-            self._send_locks.pop(peer_rank, None)
+            was = self._peers.get(peer_rank)
+            if was is not None and (conn is None or was is conn):
+                del self._peers[peer_rank]
+                self._send_locks.pop(peer_rank, None)
+            else:
+                was = None
         if was is None:
+            if conn is not None:
+                _hard_close(conn)  # the stale (already-replaced) socket
             return
         # close eagerly: after the pop, close() can no longer reach this
         # socket, and a CLOSE_WAIT fd must not wait for GC. (Also FINs the
@@ -465,6 +560,25 @@ class TcpCommManager(BaseCommunicationManager):
         lost = Message(MSG_TYPE_PEER_LOST, peer_rank, self.rank)
         for obs in list(self._observers):
             obs.receive_message(MSG_TYPE_PEER_LOST, lost)
+
+    def _notify_peer_join(self, peer_rank):
+        """Dispatch MSG_TYPE_PEER_JOIN for an accepted rejoin (mirrors
+        ``_notify_peer_lost``; no dedup needed -- the accept loop admits
+        a rank at most once while it is routed)."""
+        if self._stopping:
+            return
+        fr = get_flight_recorder()
+        if fr is not None:
+            fr.record("peer_join", peer=peer_rank, observer=self.rank,
+                      transport="tcp")
+        reg = get_registry()
+        if reg is not None:
+            reg.inc("fed_peer_rejoins_total",
+                    help="previously lost/shed ranks re-admitted by a "
+                         "fresh HELLO", transport="tcp")
+        joined = Message(MSG_TYPE_PEER_JOIN, peer_rank, self.rank)
+        for obs in list(self._observers):
+            obs.receive_message(MSG_TYPE_PEER_JOIN, joined)
 
     def _dispatch(self, msg: Message) -> bool:
         if msg.get_type() == "__stop__":
@@ -580,4 +694,5 @@ class TcpCommManager(BaseCommunicationManager):
             _hard_close(self._sock)
 
 
-__all__ = ["TcpCommManager", "MSG_TYPE_PEER_LOST", "MSG_TYPE_GOODBYE"]
+__all__ = ["TcpCommManager", "MSG_TYPE_PEER_LOST", "MSG_TYPE_PEER_JOIN",
+           "MSG_TYPE_GOODBYE"]

@@ -141,6 +141,11 @@ def add_base_args(parser: argparse.ArgumentParser):
     # BucketedStreamRunner): the massive-cohort knobs
     from fedml_tpu.resilience.async_agg import add_async_args
     add_async_args(p)
+    # closed-loop pace steering (fedml_tpu.resilience.steering): the
+    # controller that consumes the perfmon histograms -- adapts
+    # buffer_k/flush_deadline/deadline/overselect within --pace_*_bounds
+    from fedml_tpu.resilience.steering import add_steering_args
+    add_steering_args(p)
     # observability knobs (fedml_tpu.observability): span tracing, trace
     # export dir, control-plane flight recorder
     from fedml_tpu.observability import add_observability_args

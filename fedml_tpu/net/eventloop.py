@@ -57,6 +57,7 @@ from fedml_tpu.observability.registry import get_registry
 from fedml_tpu.compression.codec import (message_from_wire,
                                          message_to_wire_views)
 from fedml_tpu.core.comm.base import (BaseCommunicationManager,
+                                      MSG_TYPE_PEER_JOIN,
                                       MSG_TYPE_PEER_LOST)
 from fedml_tpu.core.comm.tcp import MSG_TYPE_GOODBYE, _enable_keepalive
 from fedml_tpu.core.message import Message
@@ -353,6 +354,11 @@ class EventLoopCommManager(BaseCommunicationManager):
                 if kind == "frame":
                     if not self._dispatch_hub_frame(item[1], item[2]):
                         return
+                elif kind == "join":
+                    # rejoin: FIFO order guarantees the PEER_JOIN lands
+                    # before any frame the rejoined rank sends
+                    self._goodbye.discard(item[1])
+                    self._notify_peer_join(item[1])
                 elif kind in ("eof", "shed"):
                     rank = item[1]
                     clean = rank in self._goodbye and kind != "shed"
@@ -496,6 +502,24 @@ class EventLoopCommManager(BaseCommunicationManager):
         lost = Message(MSG_TYPE_PEER_LOST, peer_rank, self.rank)
         for obs in list(self._observers):
             obs.receive_message(MSG_TYPE_PEER_LOST, lost)
+
+    def _notify_peer_join(self, peer_rank):
+        """Dispatch MSG_TYPE_PEER_JOIN for an accepted rejoin (runs on
+        the dispatcher thread, mirroring ``_notify_peer_lost``)."""
+        if self._stopping:
+            return
+        fr = get_flight_recorder()
+        if fr is not None:
+            fr.record("peer_join", peer=peer_rank, observer=self.rank,
+                      transport="eventloop")
+        reg = get_registry()
+        if reg is not None:
+            reg.inc("fed_peer_rejoins_total",
+                    help="previously lost/shed ranks re-admitted by a "
+                         "fresh HELLO", transport="eventloop")
+        joined = Message(MSG_TYPE_PEER_JOIN, peer_rank, self.rank)
+        for obs in list(self._observers):
+            obs.receive_message(MSG_TYPE_PEER_JOIN, joined)
 
     # -- shutdown ----------------------------------------------------------
     def stop_receive_message(self):
@@ -644,13 +668,22 @@ class EventLoopCommManager(BaseCommunicationManager):
     def _handshake(self, conn, frame):
         """Server-side HELLO: route the connection by its declared rank.
         Invalid HELLOs close the connection (the loop must never raise);
-        the constructor's join timeout surfaces the misconfiguration."""
+        the constructor's join timeout surfaces the misconfiguration.
+
+        Rejoin protocol: the selector accepts for the life of the loop,
+        so a HELLO arriving *after* the initial join from a rank that is
+        not currently routed (crashed, shed by backpressure, or said
+        goodbye) re-admits it -- its peer-lost dedup is cleared (a
+        second death must notify again) and a ``join`` item is posted to
+        the dispatcher FIFO, which dispatches ``MSG_TYPE_PEER_JOIN``
+        *in order* with the rank's subsequent frames."""
         try:
             peer_rank = int(json.loads(bytes(frame).decode())["rank"])
         except (ValueError, KeyError, TypeError, UnicodeDecodeError):
             logging.warning("eventloop hub: undecodable HELLO -- closing")
             self._close_conn(conn, post=False)
             return
+        rejoin = self._joined.is_set()  # a late HELLO is a (re)join
         with self._lock:
             bad = (peer_rank <= 0 or peer_rank >= self.world_size
                    or peer_rank in self._peers)
@@ -659,6 +692,12 @@ class EventLoopCommManager(BaseCommunicationManager):
                 conn.hello = True
                 self._peers[peer_rank] = conn
                 joined = len(self._peers)
+                # a rank already marked lost is a rejoin even BEFORE the
+                # initial join completed (crash + re-dial mid-startup);
+                # the dedup clears unconditionally so a second death
+                # notifies again (same contract as tcp._accept_rejoins)
+                rejoin = rejoin or peer_rank in self._lost_notified
+                self._lost_notified.discard(peer_rank)
         if bad:
             logging.warning(
                 "eventloop hub: invalid HELLO rank %s for world size %s "
@@ -666,6 +705,9 @@ class EventLoopCommManager(BaseCommunicationManager):
                 peer_rank, self.world_size)
             self._close_conn(conn, post=False)
             return
+        if rejoin:
+            logging.warning("eventloop hub: rank %d rejoined", peer_rank)
+            self._inbox.put(("join", peer_rank))
         if joined >= self.world_size - 1:
             self._joined.set()
 

@@ -72,16 +72,31 @@ def _quadratic_step(params, rank, lr=0.25):
 
 
 def run_swarm(host, port, clients, world_size, rank_base=1, jitter_s=0.0,
-              seed=0, connect_timeout=120.0, idle_timeout=600.0):
+              seed=0, connect_timeout=120.0, idle_timeout=600.0,
+              trace_path=None):
     """Drive ``clients`` soak clients over one selector loop until the
     server stops or disconnects every one of them. Returns a summary
-    dict (connections made, reports sent, wall seconds)."""
+    dict (connections made, reports sent, wall seconds).
+
+    ``trace_path`` replays a :class:`~fedml_tpu.resilience.faults.
+    DiurnalTrace` JSON file as the reply model instead of the uniform
+    ``jitter_s``: each reply is delayed by the phase active at
+    trace-relative now (day/night arrival swings, outage latency,
+    flash crowds) and phase-dark ranks (correlated dropouts) send no
+    reply at all -- the same seeded format the pace-steering bench and
+    the distributed drivers consume, so the soak's latency histogram
+    carries a realistic arrival curve."""
     from fedml_tpu.compression.codec import message_to_wire_views
     from fedml_tpu.core.message import Message
     from fedml_tpu.compression.codec import message_from_wire
 
+    gen = None
+    if trace_path:
+        from fedml_tpu.resilience.faults import DiurnalTrace, TraceLoadGen
+        gen = TraceLoadGen(DiurnalTrace.from_file(trace_path), seed=seed)
     sel = selectors.DefaultSelector()
     rng = np.random.default_rng(seed)
+    dropped = 0
     conns = {}
     t_start = time.monotonic()
     deadline = t_start + connect_timeout
@@ -144,7 +159,7 @@ def run_swarm(host, port, clients, world_size, rank_base=1, jitter_s=0.0,
                 pass
 
     def on_frame(c, frame):
-        nonlocal reports
+        nonlocal reports, dropped
         msg = message_from_wire(frame)
         mtype = msg.get_type()
         if mtype == "__stop__":
@@ -152,6 +167,18 @@ def run_swarm(host, port, clients, world_size, rank_base=1, jitter_s=0.0,
             return
         if mtype != "res_sync":
             return  # reserved frames: nothing for a soak client to do
+        delay = None
+        if gen is not None:
+            # diurnal-trace reply model: phase-dark ranks stay silent
+            # (correlated dropout), everyone else replies at the phase's
+            # seeded delay -- the realistic arrival curve. Trace time is
+            # the generator's LAZY epoch (t=0 at the first reply), so the
+            # connect burst of a big swarm cannot eat the first phases
+            action = gen.decide(c.rank, c.reports, gen.trace_time())
+            if action[0] == "drop":
+                dropped += 1
+                return
+            delay = action[1]
         params, n = _quadratic_step(msg.get("params"), c.rank)
         out = Message("res_report", c.rank, 0)
         out.add("params", params)
@@ -164,10 +191,11 @@ def run_swarm(host, port, clients, world_size, rank_base=1, jitter_s=0.0,
         frame_views = [memoryview(_HDR.pack(nbytes))] + views
         c.reports += 1
         reports += 1
-        if jitter_s > 0:
-            # seeded reply jitter: the report-latency histogram's tail
-            c.due = (time.monotonic() + float(rng.random()) * jitter_s,
-                     frame_views)
+        if delay is None and jitter_s > 0:
+            # seeded uniform reply jitter (the pre-trace model)
+            delay = float(rng.random()) * jitter_s
+        if delay:
+            c.due = (time.monotonic() + delay, frame_views)
         else:
             c.tx.extend(frame_views)
             flush(c)
@@ -209,7 +237,7 @@ def run_swarm(host, port, clients, world_size, rank_base=1, jitter_s=0.0,
                 on_readable(c)
             if mask & selectors.EVENT_WRITE and c.rank in conns:
                 flush(c)
-        if jitter_s > 0:
+        if jitter_s > 0 or gen is not None:
             now = time.monotonic()
             for c in list(conns.values()):
                 if c.due is not None and now >= c.due[0]:
@@ -218,7 +246,8 @@ def run_swarm(host, port, clients, world_size, rank_base=1, jitter_s=0.0,
                     flush(c)
     sel.close()
     return {"connections": connected, "reports": reports,
-            "unfinished": len(conns),
+            "dropped": dropped, "unfinished": len(conns),
+            "trace": bool(gen is not None),
             "wall_s": round(time.monotonic() - t_start, 3)}
 
 
@@ -226,11 +255,14 @@ def run_soak(n_clients, total_updates=3, host="localhost", port=None,
              buffer_k=None, flush_deadline_s=30.0, jitter_s=0.5,
              high_watermark=32 * 2 ** 20, join_timeout=600.0,
              handshake_timeout=None, init_params=None,
-             metrics_logger=None):
+             metrics_logger=None, trace_path=None, pace_controller=None):
     """The soak scenario: a real buffered-async server over the event
     loop, ``n_clients`` swarm connections from a subprocess. Arm
     ``observability.enable(perfmon=True, status_path=...)`` around this
     call to get the ``status.json`` + latency-histogram evidence.
+    ``trace_path`` makes the swarm replay a DiurnalTrace JSON file
+    instead of uniform jitter (see :func:`run_swarm`);
+    ``pace_controller`` arms closed-loop pace steering on the server.
     Returns ``(server, swarm_summary_dict)``."""
     import socket as _socket
 
@@ -251,11 +283,13 @@ def run_soak(n_clients, total_updates=3, host="localhost", port=None,
         staleness_decay=0.5, flush_deadline_s=float(flush_deadline_s))
     # the swarm dials with retry, so spawn it first and let the server's
     # listener come up under the burst
+    cmd = [sys.executable, "-m", "fedml_tpu.net.soak", "--swarm",
+           "--host", host, "--port", str(port), "--clients", str(n_clients),
+           "--world", str(world), "--jitter_s", str(jitter_s)]
+    if trace_path:
+        cmd += ["--trace", str(trace_path)]
     proc = subprocess.Popen(
-        [sys.executable, "-m", "fedml_tpu.net.soak", "--swarm",
-         "--host", host, "--port", str(port), "--clients", str(n_clients),
-         "--world", str(world), "--jitter_s", str(jitter_s)],
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
     try:
         comm = EventLoopCommManager(
             host, port, 0, world,
@@ -264,7 +298,7 @@ def run_soak(n_clients, total_updates=3, host="localhost", port=None,
             low_watermark=high_watermark // 4)
         server = AsyncBufferedFedAvgServer(
             None, comm, world, init_params, total_updates, policy,
-            metrics_logger=metrics_logger)
+            metrics_logger=metrics_logger, pace_controller=pace_controller)
         server.register_message_receive_handlers()
         server.start()
         import threading
@@ -302,13 +336,18 @@ def _main(argv=None):
     p.add_argument("--world", type=int, required=True)
     p.add_argument("--jitter_s", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--trace", type=str, default=None,
+                   help="DiurnalTrace JSON file: replay its arrival "
+                        "curve (per-phase reply delays + correlated "
+                        "dropouts) instead of uniform --jitter_s")
     args = p.parse_args(argv)
     if not args.swarm:
         p.error("only the --swarm role has a CLI; run_soak is the "
                 "parent-side API")
     logging.basicConfig(level=logging.INFO)
     summary = run_swarm(args.host, args.port, args.clients, args.world,
-                        jitter_s=args.jitter_s, seed=args.seed)
+                        jitter_s=args.jitter_s, seed=args.seed,
+                        trace_path=args.trace)
     sys.stdout.write(json.dumps(summary) + "\n")
     return 0
 

@@ -139,11 +139,7 @@ class PerfMonitor:
         with self._lock:
             self._round_ends.append(now)
             self.rounds += 1
-            rph = None
-            if len(self._round_ends) >= 2:
-                span = self._round_ends[-1] - self._round_ends[0]
-                if span > 0:
-                    rph = 3600.0 * (len(self._round_ends) - 1) / span
+            rph = self._rph_locked()
         reg = get_registry()
         if reg is not None:
             reg.observe("fed_round_seconds", float(seconds),
@@ -156,10 +152,30 @@ class PerfMonitor:
                             help="wall seconds per executed client step "
                                  "(round time / true steps)")
             if rph is not None:
-                reg.set_gauge("fed_rounds_per_hour", round(rph, 2),
+                reg.set_gauge("fed_rounds_per_hour", rph,
                               help="rolling rounds/hour over the last "
                                    "window of rounds")
         return rph
+
+    def _rph_locked(self):
+        """THE rolling rounds/hour formula (callers hold ``_lock``):
+        one definition feeds the gauge, ``rounds_per_hour()`` and
+        ``record()`` so they can never drift apart."""
+        if len(self._round_ends) < 2:
+            return None
+        span = self._round_ends[-1] - self._round_ends[0]
+        if span <= 0:
+            return None
+        return round(3600.0 * (len(self._round_ends) - 1) / span, 2)
+
+    def rounds_per_hour(self):
+        """Current rolling rounds/hour (None until two observations) --
+        the same value the ``fed_rounds_per_hour`` gauge holds, exposed
+        so both distributed servers can put the live pace in their
+        ``status.json`` snapshot on either paradigm (sync rounds and
+        async flushes feed the one gauge)."""
+        with self._lock:
+            return self._rph_locked()
 
     def observe_report_latency(self, seconds):
         """Seconds from a round attempt's open to one client report --
@@ -236,11 +252,9 @@ class PerfMonitor:
         with self._lock:
             out = {prefix + "rounds_observed": self.rounds,
                    prefix + "reports_observed": self.reports}
-            if len(self._round_ends) >= 2:
-                span = self._round_ends[-1] - self._round_ends[0]
-                if span > 0:
-                    out[prefix + "rounds_per_hour"] = round(
-                        3600.0 * (len(self._round_ends) - 1) / span, 2)
+            rph = self._rph_locked()
+            if rph is not None:
+                out[prefix + "rounds_per_hour"] = rph
         if self.status is not None:
             out[prefix + "status_path"] = self.status.path
             out[prefix + "status_writes"] = self.status.writes

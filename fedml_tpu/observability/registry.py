@@ -156,6 +156,21 @@ class MetricsRegistry:
                 return (v.total, v.count)
             return v
 
+    def histogram_buckets(self, name, **labels):
+        """Raw bucket layout + per-bucket counts of one histogram
+        series: ``((upper_edges..., inf), (counts...,))``, or None for a
+        missing series. The pace controller (resilience/steering.py)
+        diffs successive snapshots to quantile the *window* between two
+        control decisions -- the cumulative distribution would let a
+        long quiet phase mask a regime change."""
+        with self._lock:
+            m = self._metrics.get(name)
+            v = (m["series"].get(_label_key(labels))
+                 if m is not None else None)
+            if not isinstance(v, _Hist):
+                return None
+            return (v.buckets + (math.inf,), tuple(v.counts))
+
     def histogram_quantile(self, name, q, **labels):
         """Approximate quantile of one histogram series from its bucket
         counts: the upper edge of the first bucket whose cumulative count

@@ -6,12 +6,19 @@ dies with it; production FL at scale is defined by churn (Bonawitz et al.,
 failure a first-class, *testable* event for the control plane:
 
 - ``faults``      -- deterministic, seeded fault injection over any
-                     transport (drop/delay/duplicate/reorder/stall/kill).
+                     transport (drop/delay/duplicate/reorder/stall/kill),
+                     plus the diurnal trace-driven load generator
+                     (day/night arrival swings, correlated dropouts,
+                     outages, flash crowds -- replayable JSON traces).
 - ``policy``      -- send retry with exponential backoff; over-selection,
                      report deadlines, quorum, round abandonment.
 - ``async_agg``   -- FedBuff-style buffered ASYNC aggregation: fold
                      updates as they arrive, staleness-weighted, server
                      update every K folds -- no round barrier.
+- ``steering``    -- closed-loop pace steering: the server adapts
+                     buffer_k / flush deadline / report deadline /
+                     over-selection from its own live histograms, within
+                     operator bounds (``--pace_steering``).
 - ``recovery``    -- round-granular crash/resume over utils/checkpoint.
 - ``integration`` -- wiring into FedAvg-family algorithms, the comm
                      managers, MetricsLogger, and the experiment flags.
@@ -25,8 +32,10 @@ from fedml_tpu.resilience.async_agg import (AsyncAggPolicy,
                                             add_async_args,
                                             run_async_tcp_fedavg,
                                             staleness_weight)
-from fedml_tpu.resilience.faults import (ACTIONS, FaultPlan, FaultRule,
-                                         FaultyCommManager)
+from fedml_tpu.resilience.faults import (ACTIONS, DiurnalTrace, FaultPlan,
+                                         FaultRule, FaultyCommManager,
+                                         LoadPhase, TraceLoadGen,
+                                         TraceShapedCommManager)
 from fedml_tpu.resilience.integration import (ResilientFedAvgClient,
                                               ResilientFedAvgServer,
                                               SimResilience,
@@ -41,9 +50,13 @@ from fedml_tpu.resilience.policy import (ROUND_ABANDONED, ROUND_COMPLETE,
                                          fold_entries_fp64,
                                          send_with_retry)
 from fedml_tpu.resilience.recovery import RoundRecovery
+from fedml_tpu.resilience.steering import (PaceBounds, PaceController,
+                                           PaceDecision, add_steering_args)
 
 __all__ = [
     "ACTIONS", "FaultRule", "FaultPlan", "FaultyCommManager",
+    "LoadPhase", "DiurnalTrace", "TraceLoadGen", "TraceShapedCommManager",
+    "PaceBounds", "PaceController", "PaceDecision", "add_steering_args",
     "RetryPolicy", "RoundPolicy", "RoundController", "PeerUnreachableError",
     "send_with_retry", "aggregate_reports", "fold_entries_fp64",
     "ROUND_COMPLETE", "ROUND_DEGRADED", "ROUND_ABANDONED",

@@ -44,6 +44,7 @@ population shows, simulated on one chip.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import threading
 import time
@@ -53,7 +54,7 @@ from typing import Optional
 import numpy as np
 
 from fedml_tpu.core.locks import audited_lock, audited_rlock
-from fedml_tpu.core.comm.base import MSG_TYPE_PEER_LOST
+from fedml_tpu.core.comm.base import MSG_TYPE_PEER_JOIN, MSG_TYPE_PEER_LOST
 from fedml_tpu.core.message import Message
 from fedml_tpu.core.managers import ServerManager
 from fedml_tpu.observability.perfmon import get_perf_monitor
@@ -331,7 +332,8 @@ class AsyncBufferedFedAvgServer(ServerManager):
     def __init__(self, args, comm, size, init_params, total_updates,
                  async_policy: AsyncAggPolicy,
                  retry_policy: Optional[RetryPolicy] = None,
-                 metrics_logger=None, timer_factory=threading.Timer):
+                 metrics_logger=None, timer_factory=threading.Timer,
+                 pace_controller=None):
         super().__init__(args, comm, rank=0, size=size)
         self.params = {k: np.asarray(v) for k, v in init_params.items()}
         self.total_updates = int(total_updates)
@@ -344,7 +346,15 @@ class AsyncBufferedFedAvgServer(ServerManager):
         self.history = []     # params after each flush
         self.flush_log = []   # per-flush sorted contributor ranks
         self.counters = {"reports": 0, "late_reports": 0,
-                         "clients_dropped": 0, "retries": 0}
+                         "clients_dropped": 0, "clients_rejoined": 0,
+                         "retries": 0}
+        # closed-loop pace steering (resilience/steering.py): when armed,
+        # each flush re-decides buffer_k/flush_deadline from the live
+        # arrival rate + windowed latency tail, within operator bounds.
+        # None = today's fixed-knob path, bit for bit.
+        self.pace = pace_controller
+        self._pace_window_t = time.time()   # flush-window open (arrival
+        self._pace_window_reports = 0       # rate feed; _advance_lock)
         self._timer_factory = timer_factory
         self._timer = None
         self._last_flush_reason = None
@@ -369,6 +379,8 @@ class AsyncBufferedFedAvgServer(ServerManager):
                                               self._on_report)
         self.register_message_receive_handler(MSG_TYPE_PEER_LOST,
                                               self._on_peer_lost)
+        self.register_message_receive_handler(MSG_TYPE_PEER_JOIN,
+                                              self._on_peer_join)
 
     def start(self):
         with self._advance_lock:
@@ -424,6 +436,8 @@ class AsyncBufferedFedAvgServer(ServerManager):
                 {k: np.asarray(v) for k, v in msg.get("params").items()},
                 staleness=staleness)
             self.counters["reports"] += 1
+            if self.pace is not None:
+                self._pace_window_reports += 1
             if self.agg.ready(target=len(self.alive)):
                 done, syncs = self._flush_locked("buffer_k")
             else:
@@ -472,6 +486,32 @@ class AsyncBufferedFedAvgServer(ServerManager):
         self._send_syncs(syncs)
         self._report_health()
 
+    def _on_peer_join(self, msg):
+        """Rejoin protocol: a previously shed/lost rank dialed back in
+        (fresh transport HELLO). Re-admit it to the alive set and hand
+        it the CURRENT model so it contributes from the next flush
+        window -- capacity that comes back must not stay dead for the
+        run (ROADMAP control-plane follow-up (c))."""
+        rank = int(msg.get_sender_id())
+        sync = None
+        with self._advance_lock:
+            if (self.failed is not None
+                    or self.agg.version >= self.total_updates):
+                logging.info("async server: rank %d rejoined after run "
+                             "end (ignored)", rank)
+                return
+            if rank in self.alive:
+                logging.info("async server: duplicate peer-join for rank "
+                             "%d (already alive)", rank)
+                return
+            self.alive.add(rank)
+            self.counters["clients_rejoined"] += 1
+            sync = self._make_sync_locked(rank)
+            logging.warning("async server: rank %d rejoined (%d alive)",
+                            rank, len(self.alive))
+        self._send_syncs([sync])
+        self._report_health()
+
     def _report_health(self):
         """Push a health snapshot to the perf monitor's status.json (and
         the update-pace histogram) -- called from handler threads AFTER
@@ -495,10 +535,15 @@ class AsyncBufferedFedAvgServer(ServerManager):
                             "complete" if self.agg.version
                             >= self.total_updates else "running"),
             }
+            if self.pace is not None:
+                fields["pace"] = self.pace.status_fields()
             dts, self._pending_flush_dts = self._pending_flush_dts, []
         for dt in dts:
             mon.observe_round(dt)  # flush-to-flush pace: the barrier-free
             # "round" time, feeding the rolling rounds/hour gauge
+        rph = mon.rounds_per_hour()
+        if rph is not None:
+            fields["rounds_per_hour"] = rph
         mon.status_update(force=fields["outcome"] != "running", **fields)
 
     # -- flush machinery (runs UNDER _advance_lock) ------------------------
@@ -521,11 +566,20 @@ class AsyncBufferedFedAvgServer(ServerManager):
                      "max staleness %d%s", res.version, self.total_updates,
                      reason, res.clients, res.max_staleness,
                      " [degraded]" if degraded else "")
+        if self.pace is not None:
+            # closed-loop steering: one decision per flush, AFTER the
+            # degraded call above (degraded is judged by the policy the
+            # flush actually ran under). Arrival rate = reports folded
+            # across the window just closed; the latency/staleness
+            # windows come from the registry histograms.
+            self._steer_locked(reason, res.clients)
         if self.metrics_logger is not None:
             rec = {"update": res.version, "async/flush_reason": reason,
                    "async/flush_clients": res.clients,
                    "async/flush_degraded": int(degraded)}
             rec.update(self.agg.record())
+            if self.pace is not None:
+                rec.update(self.pace.record())
             self.metrics_logger(rec)
         done = res.version >= self.total_updates
         syncs = []
@@ -533,6 +587,32 @@ class AsyncBufferedFedAvgServer(ServerManager):
             for r in sorted(set(res.contributors) & self.alive):
                 syncs.append(self._make_sync_locked(r))
         return done, syncs
+
+    def _steer_locked(self, flush_reason, flush_clients):
+        """One pace decision (runs UNDER ``_advance_lock``; the registry
+        reads take only the registry's own lock). The decided
+        buffer_k/flush_deadline replace the frozen policy on both the
+        server and the aggregator -- ``ready()`` and the deadline timer
+        read the new values from the next fold on."""
+        now = time.time()
+        window_s = max(now - self._pace_window_t, 1e-6)
+        rate = self._pace_window_reports / window_s
+        self._pace_window_reports = 0
+        self._pace_window_t = now
+        dec = self.pace.decide(flush_reason=flush_reason,
+                               flush_clients=flush_clients,
+                               arrival_rate=rate,
+                               obs=self.pace.observe_registry())
+        if (dec.buffer_k != self.async_policy.buffer_k
+                or dec.flush_deadline_s
+                != self.async_policy.flush_deadline_s):
+            self.async_policy = dataclasses.replace(
+                self.async_policy, buffer_k=dec.buffer_k,
+                flush_deadline_s=dec.flush_deadline_s)
+            self.agg.policy = self.async_policy
+            logging.info("async server: pace steering -> buffer_k %d, "
+                         "flush deadline %.3fs (%s)", dec.buffer_k,
+                         dec.flush_deadline_s, dec.reason)
 
     def _arm_deadline_locked(self):
         if (self.async_policy.flush_deadline_s <= 0
@@ -577,11 +657,16 @@ def run_async_tcp_fedavg(world_size, total_updates, async_policy,
                          init_params, fault_plan=None, retry_policy=None,
                          trainer=None, metrics_logger=None,
                          host="localhost", port=None, timeout=60.0,
-                         join_timeout=90.0, transport="tcp"):
+                         join_timeout=90.0, transport="tcp",
+                         pace_controller=None, late_clients=()):
     """Drive a multi-rank TCP buffered-async FedAvg scenario in one
     process (the async analog of ``integration.run_tcp_fedavg``; clients
     are the unchanged :class:`ResilientFedAvgClient`). ``transport``
     selects the byte layer ("tcp" | "eventloop") with identical FSMs.
+    ``pace_controller`` arms closed-loop pace steering on the server;
+    ``late_clients`` is a list of ``(rank, delay_s)`` re-dials -- a
+    fresh unfaulted client that HELLOs back in after its original
+    (usually killed/shed) incarnation, exercising the rejoin protocol.
     Returns the server (``.history``, ``.flush_log``, ``.counters``,
     ``.failed``)."""
     import socket
@@ -600,14 +685,23 @@ def run_async_tcp_fedavg(world_size, total_updates, async_policy,
     # FL126 types com_manager from these instantiation sites
     evloop = transport == "eventloop"
 
-    def run_client(rank):
-        if evloop:
-            comm = EventLoopCommManager(host, port, rank, world_size,
-                                        timeout=timeout)
-        else:
-            comm = TcpCommManager(host, port, rank, world_size,
-                                  timeout=timeout)
-        if fault_plan is not None:
+    def run_client(rank, delay_s=0.0, faulted=True):
+        if delay_s:
+            time.sleep(delay_s)
+        try:
+            if evloop:
+                comm = EventLoopCommManager(host, port, rank, world_size,
+                                            timeout=timeout)
+            else:
+                comm = TcpCommManager(host, port, rank, world_size,
+                                      timeout=timeout)
+        except OSError:
+            # a late re-dial can race the run's teardown: nothing to
+            # rejoin anymore, which is a legitimate outcome
+            logging.warning("rank %d: (re)dial failed -- server gone?",
+                            rank)
+            return
+        if faulted and fault_plan is not None:
             comm = fault_plan.wrap(comm, rank)
         fsm = ResilientFedAvgClient(None, comm, rank, world_size, trainer)
         fsm.run()
@@ -615,6 +709,9 @@ def run_async_tcp_fedavg(world_size, total_updates, async_policy,
     threads = [threading.Thread(target=run_client, args=(r,), daemon=True,
                                 name=f"async-client-{r}")
                for r in range(1, world_size)]
+    threads += [threading.Thread(target=run_client, args=(r, d, False),
+                                 daemon=True, name=f"async-rejoin-{r}")
+                for r, d in late_clients]
     for t in threads:
         t.start()
     if evloop:
@@ -626,7 +723,8 @@ def run_async_tcp_fedavg(world_size, total_updates, async_policy,
                               metrics_logger=metrics_logger)
     server = AsyncBufferedFedAvgServer(
         None, comm, world_size, init_params, total_updates, async_policy,
-        retry_policy=retry_policy, metrics_logger=metrics_logger)
+        retry_policy=retry_policy, metrics_logger=metrics_logger,
+        pace_controller=pace_controller)
     server.register_message_receive_handlers()
     server.start()
     if server.agg.version < server.total_updates and server.failed is None:

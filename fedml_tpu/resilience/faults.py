@@ -232,6 +232,301 @@ class FaultyCommManager(BaseCommunicationManager):
         return getattr(self.inner, name)
 
 
+# -- diurnal trace-driven load generation ------------------------------------
+#
+# The fault rules above model *point* failures; a production fleet's
+# dominant signal is the *load curve* -- day/night arrival-rate swings,
+# correlated dropouts (a region goes dark for hours, not per-message),
+# latency outages, flash crowds (Bonawitz MLSys'19 S3). The classes
+# below make that curve a seeded, replayable schedule: a
+# :class:`DiurnalTrace` is a JSON-serializable list of phases, a
+# :class:`TraceLoadGen` derives deterministic per-(rank, event)
+# delay/dropout decisions from it, and :class:`TraceShapedCommManager`
+# applies them to any transport at send time (same ``wrap(comm, rank)``
+# surface as :class:`FaultPlan`, so ``run_tcp_fedavg``/
+# ``run_async_tcp_fedavg`` consume a trace through their existing
+# ``fault_plan=`` parameter). ``net/soak.py``'s swarm replays the same
+# JSON format (``--trace``), and :meth:`TraceLoadGen.sim_miss_fn` plugs
+# the dropout curve into ``SimResilience`` for the wall-clock-free
+# simulation rounds. Pace steering (resilience/steering.py) is proven
+# against these traces.
+
+
+@dataclass(frozen=True)
+class LoadPhase:
+    """One phase of a diurnal load curve.
+
+    Args:
+      dur_s: phase duration (trace-relative wall seconds).
+      delay_s: mean client reply delay during the phase (the arrival
+        curve: small = flash crowd / healthy day, large = outage).
+      jitter: uniform multiplicative delay jitter -- an individual reply
+        sleeps ``delay_s * (1 + jitter * U[-1, 1))``.
+      dropout_p: fraction of ranks *dark* for this phase occurrence.
+        Correlated by construction: a rank is dark (drops every shaped
+        message) for the whole occurrence, decided once from
+        ``(seed, cycle, phase_index, rank)`` -- the region-outage shape,
+        not per-message coin flips.
+      name: label for records/logs ("day", "night", "outage", ...).
+    """
+
+    dur_s: float
+    delay_s: float = 0.0
+    jitter: float = 0.5
+    dropout_p: float = 0.0
+    name: str = ""
+
+    def __post_init__(self):
+        if self.dur_s <= 0:
+            raise ValueError("LoadPhase.dur_s must be > 0")
+        if not 0.0 <= self.dropout_p <= 1.0:
+            raise ValueError("LoadPhase.dropout_p must be in [0, 1]")
+
+
+class DiurnalTrace:
+    """A seeded, repeating (or one-shot) sequence of load phases,
+    JSON-round-trippable so a measured curve replays bit-identically
+    across runs, hosts, and the soak swarm subprocess."""
+
+    def __init__(self, phases, repeat=True, seed=0):
+        self.phases = tuple(phases)
+        if not self.phases:
+            raise ValueError("DiurnalTrace needs at least one phase")
+        self.repeat = bool(repeat)
+        self.seed = int(seed)
+        self.total_s = float(sum(p.dur_s for p in self.phases))
+
+    def locate(self, t):
+        """Phase active at trace-relative time ``t``: returns
+        ``(cycle, phase_index, phase)``. Past the end of a one-shot
+        trace the last phase holds."""
+        t = max(0.0, float(t))
+        if self.repeat:
+            cycle, t = divmod(t, self.total_s)
+            cycle = int(cycle)
+        else:
+            cycle = 0
+            t = min(t, self.total_s - 1e-9)
+        acc = 0.0
+        for i, p in enumerate(self.phases):
+            acc += p.dur_s
+            if t < acc:
+                return cycle, i, p
+        return cycle, len(self.phases) - 1, self.phases[-1]
+
+    # -- JSON replay format --------------------------------------------------
+    def to_dict(self) -> dict:
+        return {"seed": self.seed, "repeat": self.repeat,
+                "phases": [{"dur_s": p.dur_s, "delay_s": p.delay_s,
+                            "jitter": p.jitter, "dropout_p": p.dropout_p,
+                            "name": p.name} for p in self.phases]}
+
+    @classmethod
+    def from_dict(cls, d) -> "DiurnalTrace":
+        return cls([LoadPhase(**p) for p in d["phases"]],
+                   repeat=bool(d.get("repeat", True)),
+                   seed=int(d.get("seed", 0)))
+
+    def to_file(self, path):
+        import json
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2)
+        return path
+
+    @classmethod
+    def from_file(cls, path) -> "DiurnalTrace":
+        import json
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    @classmethod
+    def example(cls, scale=1.0, dropout=0.5, seed=0) -> "DiurnalTrace":
+        """The canonical day/outage/night/flash curve the steering bench
+        and the ci soak smoke replay (scaled; see docs/RESILIENCE.md
+        "Pace steering"). The outage leads so a fixed short deadline
+        meets it before finishing the run; the night's correlated
+        dropouts make the cohort target unreachable, so every fixed
+        config pays its full deadline per night round."""
+        s = float(scale)
+        return cls([
+            LoadPhase(dur_s=0.4 * s, delay_s=0.05, jitter=0.5,
+                      name="day"),
+            LoadPhase(dur_s=6.0 * s, delay_s=1.5, jitter=0.2,
+                      name="outage"),
+            LoadPhase(dur_s=15.0 * s, delay_s=0.3, jitter=0.5,
+                      dropout_p=dropout, name="night"),
+            LoadPhase(dur_s=0.4 * s, delay_s=0.02, jitter=0.5,
+                      name="flash"),
+        ], repeat=True, seed=seed)
+
+
+class TraceLoadGen:
+    """Deterministic decision stream over a :class:`DiurnalTrace`.
+
+    Every decision is a pure function of ``(seed, keys)`` -- dark ranks
+    are keyed ``(seed, cycle, phase_index, rank)`` (correlated for the
+    whole phase occurrence), reply delays ``(seed, rank, event_index)``
+    (reproducible given the same per-rank send sequence, exactly the
+    :class:`FaultPlan` contract). ``wrap(comm, rank)`` matches
+    ``FaultPlan.wrap`` so the run drivers take a trace through their
+    ``fault_plan=`` parameter unchanged.
+    """
+
+    def __init__(self, trace: DiurnalTrace, seed=None,
+                 msg_type: str = "res_report", clock=time.monotonic,
+                 population=None):
+        self.trace = trace
+        self.seed = trace.seed if seed is None else int(seed)
+        self.msg_type = msg_type
+        self._clock = clock
+        # LAZY epoch: trace time 0 is the FIRST shaped event, not
+        # generator construction -- transport handshakes (hundreds of
+        # ms at tens of ranks) must not eat the first phase, or two
+        # configs compared "on the same trace" see different curves
+        self._epoch = None
+        # known population => dark sets are exact-count (a seeded
+        # permutation's first round(p*n) ranks), not per-rank Bernoulli:
+        # "half the fleet is dark" then means exactly half, which is
+        # both the correlated-outage shape and what keeps quorum math
+        # deterministic in the steering bench/tests
+        self.population = (tuple(sorted(int(r) for r in population))
+                           if population is not None else None)
+
+    def reset_epoch(self):
+        """Re-arm the lazy epoch (t=0 becomes the next shaped event)."""
+        self._epoch = None
+
+    def trace_time(self):
+        if self._epoch is None:
+            self._epoch = self._clock()
+        return self._clock() - self._epoch
+
+    def dark(self, cycle, phase_index, rank, p) -> bool:
+        if p <= 0:
+            return False
+        if p >= 1:
+            return True
+        if self.population is not None:
+            k = int(round(p * len(self.population)))
+            if k <= 0:
+                return False
+            perm = np.random.default_rng(
+                (self.seed, int(cycle), int(phase_index))).permutation(
+                    len(self.population))
+            return int(rank) in {self.population[i] for i in perm[:k]}
+        rng = np.random.default_rng(
+            (self.seed, int(cycle), int(phase_index), int(rank)))
+        return bool(rng.random() < p)
+
+    def reply_delay(self, rank, event_index, phase: LoadPhase) -> float:
+        if phase.delay_s <= 0:
+            return 0.0
+        u = np.random.default_rng(
+            (self.seed, 7, int(rank), int(event_index))).random()
+        return float(phase.delay_s * (1.0 + phase.jitter * (2.0 * u - 1.0)))
+
+    def decide(self, rank, event_index, t):
+        """``("drop", phase)`` or ``("delay", seconds, phase)`` for one
+        shaped message at trace time ``t``."""
+        cycle, idx, phase = self.trace.locate(t)
+        if self.dark(cycle, idx, rank, phase.dropout_p):
+            return ("drop", phase)
+        return ("delay", self.reply_delay(rank, event_index, phase), phase)
+
+    def wrap(self, comm: BaseCommunicationManager,
+             rank: int) -> "TraceShapedCommManager":
+        return TraceShapedCommManager(comm, self, rank)
+
+    def sim_miss_fn(self, round_s=1.0):
+        """Deadline-miss oracle for ``SimResilience(miss_fn=...)``: the
+        simulation rounds have no wall clock, so round ``r`` maps to
+        virtual trace time ``r * round_s`` and a client misses when its
+        phase marks it dark. Pure function of (seed, round, client) --
+        the bitwise-reproducible half of the steering determinism
+        gate."""
+
+        def miss(round_idx, attempt, client_id):
+            del attempt  # an abandoned re-run re-samples, same phase
+            cycle, idx, phase = self.trace.locate(
+                float(round_idx) * float(round_s))
+            return self.dark(cycle, idx, client_id, phase.dropout_p)
+
+        return miss
+
+
+class TraceShapedCommManager(BaseCommunicationManager):
+    """Send-side trace shaper: only ``gen.msg_type`` messages (client
+    reports, by default) are delayed/dropped -- control traffic (HELLO,
+    syncs, GOODBYE) flows clean, exactly like a slow-uplink device whose
+    downlink still works.
+
+    Unlike :class:`FaultyCommManager`'s ``delay`` action (which stalls
+    the *sender thread*, modelling a busy device), the trace delay is
+    delivered by a timer -- it models network/uplink LATENCY: the
+    client's handler thread is immediately free for the next sync, so
+    consecutive round attempts see independent delays instead of one
+    slow device serializing them (which would cascade abandons under a
+    deadline prober). The decision stream stays on the sender thread
+    (one sender per rank, the :class:`_RankFaults` contract); only the
+    delivery hops threads."""
+
+    def __init__(self, inner: BaseCommunicationManager, gen: TraceLoadGen,
+                 rank: int, timer_factory=threading.Timer):
+        self.inner = inner
+        self.gen = gen
+        self.rank = int(rank)
+        self._timer_factory = timer_factory
+        self._events = 0
+        self.dropped = 0
+        self.delayed_s = 0.0
+
+    def send_message(self, msg: Message, **kw):
+        if msg.get_type() != self.gen.msg_type:
+            self.inner.send_message(msg, **kw)
+            return
+        idx = self._events
+        self._events += 1
+        action = self.gen.decide(self.rank, idx, self.gen.trace_time())
+        if action[0] == "drop":
+            self.dropped += 1
+            logging.info("trace: rank %d dark in phase %r -- dropping "
+                         "send #%d", self.rank, action[1].name, idx)
+            return
+        _, delay, _phase = action
+        if delay <= 0:
+            self.inner.send_message(msg, **kw)
+            return
+        self.delayed_s += delay
+        t = self._timer_factory(delay, self._deliver, args=(msg, kw))
+        t.daemon = True
+        t.start()
+
+    def _deliver(self, msg, kw):
+        try:
+            self.inner.send_message(msg, **kw)
+        except (ConnectionError, OSError, KeyError):
+            # the run ended (or the peer died) while this reply was in
+            # flight: a real network would drop it on the floor too
+            logging.debug("trace: rank %d delayed send arrived after "
+                          "teardown", self.rank)
+
+    # -- pass-through ------------------------------------------------------
+    def add_observer(self, observer):
+        self.inner.add_observer(observer)
+
+    def remove_observer(self, observer):
+        self.inner.remove_observer(observer)
+
+    def handle_receive_message(self):
+        self.inner.handle_receive_message()
+
+    def stop_receive_message(self):
+        self.inner.stop_receive_message()
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
 class _DeadFilter:
     """Observer interposer: drops deliveries after the wrapper died (a
     crashed process cannot handle the messages already in its mailbox).
@@ -248,4 +543,6 @@ class _DeadFilter:
         self.wrapped.receive_message(msg_type, msg_params)
 
 
-__all__ = ["ACTIONS", "FaultRule", "FaultPlan", "FaultyCommManager"]
+__all__ = ["ACTIONS", "FaultRule", "FaultPlan", "FaultyCommManager",
+           "LoadPhase", "DiurnalTrace", "TraceLoadGen",
+           "TraceShapedCommManager"]

@@ -23,6 +23,7 @@ Three integration surfaces:
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import threading
 import time
@@ -31,7 +32,7 @@ from typing import Optional
 import numpy as np
 
 from fedml_tpu.core.locks import audited_rlock
-from fedml_tpu.core.comm.base import MSG_TYPE_PEER_LOST
+from fedml_tpu.core.comm.base import MSG_TYPE_PEER_JOIN, MSG_TYPE_PEER_LOST
 from fedml_tpu.core.managers import ClientManager, ServerManager
 from fedml_tpu.core.message import Message
 from fedml_tpu.observability.perfmon import get_perf_monitor
@@ -277,7 +278,7 @@ class ResilientFedAvgServer(ServerManager):
                  round_policy: RoundPolicy,
                  retry_policy: Optional[RetryPolicy] = None,
                  cohort_target: Optional[int] = None, cohort_override=None,
-                 recovery=None, metrics_logger=None):
+                 recovery=None, metrics_logger=None, pace_controller=None):
         super().__init__(args, comm, rank=0, size=size)
         self.params = {k: np.asarray(v) for k, v in init_params.items()}
         self.rounds = int(rounds)
@@ -294,7 +295,18 @@ class ResilientFedAvgServer(ServerManager):
         self.history = []          # per-round aggregated params
         self.reporting_log = []    # per-round sorted reporting ranks
         self.counters = {"rounds_degraded": 0, "rounds_abandoned": 0,
-                         "clients_dropped": 0, "retries": 0, "resumes": 0}
+                         "clients_dropped": 0, "clients_rejoined": 0,
+                         "retries": 0, "resumes": 0}
+        # closed-loop pace steering (resilience/steering.py): when armed,
+        # every round decision re-derives deadline_s/overselect from the
+        # windowed report-latency tail + observed loss fraction, within
+        # operator bounds. None = today's fixed-policy path, bit for bit.
+        self.pace = pace_controller
+        self._last_selected = 0  # last cohort size (over-selection incl.)
+        self._last_target = 0    # last aggregation target C -- the loss
+        # denominator the controller tracks (reports short of C is the
+        # shortfall over-selection exists to cover; selected/C would
+        # read surplus over-selection itself as loss and ratchet)
         self._controller = RoundController(
             round_policy, self._on_round_complete, self._on_round_abandoned)
         # one detached span per round attempt (begun at _open_round on the
@@ -326,6 +338,8 @@ class ResilientFedAvgServer(ServerManager):
                                               self._on_report)
         self.register_message_receive_handler(MSG_TYPE_PEER_LOST,
                                               self._on_peer_lost)
+        self.register_message_receive_handler(MSG_TYPE_PEER_JOIN,
+                                              self._on_peer_join)
 
     def start(self):
         """Kick off round 0 (or the checkpointed round on resume).
@@ -380,6 +394,8 @@ class ResilientFedAvgServer(ServerManager):
             cohort = _sample_ranks(self.round_idx, self.attempt, alive,
                                    self.round_policy.select_count(
                                        target, len(alive)))
+        self._last_selected = len(cohort)
+        self._last_target = target
         self._controller.begin(self.round_idx, self.attempt, cohort, target)
         self._round_t0 = (time.time()
                           if get_perf_monitor() is not None else None)
@@ -492,6 +508,8 @@ class ResilientFedAvgServer(ServerManager):
             self.attempt = 0
             done = self.round_idx >= self.rounds
             if not done:
+                if self.pace is not None:
+                    self._steer_locked(outcome, len(reports))
                 syncs = self._open_round()
                 span = self._round_span
             done = done or self.failed is not None
@@ -518,6 +536,10 @@ class ResilientFedAvgServer(ServerManager):
                 self._fail(f"round {self.round_idx} abandoned "
                            f"{self.attempt} times")
             else:
+                if self.pace is not None:
+                    # abandon-backoff: the re-run attempt opens with a
+                    # longer deadline, not the one that just starved
+                    self._steer_locked("abandoned", len(reports))
                 syncs = self._open_round()
                 span = self._round_span
             done = self.failed is not None
@@ -526,6 +548,43 @@ class ResilientFedAvgServer(ServerManager):
             self._report_health()
             return
         self._send_syncs(syncs, span)
+        self._report_health()
+
+    def _steer_locked(self, outcome, n_reports):
+        """One pace decision per round turnover (runs UNDER
+        ``_advance_lock``). The decided deadline/overselect replace the
+        frozen ``RoundPolicy`` on both the server and the controller, so
+        the NEXT ``begin()`` arms the steered deadline."""
+        dec = self.pace.decide(outcome=outcome,
+                               selected=self._last_target,
+                               reporting=min(n_reports, self._last_target),
+                               obs=self.pace.observe_registry())
+        if (dec.deadline_s != self.round_policy.deadline_s
+                or dec.overselect != self.round_policy.overselect):
+            self.round_policy = dataclasses.replace(
+                self.round_policy, deadline_s=dec.deadline_s,
+                overselect=dec.overselect)
+            self._controller.policy = self.round_policy
+            logging.info("server: pace steering -> deadline %.3fs, "
+                         "overselect %.3f (%s)", dec.deadline_s,
+                         dec.overselect, dec.reason)
+
+    def _on_peer_join(self, msg):
+        """Rejoin protocol: a previously shed/lost rank's fresh HELLO
+        was accepted by the transport -- re-admit it to the alive set so
+        the next ``_open_round`` can sample it (mid-flight rounds are
+        untouched: the rank is not in the current cohort and a report
+        from it would land in the late counter)."""
+        rank = int(msg.get_sender_id())
+        with self._advance_lock:
+            if self.failed is not None or rank in self.alive:
+                logging.info("server: peer-join for rank %d ignored "
+                             "(already alive or run failed)", rank)
+                return
+            self.alive.add(rank)
+            self.counters["clients_rejoined"] += 1
+        logging.warning("server: rank %d rejoined -- eligible from the "
+                        "next cohort", rank)
         self._report_health()
 
     def _report_health(self):
@@ -548,9 +607,16 @@ class ResilientFedAvgServer(ServerManager):
                 "alive_ranks": sorted(self.alive),
                 "clients_dropped": self.counters["clients_dropped"],
             }
+            if self.pace is not None:
+                fields["pace"] = self.pace.status_fields()
             dt, self._pending_round_dt = self._pending_round_dt, None
         if dt is not None:
             mon.observe_round(dt)
+        rph = mon.rounds_per_hour()
+        if rph is not None:
+            # the one pace metric both paradigms report (async feeds it
+            # flush-to-flush): steered-vs-fixed comparisons read this
+            fields["rounds_per_hour"] = rph
         mon.status_update(force=True, **fields)  # decision-rate writes:
         # one per round attempt, never a hot path
 
@@ -562,6 +628,8 @@ class ResilientFedAvgServer(ServerManager):
         rec.update({f"res/{k}": v for k, v in self.counters.items()})
         rec.update({f"res/{k}": v
                     for k, v in self._controller.counters.items()})
+        if self.pace is not None:
+            rec.update(self.pace.record())
         self.metrics_logger(rec)
 
     def _fail(self, reason):
@@ -617,16 +685,22 @@ def run_tcp_fedavg(world_size, rounds, round_policy, init_params,
                    fault_plan=None, retry_policy=None, cohort_target=None,
                    cohort_override=None, trainer=None, recovery=None,
                    metrics_logger=None, host="localhost", port=None,
-                   timeout=60.0, join_timeout=90.0, transport="tcp"):
+                   timeout=60.0, join_timeout=90.0, transport="tcp",
+                   pace_controller=None, late_clients=()):
     """Drive a full multi-rank TCP FedAvg scenario in one process.
 
     Clients run in daemon threads (rank r wrapped by ``fault_plan`` when
     given); the server FSM runs its receive loop on the caller thread.
     ``transport`` selects the byte layer (``--transport``: "tcp" =
     thread-per-client hub, "eventloop" = selector loop) -- the FSMs are
-    identical either way. Returns the server (``.history``,
+    identical either way. ``pace_controller`` arms closed-loop pace
+    steering on the server (``--pace_steering``); ``late_clients`` is a
+    list of ``(rank, delay_s)`` re-dials exercising the rejoin protocol
+    (a fresh unfaulted client HELLOing back in after its original
+    incarnation was killed or shed). Returns the server (``.history``,
     ``.reporting_log``, ``.counters``, ``.failed``). Used by the ci.sh
-    chaos smokes and test_resilience.py / test_net.py.
+    chaos/steering smokes and test_resilience.py / test_net.py /
+    test_steering.py.
     """
     import socket
 
@@ -646,14 +720,22 @@ def run_tcp_fedavg(world_size, rounds, round_policy, init_params,
     # BOTH transports inside every FSM's held-lock chain analysis
     evloop = transport == "eventloop"
 
-    def run_client(rank):
-        if evloop:
-            comm = EventLoopCommManager(host, port, rank, world_size,
-                                        timeout=timeout)
-        else:
-            comm = TcpCommManager(host, port, rank, world_size,
-                                  timeout=timeout)
-        if fault_plan is not None:
+    def run_client(rank, delay_s=0.0, faulted=True):
+        if delay_s:
+            time.sleep(delay_s)
+        try:
+            if evloop:
+                comm = EventLoopCommManager(host, port, rank, world_size,
+                                            timeout=timeout)
+            else:
+                comm = TcpCommManager(host, port, rank, world_size,
+                                      timeout=timeout)
+        except OSError:
+            # a late re-dial can race teardown: nothing left to rejoin
+            logging.warning("rank %d: (re)dial failed -- server gone?",
+                            rank)
+            return
+        if faulted and fault_plan is not None:
             comm = fault_plan.wrap(comm, rank)
         fsm = ResilientFedAvgClient(None, comm, rank, world_size, trainer)
         fsm.run()
@@ -661,6 +743,9 @@ def run_tcp_fedavg(world_size, rounds, round_policy, init_params,
     threads = [threading.Thread(target=run_client, args=(r,), daemon=True,
                                 name=f"res-client-{r}")
                for r in range(1, world_size)]
+    threads += [threading.Thread(target=run_client, args=(r, d, False),
+                                 daemon=True, name=f"res-rejoin-{r}")
+                for r, d in late_clients]
     for t in threads:
         t.start()
     if evloop:
@@ -674,7 +759,7 @@ def run_tcp_fedavg(world_size, rounds, round_policy, init_params,
         None, comm, world_size, init_params, rounds, round_policy,
         retry_policy=retry_policy, cohort_target=cohort_target,
         cohort_override=cohort_override, recovery=recovery,
-        metrics_logger=metrics_logger)
+        metrics_logger=metrics_logger, pace_controller=pace_controller)
     server.register_message_receive_handlers()
     server.start()
     if server.round_idx < server.rounds and server.failed is None:

@@ -395,10 +395,14 @@ echo "== event-loop soak smoke (bench.py --soak): 1,000 swarm"
 echo "   connections through a real buffered-async server over the"
 echo "   selector transport, 3 async windows -- the record (reports/sec"
 echo "   headline + fed_report_latency_seconds p50/p90/p99 tail) feeds"
-echo "   the same throwaway perf-regression ledger. The 10k headline"
-echo "   soak is the slow-marked tests/test_net.py::TestSoak::"
-echo "   test_soak_10k (evidence in docs/NETWORKING.md) =="
-timeout -k 10 300 python bench.py --soak 1000 --ledger "$CI_LEDGER" \
+echo "   the same throwaway perf-regression ledger. The swarm replays"
+echo "   the DIURNAL trace (day/outage/night/flash arrival curve,"
+echo "   fedml_tpu.resilience.faults.DiurnalTrace) instead of uniform"
+echo "   jitter, so the latency histogram carries a realistic tail."
+echo "   The 10k headline soak is the slow-marked tests/test_net.py::"
+echo "   TestSoak::test_soak_10k (evidence in docs/NETWORKING.md) =="
+timeout -k 10 300 python bench.py --soak 1000 --soak_trace diurnal \
+    --ledger "$CI_LEDGER" \
     > bench_results/bench_soak_smoke.json
 python - <<'EOF'
 import json
@@ -408,8 +412,9 @@ assert rec["unit"] == "reports/sec" and rec["value"] > 0, rec
 assert rec["connections"] == 1000 and rec["updates"] == 3, rec
 assert rec["status_outcome"] == "complete", rec
 assert rec["report_latency_p99_s"] is not None, rec
+assert rec["jitter_model"] == "diurnal-trace", rec
 print("bench --soak:", rec["value"], "reports/sec over",
-      rec["connections"], "connections;",
+      rec["connections"], "connections (diurnal trace);",
       "p50/p99 report latency", rec["report_latency_p50_s"], "/",
       rec["report_latency_p99_s"], "s")
 EOF
@@ -436,6 +441,61 @@ if python bench.py --check-regress --ledger "$CI_LEDGER"; then
 fi
 echo "perf-regression gate: green on fresh ledger, red on 2x slowdown OK"
 rm -f "$CI_LEDGER"
+
+echo "== fedpace steering smoke (bench.py --steering): on one seeded"
+echo "   diurnal trace (day / flash crowd / latency outage / night with"
+echo "   correlated dropouts), a sweep of fixed (deadline, overselect)"
+echo "   configs vs one --pace_steering run over the real TCP control"
+echo "   plane with the perf monitor armed. Gates: (a) the short/mid"
+echo "   fixed deadlines are DISQUALIFIED by the outage (abandon-out,"
+echo "   recorded as failed) -- the reason an operator cannot just pick"
+echo "   a small deadline; (b) steered completes >= 1.10x the rounds/"
+echo "   hour of the best surviving fixed config (measured ~1.9x) with"
+echo "   final-model quality within tolerance of the unshaped full-"
+echo "   participation reference; (c) the steered record lands on the"
+echo "   throwaway ledger, --check-regress is green fresh and goes red"
+echo "   on a planted 2x rph drop. fedlint zero on resilience/ (incl."
+echo "   steering.py) is gated by the chaos-smoke section above =="
+PACE_LEDGER=bench_results/ci_pace_ledger.jsonl
+rm -f "$PACE_LEDGER"
+timeout -k 10 600 python bench.py --steering --ledger "$PACE_LEDGER" \
+    > bench_results/bench_steering_smoke.json
+python - <<'EOF'
+import json
+rec = json.loads(open("bench_results/bench_steering_smoke.json").readline())
+assert rec["unit"] == "rounds/hour" and rec["value"] > 0, rec
+assert rec["pass"] is True, rec
+assert rec["speedup_vs_best_fixed"] >= rec["speedup_threshold"] == 1.10, rec
+assert rec["steered"]["quality_rel"] <= rec["quality_tol"], rec
+failed = [f for f in rec["fixed_sweep"] if "failed" in f]
+survived = [f for f in rec["fixed_sweep"] if "rph" in f]
+assert failed and survived, \
+    "the sweep must both disqualify short deadlines and keep a best-fixed"
+led = [json.loads(l) for l in open("bench_results/ci_pace_ledger.jsonl")]
+assert led and led[-1]["metric"] == rec["metric"], \
+    "steered record did not land on the ledger"
+print("fedpace steering smoke:", rec["value"], "rph steered vs",
+      rec["best_fixed_rph"], "best fixed ->",
+      rec["speedup_vs_best_fixed"], "x; quality",
+      rec["steered"]["quality_rel"], "; disqualified fixed configs:",
+      [f["config"] for f in failed])
+EOF
+python bench.py --check-regress --ledger "$PACE_LEDGER"
+python - <<'EOF'
+import json
+from fedml_tpu.observability.perfmon import append_ledger
+rec = json.loads(open("bench_results/bench_steering_smoke.json").readline())
+slow = dict(rec)
+slow["value"] = rec["value"] / 2.0          # the planted 2x rph drop
+slow["injected_fixture"] = "2x-rph-drop"
+append_ledger(slow, "bench_results/ci_pace_ledger.jsonl")
+EOF
+if python bench.py --check-regress --ledger "$PACE_LEDGER"; then
+    echo "steering perf-regression gate FAILED to fire on the 2x rph drop"
+    exit 1
+fi
+echo "fedpace ledger gate: green on the real record, red on 2x drop OK"
+rm -f "$PACE_LEDGER"
 
 echo "== fedwarm + federated-LM flagship smoke (bench.py --lm --warmup):"
 echo "   a tiny TransformerLM federated run through FedAvgAPI + the"

@@ -1931,8 +1931,9 @@ class TestCrossClass:
         # the cited identity is _advance_lock's creation site -- the
         # same string race_audit()/the flight recorder would report
         # (line shifts when integration.py grows above __init__; PR 11
-        # moved it 307 -> 321 adding the --transport flag)
-        assert "integration.py:321" in msg
+        # moved it 307 -> 321 adding the --transport flag, PR 13 moved
+        # it 321 -> 333 adding the pace-steering/rejoin state)
+        assert "integration.py:333" in msg
         assert "_send_frame" in msg and "TcpCommManager" in msg
 
 

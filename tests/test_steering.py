@@ -1,0 +1,530 @@
+"""fedpace: closed-loop pace steering + diurnal traces + rejoin protocol.
+
+Pins the ISSUE-13 acceptance surface:
+- controller determinism (same trace + seed => identical decisions) and
+  bounds clamping (no knob ever escapes the operator bounds, including
+  under empty histograms at round 0);
+- the diurnal trace load generator (JSON replay, exact-count correlated
+  dark sets, seeded reply delays, the SimResilience miss oracle);
+- ``--pace_steering`` off => trajectories bitwise-identical to a build
+  that never heard of the flag; on => seeded-deterministic decisions and
+  a bitwise-reproducible sim trajectory;
+- shed-then-rejoin: a killed rank's fresh HELLO re-admits it to the
+  alive set and future cohorts, on BOTH transports, sync and async;
+- the sync path feeds the rolling ``fed_rounds_per_hour`` gauge (and
+  status.json), so steered-vs-fixed reads one metric on either paradigm.
+"""
+
+import math
+import types
+
+import numpy as np
+import pytest
+
+from fedml_tpu.observability import enable
+from fedml_tpu.observability.registry import MetricsRegistry
+from fedml_tpu.resilience import (AsyncAggPolicy, DiurnalTrace, FaultPlan,
+                                  FaultRule, LoadPhase, PaceBounds,
+                                  PaceController, RoundPolicy, TraceLoadGen,
+                                  run_async_tcp_fedavg, run_tcp_fedavg)
+
+W0 = {"w": np.zeros((4, 4), np.float32), "b": np.ones(4, np.float32)}
+
+SLOW_REPORTS = FaultRule("delay", msg_type="res_report", p=1.0,
+                         delay_s=0.15)
+
+
+def _trace(**kw):
+    base = dict(phases=[
+        LoadPhase(dur_s=0.5, delay_s=0.02, jitter=0.5, name="day"),
+        LoadPhase(dur_s=1.0, delay_s=0.3, jitter=0.3, dropout_p=0.5,
+                  name="night"),
+    ], repeat=True, seed=3)
+    base.update(kw)
+    return DiurnalTrace(**base)
+
+
+class TestController:
+    def _feed(self, ctl):
+        """A fixed observation script covering every rule."""
+        out = [ctl.decide()]  # round 0: empty histograms -- must hold
+        out.append(ctl.decide(outcome="abandoned", reporting=0))
+        out.append(ctl.decide(outcome="complete", selected=5, reporting=5,
+                              obs={"latency_p90": 0.5}))
+        out.append(ctl.decide(outcome="degraded", selected=5, reporting=2,
+                              obs={"latency_p90": 0.5}))
+        out.append(ctl.decide(arrival_rate=50.0, flush_reason="deadline",
+                              flush_clients=2))
+        out.append(ctl.decide(arrival_rate=0.5, flush_reason="buffer_k",
+                              flush_clients=8, obs={"latency_p90": 0.1}))
+        return [(d.deadline_s, d.flush_deadline_s, d.buffer_k,
+                 d.overselect, d.reason) for d in out]
+
+    def test_deterministic_decisions(self):
+        bounds = PaceBounds(deadline_s=(0.25, 6.0), buffer_k=(1, 128))
+        a = self._feed(PaceController(bounds, seed=7, deadline_s=1.0,
+                                      buffer_k=16))
+        b = self._feed(PaceController(bounds, seed=7, deadline_s=1.0,
+                                      buffer_k=16))
+        assert a == b
+
+    def test_round0_empty_histograms_hold(self):
+        ctl = PaceController(PaceBounds(), deadline_s=1.0, buffer_k=16,
+                             flush_deadline_s=2.0, overselect=0.1)
+        d = ctl.decide()  # no outcome, no obs, nothing
+        assert d.reason == "hold"
+        assert (d.deadline_s, d.flush_deadline_s, d.buffer_k,
+                d.overselect) == (1.0, 2.0, 16, 0.1)
+
+    def test_bounds_clamping_under_extremes(self):
+        bounds = PaceBounds(buffer_k=(2, 32), flush_deadline_s=(0.1, 2.0),
+                            deadline_s=(0.2, 3.0), overselect=(0.0, 0.4))
+        ctl = PaceController(bounds, deadline_s=100.0, buffer_k=10 ** 6,
+                             flush_deadline_s=1e-9, overselect=9.0)
+        # starting points themselves clamp
+        assert bounds.deadline_s[0] <= ctl.deadline_s <= bounds.deadline_s[1]
+        assert bounds.buffer_k[0] <= ctl.buffer_k <= bounds.buffer_k[1]
+        extremes = [
+            dict(outcome="abandoned", reporting=0),
+            dict(outcome="abandoned", reporting=0),
+            dict(outcome="abandoned", reporting=0),
+            dict(obs={"latency_p90": math.inf}),
+            dict(obs={"latency_p90": 1e-12}),
+            dict(selected=100, reporting=0),
+            dict(selected=100, reporting=100),
+            dict(arrival_rate=1e9),
+            dict(arrival_rate=1e-9),
+            dict(obs={"latency_p90": 1e6}, outcome="abandoned",
+                 reporting=0, selected=10, reporting_=None),
+        ]
+        for kw in extremes:
+            kw.pop("reporting_", None)
+            d = ctl.decide(**kw)
+            assert bounds.deadline_s[0] <= d.deadline_s \
+                <= bounds.deadline_s[1], d
+            assert bounds.flush_deadline_s[0] <= d.flush_deadline_s \
+                <= bounds.flush_deadline_s[1], d
+            assert bounds.buffer_k[0] <= d.buffer_k \
+                <= bounds.buffer_k[1], d
+            assert bounds.overselect[0] <= d.overselect \
+                <= bounds.overselect[1], d
+
+    def test_abandon_discrimination(self):
+        # zero reports = latency signal: deadline backs off
+        ctl = PaceController(PaceBounds(deadline_s=(0.1, 50.0)),
+                             deadline_s=1.0, abandon_backoff=3.0)
+        d = ctl.decide(outcome="abandoned", reporting=0)
+        assert d.deadline_s == 3.0 and "abandon-backoff" in d.reason
+        # some reports = cohort-loss signal: over-select, deadline holds
+        ctl = PaceController(PaceBounds(deadline_s=(0.1, 50.0)),
+                             deadline_s=1.0, abandon_backoff=3.0)
+        d = ctl.decide(outcome="abandoned", selected=5, reporting=2)
+        assert d.deadline_s == 1.0 and "abandon-backoff" not in d.reason
+        assert d.overselect > 0.0
+
+    def test_tail_tracking_rate_limited(self):
+        ctl = PaceController(PaceBounds(deadline_s=(0.05, 100.0)),
+                             deadline_s=1.0, latency_margin=1.25,
+                             step_up=2.0, step_down=4.0)
+        # huge tail: at most step_up per decision
+        d = ctl.decide(obs={"latency_p90": 100.0})
+        assert d.deadline_s == 2.0
+        # tiny tail: at most step_down per decision
+        d = ctl.decide(obs={"latency_p90": 0.1})
+        assert d.deadline_s == 0.5
+        d = ctl.decide(obs={"latency_p90": 0.1})
+        assert d.deadline_s == 0.125  # then settles at margin * p90
+
+    def test_buffer_k_tracks_arrival_and_flash_crowds(self):
+        ctl = PaceController(PaceBounds(buffer_k=(1, 64)), buffer_k=8,
+                             flush_deadline_s=1.0, step_up=2.0)
+        d = ctl.decide(arrival_rate=1000.0)   # flash crowd
+        assert d.buffer_k == 16               # geometric rate limit
+        d = ctl.decide(arrival_rate=1000.0)
+        assert d.buffer_k == 32
+        d = ctl.decide(arrival_rate=1000.0)
+        assert d.buffer_k == 64               # operator cap
+        d = ctl.decide(arrival_rate=0.1)      # quiet night
+        assert d.buffer_k == 16               # shrink, rate-limited
+
+    def test_windowed_quantiles_not_cumulative(self):
+        reg = MetricsRegistry()
+        ctl = PaceController()
+        for _ in range(100):   # a long sunny day
+            reg.observe("fed_report_latency_seconds", 0.05,
+                        buckets=(0.1, 0.5, 1.0))
+        obs = ctl.observe_registry(reg)
+        assert obs["latency_p90"] == 0.1
+        for _ in range(10):    # the night regime
+            reg.observe("fed_report_latency_seconds", 0.4,
+                        buckets=(0.1, 0.5, 1.0))
+        obs = ctl.observe_registry(reg)
+        # cumulative p90 would still be 0.1 (100 fast vs 10 slow); the
+        # WINDOW since the last decision is all slow
+        assert obs["latency_p90"] == 0.5
+        # empty window: no latency key at all
+        assert "latency_p90" not in ctl.observe_registry(reg)
+
+    def test_decision_series_emitted(self):
+        reg = MetricsRegistry()
+        from fedml_tpu.observability.registry import set_registry
+        prev = set_registry(reg)
+        try:
+            ctl = PaceController(deadline_s=1.0)
+            ctl.decide(obs={"latency_p90": 0.5})
+        finally:
+            set_registry(prev)
+        assert reg.get("fed_pace_deadline_seconds") == 0.625
+        assert reg.get("fed_pace_decisions_total",
+                       reason="track-tail") == 1
+
+
+class TestDiurnalTrace:
+    def test_json_roundtrip_and_locate(self, tmp_path):
+        t = _trace()
+        p = tmp_path / "trace.json"
+        t.to_file(str(p))
+        t2 = DiurnalTrace.from_file(str(p))
+        assert t2.to_dict() == t.to_dict()
+        assert t.locate(0.1)[2].name == "day"
+        assert t.locate(0.7)[2].name == "night"
+        cycle, idx, ph = t.locate(1.6)   # wrapped into cycle 1
+        assert (cycle, ph.name) == (1, "day")
+        one_shot = _trace(repeat=False)
+        assert one_shot.locate(100.0)[2].name == "night"  # last holds
+
+    def test_dark_sets_exact_count_and_correlated(self):
+        gen = TraceLoadGen(_trace(), seed=5, population=range(1, 9))
+        dark = [r for r in range(1, 9) if gen.dark(0, 1, r, 0.5)]
+        assert len(dark) == 4          # exact count, not binomial
+        # correlated: same phase occurrence -> same set, every query
+        assert dark == [r for r in range(1, 9) if gen.dark(0, 1, r, 0.5)]
+        # a different occurrence draws a different (seeded) set
+        dark2 = [r for r in range(1, 9) if gen.dark(1, 1, r, 0.5)]
+        assert len(dark2) == 4
+        gen2 = TraceLoadGen(_trace(), seed=5, population=range(1, 9))
+        assert dark == [r for r in range(1, 9) if gen2.dark(0, 1, r, 0.5)]
+
+    def test_reply_delays_seeded(self):
+        t = _trace()
+        g1, g2 = TraceLoadGen(t, seed=9), TraceLoadGen(t, seed=9)
+        night = t.phases[1]
+        d1 = [g1.reply_delay(3, i, night) for i in range(5)]
+        assert d1 == [g2.reply_delay(3, i, night) for i in range(5)]
+        lo, hi = 0.3 * (1 - 0.3), 0.3 * (1 + 0.3)
+        assert all(lo <= d <= hi for d in d1)
+
+    def test_sim_miss_fn_deterministic(self):
+        gen = TraceLoadGen(_trace(), seed=4, population=range(8))
+        miss = gen.sim_miss_fn(round_s=0.25)
+        grid = [[miss(r, 0, c) for c in range(8)] for r in range(12)]
+        miss2 = TraceLoadGen(_trace(), seed=4,
+                             population=range(8)).sim_miss_fn(round_s=0.25)
+        assert grid == [[miss2(r, 0, c) for c in range(8)]
+                        for r in range(12)]
+        # day rounds (t in [0, 0.5)) never miss; night rounds miss
+        # exactly half the population
+        assert not any(grid[0]) and not any(grid[1])
+        assert sum(grid[3]) == 4
+
+
+def _sim_args(**kw):
+    base = dict(client_num_in_total=12, client_num_per_round=6,
+                comm_round=6, epochs=1, batch_size=16, lr=0.1, wd=0.0,
+                client_optimizer="sgd", frequency_of_the_test=10 ** 9,
+                seed=0, ci=0, overselect=0.3, straggler_p=0.25,
+                quorum=0.34)
+    base.update(kw)
+    return types.SimpleNamespace(**base)
+
+
+def _run_sim(args, rounds=5):
+    import jax
+
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    from fedml_tpu.algorithms.specs import make_classification_spec
+    from fedml_tpu.data import load_synthetic_federated
+    from fedml_tpu import models
+    import jax.numpy as jnp
+
+    dataset = load_synthetic_federated(client_num=12, n_train=240,
+                                       n_test=48, feature_dim=8,
+                                       class_num=4, seed=0)
+    spec = make_classification_spec(
+        models.LogisticRegression(num_classes=4, apply_sigmoid=False),
+        jnp.zeros((1, 8)))
+    api = FedAvgAPI(dataset, spec, args)
+    records = []
+    for _ in range(rounds):
+        records.append(api.train_one_round())
+    return jax.tree.map(np.asarray, api.global_state), records, api
+
+
+class TestSimSteering:
+    def test_steered_sim_bitwise_deterministic(self):
+        """Same seed + same (simulated) trace => identical decisions AND
+        a bitwise-identical trajectory across two runs."""
+        import jax
+
+        s1, r1, api1 = _run_sim(_sim_args(pace_steering=1))
+        s2, r2, api2 = _run_sim(_sim_args(pace_steering=1))
+        for a, b in zip(jax.tree.leaves(s1), jax.tree.leaves(s2)):
+            assert (a == b).all()
+        d1 = [(d.overselect, d.reason) for d in api1.pace.decisions]
+        d2 = [(d.overselect, d.reason) for d in api2.pace.decisions]
+        assert d1 == d2 and len(d1) == 4  # rounds 1..4 steer
+        # the decision series rides the round records
+        assert any("pace/overselect" in r for r in r1)
+
+    def test_flag_off_bitwise_identical_to_no_flag(self):
+        """Switchboard discipline: --pace_steering 0 == an args namespace
+        that has no pace attribute at all, bit for bit."""
+        import jax
+
+        s_off, _, api_off = _run_sim(_sim_args(pace_steering=0))
+        ns = _sim_args()
+        assert not hasattr(ns, "pace_steering")
+        s_none, _, api_none = _run_sim(ns)
+        assert api_off.pace is None and api_none.pace is None
+        for a, b in zip(jax.tree.leaves(s_off), jax.tree.leaves(s_none)):
+            assert (a == b).all()
+
+    def test_steering_moves_overselect_within_bounds(self):
+        _, _, api = _run_sim(_sim_args(pace_steering=1,
+                                       pace_overselect_bounds="0,0.45"))
+        eps = [d.overselect for d in api.pace.decisions]
+        assert all(0.0 <= e <= 0.45 for e in eps)
+        # a 25% straggler rate must pull over-selection up off the floor
+        assert eps[-1] > 0.0
+
+    def test_steering_without_resilience_warns_off(self):
+        _, _, api = _run_sim(_sim_args(pace_steering=1, overselect=0.0,
+                                       straggler_p=0.0))
+        assert api.pace is None and api.resilience is None
+
+
+class TestSteeredServers:
+    def test_sync_server_steers_deadline_and_status(self, tmp_path):
+        pace = PaceController(PaceBounds(deadline_s=(0.25, 6.0)),
+                              seed=0, deadline_s=2.0)
+        plan = FaultPlan(seed=1, rules=(SLOW_REPORTS,))
+        with enable(perfmon=True, flightrec_dir=str(tmp_path),
+                    compile_events=False) as obs:
+            srv = run_tcp_fedavg(4, 4, RoundPolicy(deadline_s=2.0,
+                                                   quorum=0.34),
+                                 W0, fault_plan=plan,
+                                 pace_controller=pace, join_timeout=90)
+        assert srv.failed is None and len(srv.history) == 4
+        assert len(pace.decisions) == 3   # one per completed turnover
+        # the 0.15 s report tail tracks the deadline DOWN from 2.0
+        assert pace.deadline_s < 2.0
+        assert srv.round_policy.deadline_s == pace.deadline_s
+        import json
+        status = json.load(open(obs.status_path))
+        assert status["pace"]["decisions"] == 3
+        assert status["pace"]["deadline_s"] == pace.deadline_s
+        assert obs.registry.get("fed_pace_deadline_seconds") is not None
+
+    def test_async_server_steers_buffer_within_bounds(self):
+        trace = _trace(phases=[
+            LoadPhase(dur_s=0.6, delay_s=0.02, jitter=0.5, name="flash"),
+            LoadPhase(dur_s=0.6, delay_s=0.4, jitter=0.3, name="night"),
+        ], seed=2)
+        gen = TraceLoadGen(trace, seed=2, population=range(1, 5))
+        bounds = PaceBounds(buffer_k=(1, 3), flush_deadline_s=(0.2, 2.0))
+        pace = PaceController(bounds, seed=0, buffer_k=2,
+                              flush_deadline_s=1.0)
+        pol = AsyncAggPolicy(buffer_k=2, staleness_decay=0.5,
+                             flush_deadline_s=1.0)
+        with enable(perfmon=True, compile_events=False):
+            srv = run_async_tcp_fedavg(5, 6, pol, W0, fault_plan=gen,
+                                       pace_controller=pace,
+                                       join_timeout=120)
+        assert srv.failed is None and srv.agg.version == 6
+        assert len(pace.decisions) == 6   # one per flush
+        for d in pace.decisions:
+            assert bounds.buffer_k[0] <= d.buffer_k <= bounds.buffer_k[1]
+            assert bounds.flush_deadline_s[0] <= d.flush_deadline_s \
+                <= bounds.flush_deadline_s[1]
+        # the steered policy actually replaced the frozen one
+        assert srv.async_policy.buffer_k == pace.buffer_k
+        assert srv.agg.policy is srv.async_policy
+
+
+class TestRejoin:
+    @pytest.mark.parametrize("transport", ["tcp", "eventloop"])
+    def test_shed_then_rejoin_sync(self, transport):
+        """A killed rank's fresh HELLO re-admits it: alive set, future
+        cohorts, and its reports aggregate again -- on both transports."""
+        plan = FaultPlan(seed=5, rules=(
+            FaultRule("kill", rank=3, msg_type="res_report", nth=1),
+            SLOW_REPORTS,
+        ))
+        srv = run_tcp_fedavg(4, 8, RoundPolicy(deadline_s=2.0,
+                                               quorum=0.3),
+                             W0, fault_plan=plan, late_clients=((3, 1.0),),
+                             join_timeout=120, transport=transport)
+        assert srv.failed is None and len(srv.history) == 8
+        assert srv.counters["clients_dropped"] == 1
+        assert srv.counters["clients_rejoined"] == 1
+        early = [r for r in srv.reporting_log[:2] if 3 in r]
+        late = [r for r in srv.reporting_log[2:] if 3 in r]
+        assert late, "rejoined rank never contributed to a later round"
+        del early  # the kill fires on rank 3's FIRST report
+
+    def test_shed_then_rejoin_async(self):
+        plan = FaultPlan(seed=5, rules=(
+            FaultRule("kill", rank=3, msg_type="res_report", nth=1),
+            FaultRule("delay", msg_type="res_report", p=1.0, delay_s=0.2),
+        ))
+        pol = AsyncAggPolicy(buffer_k=3, staleness_decay=0.5,
+                             flush_deadline_s=2.0)
+        srv = run_async_tcp_fedavg(4, 8, pol, W0, fault_plan=plan,
+                                   late_clients=((3, 1.0),),
+                                   join_timeout=120)
+        assert srv.failed is None and srv.agg.version == 8
+        assert srv.counters["clients_rejoined"] == 1
+        assert any(3 in c for c in srv.flush_log[2:]), \
+            "rejoined rank never folded into a later flush"
+
+    def test_eventloop_rejoin_clears_peer_lost_dedup(self):
+        """kill -> rejoin -> kill again: the second death must notify
+        again (the rejoin clears the per-peer PEER_LOST dedup), and the
+        rejoin itself must dispatch MSG_TYPE_PEER_JOIN -- keyed off the
+        rank's lost state, not only the initial-join latch."""
+        import json as _json
+        import socket
+        import struct
+        import threading
+        import time as _time
+
+        from fedml_tpu.core.comm.base import (MSG_TYPE_PEER_JOIN,
+                                              MSG_TYPE_PEER_LOST)
+        from fedml_tpu.net.eventloop import EventLoopCommManager
+
+        hdr = struct.Struct("!I")
+        s = socket.socket()
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+        s.close()
+
+        def dial(rank):
+            deadline = _time.monotonic() + 20.0
+            while True:  # the hub's listener may not be up yet
+                try:
+                    c = socket.create_connection(("localhost", port),
+                                                 timeout=10)
+                    break
+                except OSError:
+                    if _time.monotonic() >= deadline:
+                        raise
+                    _time.sleep(0.05)
+            hello = _json.dumps({"rank": rank}).encode()
+            c.sendall(hdr.pack(len(hello)) + hello)
+            return c
+
+        events = []
+
+        class Obs:
+            def receive_message(self, t, msg):
+                if str(t) in (MSG_TYPE_PEER_LOST, MSG_TYPE_PEER_JOIN):
+                    events.append((str(t), int(msg.get_sender_id())))
+
+        dials = {}
+        dialers = []
+        for r in (1, 2):
+            t = threading.Thread(target=lambda r=r: dials.update(
+                {r: dial(r)}), daemon=True)
+            t.start()
+            dialers.append(t)
+        hub = EventLoopCommManager("localhost", port, 0, 3, timeout=30)
+        for t in dialers:
+            t.join(timeout=10)
+        hub.add_observer(Obs())
+        loop = threading.Thread(target=hub.handle_receive_message,
+                                daemon=True)
+        loop.start()
+        try:
+            def wait_for(pred, timeout=10.0):
+                deadline = _time.monotonic() + timeout
+                while _time.monotonic() < deadline:
+                    if pred():
+                        return True
+                    _time.sleep(0.02)
+                return False
+
+            dials[1].close()  # crash #1: EOF without GOODBYE
+            assert wait_for(lambda: (MSG_TYPE_PEER_LOST, 1) in events)
+            dials[1] = dial(1)  # rejoin
+            assert wait_for(lambda: (MSG_TYPE_PEER_JOIN, 1) in events)
+            dials[1].close()  # crash #2 must notify AGAIN
+            assert wait_for(lambda: events.count(
+                (MSG_TYPE_PEER_LOST, 1)) == 2), events
+        finally:
+            hub.stop_receive_message()
+            for c in dials.values():
+                try:
+                    c.close()
+                except OSError:
+                    pass
+            loop.join(timeout=10)
+
+    def test_duplicate_hello_still_rejected(self):
+        """A HELLO for a rank that is ALIVE stays invalid: rejoin only
+        re-admits ranks the hub actually lost."""
+        import socket, struct, json as _json, time as _time
+
+        from fedml_tpu.core.comm.tcp import TcpCommManager
+        hdr = struct.Struct("!I")
+        s = socket.socket()
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+        s.close()
+        import threading
+        clients = []
+
+        def client(rank):
+            c = TcpCommManager("localhost", port, rank, 3, timeout=30)
+            clients.append(c)
+
+        ts = [threading.Thread(target=client, args=(r,), daemon=True)
+              for r in (1, 2)]
+        for t in ts:
+            t.start()
+        hub = TcpCommManager("localhost", port, 0, 3, timeout=30)
+        loop = threading.Thread(target=hub.handle_receive_message,
+                                daemon=True)
+        loop.start()
+        _time.sleep(0.3)
+        dup = socket.create_connection(("localhost", port), timeout=5)
+        hello = _json.dumps({"rank": 1}).encode()  # rank 1 is alive
+        dup.sendall(hdr.pack(len(hello)) + hello)
+        # the hub must close the duplicate, not reroute rank 1
+        dup.settimeout(5.0)
+        assert dup.recv(1) == b""  # EOF = rejected
+        dup.close()
+        with hub._lock:
+            assert 1 in hub._peers
+        hub.stop_receive_message()
+        for c in clients:
+            c.close()
+        loop.join(timeout=10)
+
+
+class TestSyncRoundsPerHour:
+    def test_sync_path_feeds_rolling_gauge_and_status(self, tmp_path):
+        """The one pace metric both paradigms report: a sync run's round
+        decisions populate fed_rounds_per_hour and the status snapshot."""
+        import json
+
+        with enable(perfmon=True, flightrec_dir=str(tmp_path),
+                    compile_events=False) as obs:
+            srv = run_tcp_fedavg(4, 4, RoundPolicy(deadline_s=5.0,
+                                                   quorum=0.5),
+                                 W0, join_timeout=60)
+        assert srv.failed is None
+        rph = obs.registry.get("fed_rounds_per_hour")
+        assert rph is not None and rph > 0
+        status = json.load(open(obs.status_path))
+        assert status["server"] == "resilient"
+        assert status["rounds_per_hour"] > 0
