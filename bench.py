@@ -807,7 +807,8 @@ def run_soak_bench(args):
         server, summary = run_soak(
             n, total_updates=int(args.soak_updates),
             jitter_s=float(args.soak_jitter), trace_path=trace_file,
-            join_timeout=max(300.0, n / 10.0))
+            join_timeout=max(300.0, n / 10.0),
+            decode_workers=int(args.soak_decode_workers))
     wall_s = time.time() - t0
     if server.failed is not None:
         print(json.dumps({"metric": "eventloop-soak", "error":
@@ -818,6 +819,12 @@ def run_soak_bench(args):
     assert status.get("final") is True, status
     reports = server.counters["reports"]
     q = obs.registry.histogram_quantile
+    # ingest-stage accounting (ISSUE 14): frames decoded + decode wall
+    # seconds on the server transport -- decode-seconds-per-report is
+    # the quantity the batched/parallel ingest pipeline exists to move
+    ingest = server.com_manager.ingest_stats()
+    decode_s_per_report = (ingest["decode_s"] / ingest["frames"]
+                           if ingest["frames"] else None)
     out = {
         "metric": f"eventloop-soak reports/sec ({n} connections, "
                   "async buffered)",
@@ -836,11 +843,32 @@ def run_soak_bench(args):
         "transport": "eventloop",
         "jitter_model": ("diurnal-trace" if trace_file else "uniform"),
         "swarm_dropped": summary.get("dropped", 0),
+        "decode_workers": ingest["workers"],
+        "ingest_frames": ingest["frames"],
+        "ingest_decode_s": ingest["decode_s"],
+        "decode_s_per_report": (round(decode_s_per_report, 9)
+                                if decode_s_per_report else None),
     }
     print(json.dumps(out), flush=True)
     if args.ledger:
         from fedml_tpu.observability.perfmon import append_ledger
         append_ledger(out, args.ledger)
+        if ingest["frames"] and ingest["decode_s"] > 0:
+            # second ledger row: decode THROUGHPUT (frames per decode
+            # second -- higher is better, so --check-regress's one-sided
+            # gate fires on a decode slowdown even when wall-clock
+            # reports/sec is masked by reply jitter)
+            decode_rec = {
+                "metric": f"eventloop-soak decode frames/sec "
+                          f"({n} connections)",
+                "value": round(ingest["frames"] / ingest["decode_s"], 1),
+                "unit": "frames/decode-sec",
+                "decode_workers": ingest["workers"],
+                "ingest_frames": ingest["frames"],
+                "decode_s_per_report": out["decode_s_per_report"],
+            }
+            print(json.dumps(decode_rec), flush=True)
+            append_ledger(decode_rec, args.ledger)
     return 0
 
 
@@ -1031,6 +1059,13 @@ def main():
                         "the swarm's reply model instead of uniform "
                         "--soak_jitter ('diurnal' = the built-in "
                         "day/outage/night/flash curve, dropout-free)")
+    p.add_argument("--soak_decode_workers", type=int, default=1,
+                   help="soak bench: parallel frame-decode workers on "
+                        "the server transport (net/ingest.py DecodeStage"
+                        "; 1 = inline dispatcher decode). Trajectories "
+                        "are identical at any setting -- only decode "
+                        "throughput moves (decode_s_per_report on the "
+                        "record)")
     p.add_argument("--steering", action="store_true",
                    help="fedpace headline bench (resilience/steering.py):"
                         " on one seeded diurnal trace, run a small sweep "

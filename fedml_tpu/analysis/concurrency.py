@@ -88,6 +88,12 @@ _EVENTLOOP_BLOCKING_NAMES = {"_send_frame", "_recv_frame",
 _LOOP_REGISTER_ATTRS = {"register", "modify", "add_reader", "add_writer",
                         "call_soon", "call_soon_threadsafe", "call_later",
                         "call_at"}
+#: Constructors whose callable arguments become decode-worker roots
+#: (``net/ingest.py DecodeStage``): a decode callback runs on a shard
+#: worker that serves EVERY peer hashed to it -- one blocked decode
+#: stalls the shard exactly like a blocked loop callback stalls the
+#: loop, so the callback is held to the same FL129 grammar.
+_DECODE_STAGE_CTORS = {"DecodeStage"}
 
 #: Public aliases: the cross-class pass (``analysis.crossclass``, FL126)
 #: shares this pass's vocabulary -- lock-constructor classification and
@@ -467,9 +473,19 @@ class _EventLoopChecker:
                  if isinstance(fn, ast.AsyncFunctionDef)}
         for fn in self.methods.values():
             for node in ast.walk(fn):
-                if not (isinstance(node, ast.Call)
-                        and isinstance(node.func, ast.Attribute)
-                        and node.func.attr in _LOOP_REGISTER_ATTRS):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                is_sink = (isinstance(f, ast.Attribute)
+                           and f.attr in _LOOP_REGISTER_ATTRS)
+                if not is_sink:
+                    # decode-stage construction: DecodeStage(n, self.m,
+                    # out) roots `m` -- the method runs on shard workers
+                    last = (f.id if isinstance(f, ast.Name) else
+                            f.attr if isinstance(f, ast.Attribute)
+                            else None)
+                    is_sink = last in _DECODE_STAGE_CTORS
+                if not is_sink:
                     continue
                 for arg in list(node.args) + [kw.value
                                               for kw in node.keywords]:

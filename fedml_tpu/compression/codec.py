@@ -119,11 +119,25 @@ def encode_array(x) -> bytes:
     return b"".join(encode_array_views(x))
 
 
-def decode_array(buf: bytes, offset: int = 0):
-    """Decode one array frame at ``offset``; returns ``(array, new_offset)``."""
+def decode_array(buf, offset: int = 0):
+    """Decode one array frame at ``offset``; returns ``(array, new_offset)``.
+
+    ``buf`` may be ``bytes``, ``bytearray``, or a ``memoryview`` over the
+    transport's receive buffer. The decode twin of
+    :func:`encode_array_views`: a contiguous little-endian payload of a
+    native dtype is returned as an ``np.frombuffer`` view that ALIASES
+    ``buf`` -- zero payload copies from the wire to the aggregator fold
+    (``np.shares_memory``-pinned in tests). The view is marked read-only
+    when the backing buffer is mutable, and it keeps the buffer alive by
+    reference: the retention contract is that a transport hands each
+    frame buffer off whole and never writes into it again (the event
+    loop allocates a fresh ``bytearray`` per frame). Layouts the wire
+    cannot alias -- bool bit-packing, extension dtypes (bf16), and
+    big-endian hosts -- fall back to the one-conversion copying path,
+    byte-equal."""
     (nlen,) = struct.unpack_from("!B", buf, offset)
     offset += 1
-    name = buf[offset:offset + nlen].decode("ascii")
+    name = bytes(buf[offset:offset + nlen]).decode("ascii")
     offset += nlen
     (ndim,) = struct.unpack_from("!B", buf, offset)
     offset += 1
@@ -134,21 +148,34 @@ def decode_array(buf: bytes, offset: int = 0):
         offset += _DIM.size
     (nbytes,) = _DIM.unpack_from(buf, offset)
     offset += _DIM.size
-    payload = buf[offset:offset + nbytes]
-    if len(payload) != nbytes:
+    if len(buf) - offset < nbytes:
         raise ValueError("codec: truncated array payload")
-    offset += nbytes
     dt = _resolve_dtype(name)
     size = int(np.prod(shape, dtype=np.int64)) if shape else 1
     if dt == np.bool_:
-        bits = np.unpackbits(np.frombuffer(payload, np.uint8), count=size)
+        bits = np.unpackbits(
+            np.frombuffer(buf, np.uint8, count=nbytes, offset=offset),
+            count=size)
         arr = bits.astype(np.bool_).reshape(shape)
-    else:
-        arr = np.frombuffer(payload, dt)
+    elif name in _EXTRA_DTYPES or (sys.byteorder == "big"
+                                   and dt.itemsize > 1):
+        # copying path: extension dtypes stay off the aliasing fast path
+        # (conservative across numpy versions), and a big-endian host
+        # must byteswap off the little-endian wire anyway
+        arr = np.frombuffer(bytes(buf[offset:offset + nbytes]), dt)
         if sys.byteorder == "big" and dt.itemsize > 1:
-            arr = arr.byteswap()  # wire is little-endian, host is not
+            arr = arr.byteswap()
         arr = arr.reshape(shape)
-    return arr, offset
+    else:
+        if nbytes != size * dt.itemsize:
+            raise ValueError("codec: array payload size mismatch")
+        arr = np.frombuffer(buf, dt, count=size, offset=offset)
+        if arr.flags.writeable:
+            # aliases a mutable receive buffer: freeze the view so no
+            # consumer can corrupt a sibling array sharing the frame
+            arr.flags.writeable = False
+        arr = arr.reshape(shape)
+    return arr, offset + nbytes
 
 
 def _is_array(v) -> bool:
@@ -217,16 +244,29 @@ def encode_tree(tree) -> bytes:
     return b"".join(encode_tree_views(tree))
 
 
-def decode_tree(data: bytes):
-    """Inverse of :func:`encode_tree`."""
+def parse_wire_header(data):
+    """Parse ONLY a binary frame's JSON control header: returns
+    ``(header, offset)`` where ``header`` is the msg_params dict with
+    ``{"__nd__": i}`` markers still in place and ``offset`` is where the
+    array frames begin. This is the amortized half of a batched decode
+    (one pass per chunk) and the whole decode a relay needs -- the hubs
+    route on ``header["receiver"]`` and re-queue the RAW frame, so a
+    relayed tensor payload is never decoded at all."""
     if len(data) < 2 or data[0] != MAGIC:
         raise ValueError("codec: not a binary tree frame")
     if data[1] != VERSION:
         raise ValueError(f"codec: unsupported wire version {data[1]}")
     (hlen,) = _HDR_LEN.unpack_from(data, 2)
     off = 2 + _HDR_LEN.size
-    header = json.loads(data[off:off + hlen].decode())
-    off += hlen
+    header = json.loads(bytes(data[off:off + hlen]).decode())
+    return header, off + hlen
+
+
+def decode_tree(data):
+    """Inverse of :func:`encode_tree`; accepts ``bytes`` | ``bytearray``
+    | ``memoryview`` (array payloads alias it -- see
+    :func:`decode_array`)."""
+    header, off = parse_wire_header(data)
     arrays = []
     while off < len(data):
         arr, off = decode_array(data, off)
@@ -276,25 +316,105 @@ def message_to_wire_views(msg) -> list:
     return encode_tree_views(msg.get_params())
 
 
-def message_from_wire(data: bytes):
-    """Binary OR legacy-JSON frame -> ``Message`` (first-byte sniff: 0x9E
-    is the binary magic and cannot start a JSON document)."""
-    from fedml_tpu.core.message import Message
-    msg = Message()
-    if data[:1] == bytes((MAGIC,)):
-        params = decode_tree(data)
-        msg.init(params)
-        msg.type = str(params[Message.MSG_ARG_KEY_TYPE])
-        msg.sender_id = params[Message.MSG_ARG_KEY_SENDER]
-        msg.receiver_id = params[Message.MSG_ARG_KEY_RECEIVER]
-        return msg
-    msg.init_from_json_string(
-        data.decode() if isinstance(data, (bytes, bytearray)) else data)
+def _message_from_params(message_cls, params):
+    msg = message_cls()
+    msg.init(params)
+    msg.type = str(params[message_cls.MSG_ARG_KEY_TYPE])
+    msg.sender_id = params[message_cls.MSG_ARG_KEY_SENDER]
+    msg.receiver_id = params[message_cls.MSG_ARG_KEY_RECEIVER]
     return msg
+
+
+def _is_binary(data) -> bool:
+    """First-byte sniff (0x9E cannot start a JSON document), for any
+    bytes-like ``data``; str (legacy JSON text) is never binary."""
+    if isinstance(data, str):
+        return False
+    return len(data) >= 1 and data[0] == MAGIC
+
+
+def message_from_wire(data):
+    """Binary OR legacy-JSON frame -> ``Message`` (first-byte sniff: 0x9E
+    is the binary magic and cannot start a JSON document). Accepts
+    ``bytes`` | ``bytearray`` | ``memoryview`` | ``str``; binary tensor
+    payloads alias ``data`` (see :func:`decode_array`)."""
+    from fedml_tpu.core.message import Message
+    if _is_binary(data):
+        return _message_from_params(Message, decode_tree(data))
+    msg = Message()
+    msg.init_from_json_string(
+        data if isinstance(data, str) else bytes(data).decode())
+    return msg
+
+
+def message_from_header(header, data, offset):
+    """Second half of a split decode: ``parse_wire_header`` gave
+    ``(header, offset)``; this decodes the array frames from ``offset``
+    and builds the ``Message`` -- the header JSON is parsed exactly
+    once per frame even when the caller routed on it first."""
+    from fedml_tpu.core.message import Message
+    arrays = []
+    off = offset
+    while off < len(data):
+        arr, off = decode_array(data, off)
+        arrays.append(arr)
+    return _message_from_params(Message, _restore(header, arrays))
+
+
+def peek_wire_envelope(data):
+    """``(type, sender, receiver)`` of a frame WITHOUT decoding any
+    array payload: binary frames parse only the JSON control header;
+    legacy JSON frames (tiny control messages) parse whole. The hubs'
+    relay path routes on this and re-queues the raw frame -- the
+    destination, not the relay, validates the payload."""
+    from fedml_tpu.core.message import Message
+    if _is_binary(data):
+        header, _ = parse_wire_header(data)
+    else:
+        header = json.loads(
+            data if isinstance(data, str) else bytes(data).decode())
+    return (str(header[Message.MSG_ARG_KEY_TYPE]),
+            header[Message.MSG_ARG_KEY_SENDER],
+            header[Message.MSG_ARG_KEY_RECEIVER])
+
+
+#: Exception types one undecodable frame can raise -- the concrete
+#: failure set the transports catch (a malformed peer must cost one
+#: connection, never the decode stage or a serve thread).
+DECODE_ERRORS = (ValueError, KeyError, IndexError, TypeError,
+                 struct.error, UnicodeDecodeError)
+
+
+def decode_frames(frames):
+    """Batch decode: one pass over a chunk of wire frames -> a list
+    aligned with ``frames`` holding ``Message`` objects, with
+    undecodable frames carried as their exception instance (the caller
+    decides the peer's fate; one bad frame must not poison the chunk).
+    Amortizes the per-frame import/dispatch overhead the event-loop
+    dispatcher used to pay once per frame, and every tensor payload
+    aliases its frame buffer (zero-copy decode)."""
+    from fedml_tpu.core.message import Message
+    out = []
+    for data in frames:
+        try:
+            if _is_binary(data):
+                msg = _message_from_params(Message, decode_tree(data))
+            else:
+                msg = Message()
+                msg.init_from_json_string(
+                    data if isinstance(data, str)
+                    else bytes(data).decode())
+        except DECODE_ERRORS as e:
+            out.append(e)
+            continue
+        out.append(msg)
+    return out
 
 
 __all__ = ["MAGIC", "VERSION", "encode_array", "encode_array_views",
            "decode_array", "encode_tree", "encode_tree_views",
            "decode_tree", "array_wire_nbytes", "tree_wire_nbytes",
            "message_to_wire", "message_to_wire_views",
-           "message_from_wire"]
+           "message_from_wire", "message_from_header",
+           "parse_wire_header", "peek_wire_envelope", "decode_frames",
+           "DECODE_ERRORS"]

@@ -39,11 +39,15 @@ import time
 from fedml_tpu.core.locks import audited_lock, io_lock
 from fedml_tpu.observability.flightrec import get_flight_recorder
 from fedml_tpu.observability.registry import get_registry
-from fedml_tpu.compression.codec import message_from_wire
+from fedml_tpu.compression.codec import (DECODE_ERRORS, MAGIC,
+                                         message_from_header,
+                                         message_from_wire,
+                                         parse_wire_header)
 from fedml_tpu.core.comm.base import (BaseCommunicationManager,
                                       MSG_TYPE_PEER_JOIN,
                                       MSG_TYPE_PEER_LOST)
 from fedml_tpu.core.message import Message
+from fedml_tpu.net.ingest import note_ingest
 
 _HDR = struct.Struct("!I")
 _MAX_FRAME = 256 * 1024 * 1024
@@ -59,12 +63,17 @@ def _send_frame(sock, payload: bytes):
 
 
 def _recv_exact(sock, n):
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+    """Exactly ``n`` bytes into a fresh ``bytearray`` via ``recv_into``
+    (no per-chunk concat copies); the buffer is per-frame and handed
+    off whole, so the codec's zero-copy decode may alias it."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if not r:
             raise ConnectionError("peer closed")
-        buf += chunk
+        got += r
     return buf
 
 
@@ -339,7 +348,9 @@ class TcpCommManager(BaseCommunicationManager):
                         # destroy the GOODBYE still queued at the server
                         continue
                     self._count_in(len(frame))
+                    t0 = time.perf_counter()
                     msg = message_from_wire(frame)
+                    note_ingest(1, time.perf_counter() - t0, "tcp")
                     fr = get_flight_recorder()
                     if fr is not None:
                         fr.record("recv", type=msg.get_type(),
@@ -431,9 +442,30 @@ class TcpCommManager(BaseCommunicationManager):
                 return
             self._count_in(len(frame))
             try:
-                msg = message_from_wire(frame)
-            except (ValueError, KeyError, IndexError, TypeError,
-                    struct.error, UnicodeDecodeError):
+                # header-only peek: the envelope routes the frame; a
+                # relayed tensor payload is never decoded at the hub
+                # (parity with the event-loop hub's raw re-queue), and
+                # a locally-dispatched frame's header JSON is parsed
+                # exactly once (split decode via message_from_header)
+                msg = None
+                if len(frame) >= 1 and frame[0] == MAGIC:
+                    header, hoff = parse_wire_header(frame)
+                    mtype = str(header[Message.MSG_ARG_KEY_TYPE])
+                    receiver = int(header[Message.MSG_ARG_KEY_RECEIVER])
+                    if receiver == 0 and mtype not in (MSG_TYPE_GOODBYE,
+                                                       MSG_TYPE_PEER_LOST):
+                        t0 = time.perf_counter()
+                        msg = message_from_header(header, frame, hoff)
+                        note_ingest(1, time.perf_counter() - t0, "tcp")
+                else:
+                    # legacy JSON frames are tiny control messages:
+                    # parse whole, once
+                    t0 = time.perf_counter()
+                    msg = message_from_wire(frame)
+                    note_ingest(1, time.perf_counter() - t0, "tcp")
+                    mtype = msg.get_type()
+                    receiver = int(msg.get_receiver_id())
+            except DECODE_ERRORS:
                 # malformed payload (corrupt bytes, version skew, unknown
                 # wire dtype, truncated array-frame list -> IndexError):
                 # the concrete decode failures the codec can raise --
@@ -445,13 +477,13 @@ class TcpCommManager(BaseCommunicationManager):
                 return
             fr = get_flight_recorder()
             if fr is not None:
-                fr.record("recv", type=msg.get_type(), src=peer_rank,
+                fr.record("recv", type=mtype, src=peer_rank,
                           dst=self.rank, bytes=len(frame), transport="tcp")
-            if msg.get_type() == MSG_TYPE_GOODBYE:
+            if mtype == MSG_TYPE_GOODBYE:
                 # clean hang-up: unroute WITHOUT a peer-lost dispatch
                 self._drop_peer(peer_rank, lost=False, conn=conn)
                 return
-            if msg.get_type() == MSG_TYPE_PEER_LOST:
+            if mtype == MSG_TYPE_PEER_LOST:
                 # reserved: transport-synthesized only. An in-band frame
                 # of this type (bug or spoof) must not trigger fail-fast
                 # for a healthy rank, nor be relayed to one.
@@ -459,7 +491,6 @@ class TcpCommManager(BaseCommunicationManager):
                                 "%s frame from rank %s",
                                 MSG_TYPE_PEER_LOST, peer_rank)
                 continue
-            receiver = int(msg.get_receiver_id())
             if receiver == 0:
                 try:
                     keep = self._dispatch(msg)
@@ -490,8 +521,7 @@ class TcpCommManager(BaseCommunicationManager):
                     slock = self._send_locks.get(receiver)
                 if dest is None:  # unroutable: drop loudly, keep pipe alive
                     logging.warning("tcp hub: dropping message for unknown "
-                                    "rank %s (type=%s)", receiver,
-                                    msg.get_type())
+                                    "rank %s (type=%s)", receiver, mtype)
                 else:
                     try:
                         with slock:

@@ -80,6 +80,17 @@ class DistributedManager(Observer):
         else:
             handler(msg_params)
 
+    def receive_message_batch(self, msg_type, msgs) -> None:
+        """Batched dispatch hook: a chunk-draining transport (the event
+        loop's dispatcher) hands a run of consecutive same-type messages
+        here in FIFO order. The default is the per-message loop --
+        bitwise-identical to N ``receive_message`` calls -- so only FSMs
+        that explicitly implement a batched handler (the buffered async
+        server's one-lock batched fold) ever behave differently, and
+        even those must preserve the per-message trajectory exactly."""
+        for msg in msgs:
+            self.receive_message(msg_type, msg)
+
     def send_message(self, message: Message):
         tracer = get_tracer()
         if tracer.enabled:

@@ -255,14 +255,18 @@ def run_soak(n_clients, total_updates=3, host="localhost", port=None,
              buffer_k=None, flush_deadline_s=30.0, jitter_s=0.5,
              high_watermark=32 * 2 ** 20, join_timeout=600.0,
              handshake_timeout=None, init_params=None,
-             metrics_logger=None, trace_path=None, pace_controller=None):
+             metrics_logger=None, trace_path=None, pace_controller=None,
+             decode_workers=1):
     """The soak scenario: a real buffered-async server over the event
     loop, ``n_clients`` swarm connections from a subprocess. Arm
     ``observability.enable(perfmon=True, status_path=...)`` around this
     call to get the ``status.json`` + latency-histogram evidence.
     ``trace_path`` makes the swarm replay a DiurnalTrace JSON file
     instead of uniform jitter (see :func:`run_swarm`);
-    ``pace_controller`` arms closed-loop pace steering on the server.
+    ``pace_controller`` arms closed-loop pace steering on the server;
+    ``decode_workers`` sizes the server transport's parallel frame-
+    decode stage (1 = today's inline dispatcher decode -- trajectories
+    are identical at any setting, only decode throughput moves).
     Returns ``(server, swarm_summary_dict)``."""
     import socket as _socket
 
@@ -295,7 +299,8 @@ def run_soak(n_clients, total_updates=3, host="localhost", port=None,
             host, port, 0, world,
             timeout=handshake_timeout or max(120.0, n_clients / 50.0),
             metrics_logger=metrics_logger, high_watermark=high_watermark,
-            low_watermark=high_watermark // 4)
+            low_watermark=high_watermark // 4,
+            decode_workers=decode_workers)
         server = AsyncBufferedFedAvgServer(
             None, comm, world, init_params, total_updates, policy,
             metrics_logger=metrics_logger, pace_controller=pace_controller)

@@ -49,7 +49,17 @@ from fedml_tpu.observability.registry import get_registry
 #: Bucket layouts for the monitor's histograms: latency-flavored seconds
 #: for round/report times, tighter sub-second edges for steps, small
 #: integer edges for staleness/depth counts.
-ROUND_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+#:
+#: The sub-1 s region is deliberately fine-grained (ISSUE 14 / ROADMAP
+#: steering follow-up (b)): the pace controller's tail tracker reads
+#: bucket UPPER EDGES as its p50/p90, so the old 0.1/0.25/0.5 ladder
+#: quantized every sub-250 ms latency regime to the 0.25 edge and the
+#: steered deadline could never track tighter. Roughly 1.4-2x edge
+#: ratios below 1 s keep the tracker's resolution ~= its geometric rate
+#: limit; the controller LAW is unchanged -- only its input resolution
+#: (quantile-resolution test in tests/test_steering.py).
+ROUND_BUCKETS = (0.01, 0.025, 0.05, 0.075, 0.1, 0.15, 0.25, 0.35, 0.5,
+                 0.75, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
                  300.0, 600.0)
 STEP_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                 0.25, 0.5, 1.0)
@@ -318,42 +328,57 @@ def ledger_records(path):
 
 
 def check_regression(path, band=DEFAULT_REGRESS_BAND):
-    """Compare the ledger's newest record against the median of its
+    """Compare each metric's newest record against the median of its
     predecessors (higher-is-better headline ``value``: rounds/hour,
-    clients/sec).
+    clients/sec, reports/sec, decode frames/sec).
 
     Baseline = all EARLIER records with the same ``metric`` string (a
-    smoke record never judges a flagship run and vice versa). A fresh
-    ledger -- no record at all, or no same-metric predecessor -- passes.
-    Returns ``(ok, detail_dict)``; the CLI (``bench.py
-    --check-regress``) prints the detail as one JSON line and exits
-    non-zero when ``ok`` is False.
+    smoke record never judges a flagship run and vice versa), and EVERY
+    distinct metric's latest record is judged -- a run that appends
+    several rows (the soak bench writes reports/sec AND decode
+    frames/sec) cannot shadow one metric's regression behind another's
+    newer record. A fresh ledger -- no record at all, or no metric with
+    a same-metric predecessor -- passes. Returns ``(ok, detail_dict)``;
+    the CLI (``bench.py --check-regress``) prints the detail as one
+    JSON line and exits non-zero when ``ok`` is False.
     """
     records = ledger_records(path)
-    if not records:
-        return True, {"check": "perf-regression", "ledger": path,
-                      "records": 0, "fresh_ledger": True, "pass": True}
-    latest = records[-1]
-    metric = latest.get("metric")
-    baseline = [r.get("value") for r in records[:-1]
-                if r.get("metric") == metric
-                and isinstance(r.get("value"), (int, float))]
     detail = {"check": "perf-regression", "ledger": path,
-              "records": len(records), "metric": metric,
-              "latest_value": latest.get("value"), "band": band}
-    if not baseline:
+              "records": len(records), "band": band}
+    if not records:
         detail.update({"fresh_ledger": True, "pass": True})
         return True, detail
-    ordered = sorted(baseline)
-    n = len(ordered)
-    median = (ordered[n // 2] if n % 2 else
-              0.5 * (ordered[n // 2 - 1] + ordered[n // 2]))
-    threshold = median * (1.0 - band)
-    value = latest.get("value")
-    ok = isinstance(value, (int, float)) and value >= threshold
-    detail.update({"fresh_ledger": False, "baseline_records": n,
-                   "baseline_median": median,
-                   "threshold": round(threshold, 4), "pass": ok})
+    by_metric = {}        # metric -> ordered values (numeric), last rec
+    for r in records:
+        vals, _ = by_metric.setdefault(r.get("metric"), ([], None))
+        if isinstance(r.get("value"), (int, float)):
+            vals.append(r.get("value"))
+        by_metric[r.get("metric")] = (vals, r)
+    judged = []
+    for metric, (vals, latest) in by_metric.items():
+        value = latest.get("value")
+        baseline = (vals[:-1] if isinstance(value, (int, float))
+                    else vals)
+        if not baseline:
+            continue  # no same-metric predecessor: fresh for this metric
+        ordered = sorted(baseline)
+        n = len(ordered)
+        median = (ordered[n // 2] if n % 2 else
+                  0.5 * (ordered[n // 2 - 1] + ordered[n // 2]))
+        threshold = median * (1.0 - band)
+        ok = isinstance(value, (int, float)) and value >= threshold
+        judged.append({"metric": metric, "latest_value": value,
+                       "baseline_records": n, "baseline_median": median,
+                       "threshold": round(threshold, 4), "pass": ok})
+    if not judged:
+        detail.update({"fresh_ledger": True, "pass": True})
+        return True, detail
+    ok = all(j["pass"] for j in judged)
+    # top-level fields mirror the single-metric shape: the (first)
+    # failing metric when red, the last-judged metric when green
+    head = next((j for j in judged if not j["pass"]), judged[-1])
+    detail.update({"fresh_ledger": False, **head, "pass": ok,
+                   "metrics": judged})
     return ok, detail
 
 

@@ -177,26 +177,37 @@ class BufferedAggregator:
         (and, for pre-weighted partials, its numerator scale) is
         multiplied by :func:`staleness_weight`.
         """
-        sw = staleness_weight(staleness, self.policy.staleness_decay)
-        w = float(weight) * sw
-        scale = sw if preweighted else w
         with get_tracer().span("buffer-fold", staleness=int(staleness),
                                clients=int(clients)) as sp:
             with self._lock:
-                if key in self._entries:
-                    self.counters["overwrites"] += 1
-                else:
-                    self.counters["clients_folded"] += int(clients)
-                self._entries[key] = (w, payload, scale)
-                self._entry_clients[key] = int(clients)
-                self._entry_staleness[key] = int(staleness)
-                self.counters["folds"] += 1
-                self.counters["max_staleness"] = max(
-                    self.counters["max_staleness"], int(staleness))
-                depth = len(self._entries)
-                self.counters["depth_peak"] = max(
-                    self.counters["depth_peak"], depth)
+                depth = self._fold_locked(key, weight, payload, staleness,
+                                          clients, preweighted)
             sp.set(depth=depth)
+        self._note_fold(staleness, depth)
+        return depth
+
+    def _fold_locked(self, key, weight, payload, staleness, clients,
+                     preweighted):
+        """One entry into the buffer; callers hold ``_lock``."""
+        sw = staleness_weight(staleness, self.policy.staleness_decay)
+        w = float(weight) * sw
+        scale = sw if preweighted else w
+        if key in self._entries:
+            self.counters["overwrites"] += 1
+        else:
+            self.counters["clients_folded"] += int(clients)
+        self._entries[key] = (w, payload, scale)
+        self._entry_clients[key] = int(clients)
+        self._entry_staleness[key] = int(staleness)
+        self.counters["folds"] += 1
+        self.counters["max_staleness"] = max(
+            self.counters["max_staleness"], int(staleness))
+        depth = len(self._entries)
+        self.counters["depth_peak"] = max(
+            self.counters["depth_peak"], depth)
+        return depth
+
+    def _note_fold(self, staleness, depth):
         reg = get_registry()
         if reg is not None:
             reg.set_gauge("fed_buffer_depth", depth,
@@ -209,7 +220,40 @@ class BufferedAggregator:
             # the histogram complement of the point gauges above (pace
             # steering reads distributions, not last values)
             mon.observe_fold(staleness, depth)
-        return depth
+
+    def fold_many(self, entries, ready_target=None):
+        """Batched-entry fold: buffer ``entries`` (a list of ``(key,
+        weight, payload, staleness)`` per-client reports) under ONE lock
+        acquisition, stopping after the entry that brings the buffered
+        client count to the flush threshold (``buffer_k`` capped by
+        ``ready_target``, exactly :meth:`ready`'s rule). Returns
+        ``(consumed, depth)``: the caller flushes and re-enters with the
+        remainder. Fold order is the list order, the flush boundary is
+        the same entry it would be folding one at a time, and
+        :meth:`flush` sorts by key anyway -- so a chunk of reports costs
+        one lock acquisition per flush window instead of one per report
+        while staying bitwise-identical to the per-report path (pinned
+        in tests/test_async_agg.py)."""
+        k = self.policy.buffer_k
+        if ready_target is not None:
+            k = min(k, int(ready_target))
+        k = max(1, k)
+        consumed = 0
+        depth = 0
+        noted = []
+        with get_tracer().span("buffer-fold", batch=len(entries)) as sp:
+            with self._lock:
+                for key, weight, payload, staleness in entries:
+                    depth = self._fold_locked(key, weight, payload,
+                                              staleness, 1, False)
+                    noted.append((staleness, depth))
+                    consumed += 1
+                    if sum(self._entry_clients.values()) >= k:
+                        break
+            sp.set(depth=depth, consumed=consumed)
+        for staleness, d in noted:
+            self._note_fold(staleness, d)
+        return consumed, depth
 
     def ready(self, target=None) -> bool:
         """True when the buffered client count reaches ``buffer_k`` --
@@ -409,6 +453,87 @@ class AsyncBufferedFedAvgServer(ServerManager):
                 pass  # peer-lost dispatch already updated `alive`
 
     # -- handler threads ---------------------------------------------------
+    def receive_message_batch(self, msg_type, msgs):
+        """Batched dispatch from a chunk-draining transport (the event
+        loop): a run of reports folds under ONE ``_advance_lock``
+        acquisition via :meth:`BufferedAggregator.fold_many`, with the
+        flush boundary landing on exactly the report it would land on
+        one message at a time -- trajectories are bitwise-identical to
+        the per-message path (A/B-pinned). Any other type -- and any
+        run while the tracer is armed (per-message ``__trace__``
+        contexts must parent each handler) -- takes the default
+        per-message loop."""
+        if str(msg_type) != MSG_C2S_REPORT or len(msgs) < 2 \
+                or get_tracer().enabled:
+            super().receive_message_batch(msg_type, msgs)
+            return
+        self._on_report_batch(msgs)
+
+    def _on_report_batch(self, msgs):
+        mon = get_perf_monitor()
+        syncs, done = [], False
+        with self._advance_lock:
+            reports = []
+            for msg in msgs:
+                if self.failed is not None \
+                        or self.agg.version >= self.total_updates:
+                    self.counters["late_reports"] += 1
+                    logging.info("async server: late report from rank %d "
+                                 "(run already finished)",
+                                 int(msg.get_sender_id()))
+                    continue
+                # payload/weight/sender converted ONCE per report --
+                # only staleness depends on the flush segment
+                reports.append((
+                    int(msg.get_sender_id()), float(msg.get("num_samples")),
+                    {k: np.asarray(v)
+                     for k, v in msg.get("params").items()},
+                    int(msg.get("round"))))
+            i = 0
+            while i < len(reports) and not done:
+                # staleness (and the latency window origin) is constant
+                # within a segment: both only move at a flush, which
+                # ends the segment
+                version = self.agg.version
+                t0 = self._window_t0
+                entries = [(r, w, p, max(0, version - born))
+                           for r, w, p, born in reports[i:]]
+                consumed, _depth = self.agg.fold_many(
+                    entries, ready_target=len(self.alive))
+                if mon is not None and t0 is not None:
+                    # the per-report window-open -> report latency the
+                    # unbatched handler observes
+                    now = time.time()
+                    for _ in range(consumed):
+                        mon.observe_report_latency(now - t0)
+                i += consumed
+                self.counters["reports"] += consumed
+                if self.pace is not None:
+                    self._pace_window_reports += consumed
+                if self.agg.ready(target=len(self.alive)):
+                    done, more = self._flush_locked("buffer_k")
+                    if not done:
+                        # per-message parity: a NON-final flush's syncs
+                        # are sent (below, outside the lock); the
+                        # finishing flush's syncs are dropped exactly as
+                        # _on_report drops them
+                        syncs.extend(more)
+                else:
+                    self._arm_deadline_locked()
+                if done and i < len(reports):
+                    # run finished mid-batch: the rest are late reports
+                    self.counters["late_reports"] += len(reports) - i
+        if done:
+            # syncs accumulated from earlier (non-final) flushes in this
+            # batch still go out -- the per-message path sent them before
+            # the finishing report was even folded
+            self._send_syncs(syncs)
+            self.finish()
+            self._report_health()
+            return
+        self._send_syncs(syncs)
+        self._report_health()
+
     def _on_report(self, msg):
         rank = int(msg.get_sender_id())
         mon = get_perf_monitor()
@@ -658,7 +783,8 @@ def run_async_tcp_fedavg(world_size, total_updates, async_policy,
                          trainer=None, metrics_logger=None,
                          host="localhost", port=None, timeout=60.0,
                          join_timeout=90.0, transport="tcp",
-                         pace_controller=None, late_clients=()):
+                         pace_controller=None, late_clients=(),
+                         decode_workers=1):
     """Drive a multi-rank TCP buffered-async FedAvg scenario in one
     process (the async analog of ``integration.run_tcp_fedavg``; clients
     are the unchanged :class:`ResilientFedAvgClient`). ``transport``
@@ -717,7 +843,8 @@ def run_async_tcp_fedavg(world_size, total_updates, async_policy,
     if evloop:
         comm = EventLoopCommManager(host, port, 0, world_size,
                                     timeout=timeout,
-                                    metrics_logger=metrics_logger)
+                                    metrics_logger=metrics_logger,
+                                    decode_workers=decode_workers)
     else:
         comm = TcpCommManager(host, port, 0, world_size, timeout=timeout,
                               metrics_logger=metrics_logger)

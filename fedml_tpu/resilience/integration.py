@@ -686,7 +686,8 @@ def run_tcp_fedavg(world_size, rounds, round_policy, init_params,
                    cohort_override=None, trainer=None, recovery=None,
                    metrics_logger=None, host="localhost", port=None,
                    timeout=60.0, join_timeout=90.0, transport="tcp",
-                   pace_controller=None, late_clients=()):
+                   pace_controller=None, late_clients=(),
+                   decode_workers=1):
     """Drive a full multi-rank TCP FedAvg scenario in one process.
 
     Clients run in daemon threads (rank r wrapped by ``fault_plan`` when
@@ -751,7 +752,8 @@ def run_tcp_fedavg(world_size, rounds, round_policy, init_params,
     if evloop:
         comm = EventLoopCommManager(host, port, 0, world_size,
                                     timeout=timeout,
-                                    metrics_logger=metrics_logger)
+                                    metrics_logger=metrics_logger,
+                                    decode_workers=decode_workers)
     else:
         comm = TcpCommManager(host, port, 0, world_size, timeout=timeout,
                               metrics_logger=metrics_logger)

@@ -211,10 +211,13 @@ echo "   must stitch under server round spans THROUGH the new"
 echo "   transport, and the kill's flight-recorder dump + the"
 echo "   comm_bytes_total{transport=eventloop} series must exist."
 echo "   fedlint/fedcheck (incl. the new FL129 event-loop readiness"
-echo "   rule and container-element FL126 chains) must stay at zero"
-echo "   findings on fedml_tpu/net/ =="
-python -m fedml_tpu.analysis fedml_tpu/net/ > /dev/null \
-    && echo "fedlint on net/: 0 findings"
+echo "   rule -- now also rooting decode-stage callbacks -- and"
+echo "   container-element FL126 chains) must stay at zero findings on"
+echo "   the ingest pipeline's whole span: net/ + compression/ +"
+echo "   resilience/ =="
+python -m fedml_tpu.analysis fedml_tpu/net/ fedml_tpu/compression/ \
+    fedml_tpu/resilience/ > /dev/null \
+    && echo "fedlint on net/ + compression/ + resilience/: 0 findings"
 timeout -k 10 180 python - <<'EOF'
 import json, tempfile
 import numpy as np
@@ -394,13 +397,17 @@ EOF
 echo "== event-loop soak smoke (bench.py --soak): 1,000 swarm"
 echo "   connections through a real buffered-async server over the"
 echo "   selector transport, 3 async windows -- the record (reports/sec"
-echo "   headline + fed_report_latency_seconds p50/p90/p99 tail) feeds"
-echo "   the same throwaway perf-regression ledger. The swarm replays"
-echo "   the DIURNAL trace (day/outage/night/flash arrival curve,"
-echo "   fedml_tpu.resilience.faults.DiurnalTrace) instead of uniform"
-echo "   jitter, so the latency histogram carries a realistic tail."
-echo "   The 10k headline soak is the slow-marked tests/test_net.py::"
-echo "   TestSoak::test_soak_10k (evidence in docs/NETWORKING.md) =="
+echo "   headline + fed_report_latency_seconds p50/p90/p99 tail + the"
+echo "   ingest stage's decode-seconds-per-report) feeds the same"
+echo "   throwaway perf-regression ledger, as TWO rows: reports/sec and"
+echo "   decode frames/sec (so a decode slowdown is gated even when"
+echo "   wall-clock reports/sec is masked by reply jitter). The swarm"
+echo "   replays the DIURNAL trace (day/outage/night/flash arrival"
+echo "   curve, fedml_tpu.resilience.faults.DiurnalTrace) instead of"
+echo "   uniform jitter, so the latency histogram carries a realistic"
+echo "   tail. The 10k headline soak is the slow-marked"
+echo "   tests/test_net.py::TestSoak::test_soak_10k (evidence in"
+echo "   docs/NETWORKING.md) =="
 timeout -k 10 300 python bench.py --soak 1000 --soak_trace diurnal \
     --ledger "$CI_LEDGER" \
     > bench_results/bench_soak_smoke.json
@@ -408,23 +415,49 @@ python - <<'EOF'
 import json
 with open("bench_results/bench_soak_smoke.json") as f:
     rec = json.loads(f.readline())
+    dec = json.loads(f.readline())
 assert rec["unit"] == "reports/sec" and rec["value"] > 0, rec
 assert rec["connections"] == 1000 and rec["updates"] == 3, rec
 assert rec["status_outcome"] == "complete", rec
 assert rec["report_latency_p99_s"] is not None, rec
 assert rec["jitter_model"] == "diurnal-trace", rec
+# ingest pipeline accounting (ISSUE 14): every report went through the
+# counted batch-decode path
+assert rec["ingest_frames"] >= rec["reports"], rec
+assert rec["decode_s_per_report"] and rec["decode_s_per_report"] > 0, rec
+assert dec["unit"] == "frames/decode-sec" and dec["value"] > 0, dec
 print("bench --soak:", rec["value"], "reports/sec over",
       rec["connections"], "connections (diurnal trace);",
       "p50/p99 report latency", rec["report_latency_p50_s"], "/",
-      rec["report_latency_p99_s"], "s")
+      rec["report_latency_p99_s"], "s; decode",
+      round(rec["decode_s_per_report"] * 1e6, 1), "us/report")
 EOF
 
 echo "== perf-regression ledger gate (bench.py --check-regress, both"
 echo "   ways): the massive + soak smokes seeded a throwaway ledger --"
 echo "   the gate must pass GREEN on it (fresh: no same-metric"
-echo "   predecessor), then fail RED after a fixture record with an"
-echo "   injected 2x slowdown is appended =="
+echo "   predecessor), then fail RED on a planted 2x DECODE slowdown"
+echo "   (the ingest pipeline's own metric -- the win can never"
+echo "   silently rot), then RED again on the classic 2x clients/sec"
+echo "   slowdown =="
 python bench.py --check-regress --ledger "$CI_LEDGER"
+python - <<'EOF'
+import json
+from fedml_tpu.observability.perfmon import append_ledger
+with open("bench_results/bench_soak_smoke.json") as f:
+    f.readline()
+    dec = json.loads(f.readline())
+slow = dict(dec)
+slow["value"] = dec["value"] / 2.0       # planted 2x decode slowdown
+slow["decode_s_per_report"] = dec["decode_s_per_report"] * 2.0
+slow["injected_fixture"] = "2x-decode-slowdown"
+append_ledger(slow, "bench_results/ci_ledger.jsonl")
+EOF
+if python bench.py --check-regress --ledger "$CI_LEDGER"; then
+    echo "perf-regression gate FAILED to fire on the 2x decode slowdown"
+    exit 1
+fi
+echo "perf-regression gate: red on planted 2x decode slowdown OK"
 python - <<'EOF'
 import json
 from fedml_tpu.observability.perfmon import append_ledger

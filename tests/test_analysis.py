@@ -2620,6 +2620,58 @@ class TestEventLoopReadiness:
         assert "sendall" in found[0].message
         assert "_flush_conn" in found[0].message
 
+    def test_decode_stage_callback_rooted(self):
+        # ISSUE 14: a method handed to DecodeStage(...) runs on shard
+        # decode workers -- one blocked decode stalls every peer hashed
+        # to that shard, so the callback is held to FL129's grammar
+        # (directly and through its self-call closure)
+        src = (
+            "import time\n"
+            "from fedml_tpu.net.ingest import DecodeStage\n"
+            "class T:\n"
+            "    def __init__(self, q):\n"
+            "        self._stage = DecodeStage(4, self._decode, q)\n"
+            "    def _decode(self, item):\n"
+            "        self._slow()\n"
+            "        return item\n"
+            "    def _slow(self):\n"
+            "        time.sleep(0.1)\n")
+        assert codes(src) == ["FL129"]
+        # non-blocking decode callbacks stay clean, and a method NOT
+        # handed to the stage may block freely
+        src = (
+            "import time\n"
+            "from fedml_tpu.net.ingest import DecodeStage\n"
+            "class T:\n"
+            "    def __init__(self, q):\n"
+            "        self._stage = DecodeStage(4, self._decode, q)\n"
+            "    def _decode(self, item):\n"
+            "        return item\n"
+            "    def dispatcher(self):\n"
+            "        time.sleep(0.1)\n")
+        assert codes(src) == []
+
+    def test_mutation_decode_worker_blocking(self):
+        # revert-mutation fixture for the decode-worker stage: a
+        # blocking call planted in the REAL transport's decode callback
+        # (rooted through the DecodeStage construction) must produce
+        # exactly one FL129; the committed source is clean.
+        path = os.path.join(REPO_ROOT, "fedml_tpu/net/eventloop.py")
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        assert [f for f in lint_source(src, path=path)
+                if f.code == "FL129"] == []
+        good = ("                payload = message_from_header(header, "
+                "frame, off)")
+        assert src.count(good) == 1, "eventloop _decode_item shape changed"
+        mutated = src.replace(
+            good, "                time.sleep(0.001)\n" + good)
+        found = [f for f in lint_source(mutated, path=path)
+                 if f.code == "FL129"]
+        assert len(found) == 1, found
+        assert "sleep" in found[0].message
+        assert "_decode_item" in found[0].message
+
 
 class TestContainerElementTyping:
     """Cross-class container-element typing (the former 'Future rules'
@@ -2727,7 +2779,7 @@ class TestContainerElementTyping:
         assert ("cls", (mod, "Payload")) in idx.container_elem_types(
             sink, "items")
 
-    def _subset_paths(self, tmp_path, eventloop_src):
+    def _subset_paths(self, tmp_path, eventloop_src, extra=()):
         import shutil
         files = ["fedml_tpu/core/managers.py",
                  "fedml_tpu/core/comm/base.py",
@@ -2735,7 +2787,7 @@ class TestContainerElementTyping:
                  "fedml_tpu/core/locks.py",
                  "fedml_tpu/core/message.py",
                  "fedml_tpu/resilience/policy.py",
-                 "fedml_tpu/resilience/integration.py"]
+                 "fedml_tpu/resilience/integration.py"] + list(extra)
         for f in files:
             dst = tmp_path / f
             dst.parent.mkdir(parents=True, exist_ok=True)
@@ -2784,3 +2836,35 @@ class TestContainerElementTyping:
         msg = found[0].message
         assert "element of `self._observers`" in msg
         assert "EventLoopCommManager._notify_peer_lost" in msg
+
+    def test_mutation_batch_dispatch_under_lock(self, tmp_path):
+        # ISSUE 14 fixture: the worker->handler BATCH dispatch chain.
+        # Moving _dispatch_batch's observer fan-out under the transport
+        # state lock must produce exactly one FL126 over the real
+        # sources -- the chain (dispatcher -> element of _observers ->
+        # receive_message -> registered handler -> send_with_retry)
+        # only exists through container elements, now including the
+        # async server's batched-fold FSM. The committed tree is clean.
+        extra = ("fedml_tpu/resilience/async_agg.py",
+                 "fedml_tpu/net/ingest.py")
+        path = os.path.join(REPO_ROOT, "fedml_tpu/net/eventloop.py")
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        clean_root = self._subset_paths(tmp_path, src, extra=extra)
+        assert [f.code for f in lint_paths([clean_root])] == []
+        tail = ('            for m in msgs:\n'
+                '                try:\n'
+                '                    obs.receive_message(mtype, m)\n')
+        assert tail in src, "eventloop _dispatch_batch shape changed"
+        mutated = src.replace(tail, (
+            '            with self._lock:\n'
+            '             for m in msgs:\n'
+            '                try:\n'
+            '                    obs.receive_message(mtype, m)\n'))
+        assert mutated != src
+        found = lint_paths([self._subset_paths(tmp_path, mutated,
+                                               extra=extra)])
+        assert [f.code for f in found] == ["FL126"], found
+        msg = found[0].message
+        assert "element of `self._observers`" in msg
+        assert "EventLoopCommManager._dispatch_batch" in msg

@@ -184,6 +184,70 @@ class TestBufferedAggregator:
         assert rec["async/max_staleness"] == 2
         assert rec["async/depth_peak"] == 1
 
+    def test_fold_many_bitwise_vs_per_report(self):
+        """The batched-entry fold (ISSUE 14): fold_many over a chunk ==
+        the same folds one call at a time -- identical flush boundaries,
+        counters, and flushed bytes -- while costing one lock
+        acquisition per flush window."""
+        reports = [(r, float(2 * r + 1), _params(50 + r),
+                    0 if r % 3 else 1) for r in range(9)]
+
+        def run_batched():
+            agg = BufferedAggregator(AsyncAggPolicy(buffer_k=4,
+                                                    staleness_decay=0.5))
+            flushed = []
+            i = 0
+            while i < len(reports):
+                consumed, _depth = agg.fold_many(reports[i:])
+                i += consumed
+                if agg.ready():
+                    flushed.append(agg.flush())
+            return agg, flushed
+
+        def run_single():
+            agg = BufferedAggregator(AsyncAggPolicy(buffer_k=4,
+                                                    staleness_decay=0.5))
+            flushed = []
+            for key, w, p, s in reports:
+                agg.fold(key, w, p, staleness=s)
+                if agg.ready():
+                    flushed.append(agg.flush())
+            return agg, flushed
+
+        agg_b, fb = run_batched()
+        agg_s, fs = run_single()
+        assert agg_b.counters == agg_s.counters
+        assert agg_b.depth == agg_s.depth  # the 9th report stays buffered
+        assert len(fb) == len(fs) == 2
+        for a, b in zip(fb, fs):
+            assert a.contributors == b.contributors
+            assert a.weight == b.weight
+            assert a.max_staleness == b.max_staleness
+            for k in a.params:
+                np.testing.assert_array_equal(a.params[k], b.params[k])
+
+    def test_fold_many_stops_at_ready_target(self):
+        # the flush boundary lands on exactly the entry that fills the
+        # (target-capped) buffer, never past it
+        agg = BufferedAggregator(AsyncAggPolicy(buffer_k=64))
+        entries = [(r, 1.0, _params(r), 0) for r in range(5)]
+        consumed, depth = agg.fold_many(entries, ready_target=3)
+        assert consumed == 3 and depth == 3
+        assert agg.ready(target=3)
+        consumed2, depth2 = agg.fold_many(entries[consumed:],
+                                          ready_target=10)
+        assert consumed2 == 2 and depth2 == 5
+
+    def test_fold_many_overwrites_do_not_advance_ready(self):
+        # re-folding an existing key never counts toward K (newest wins,
+        # clients unchanged) -- same rule as per-report folds
+        agg = BufferedAggregator(AsyncAggPolicy(buffer_k=3))
+        entries = [(1, 1.0, _params(0), 0), (1, 2.0, _params(1), 0),
+                   (2, 1.0, _params(2), 0), (3, 1.0, _params(3), 0)]
+        consumed, depth = agg.fold_many(entries)
+        assert consumed == 4 and depth == 3
+        assert agg.counters["overwrites"] == 1
+
 
 # ---------------------------------------------------------------------------
 # Distributed FSM: AsyncBufferedFedAvgServer over real TCP

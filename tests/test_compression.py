@@ -590,3 +590,141 @@ class TestZeroCopyViews:
             assert wire == encode_array(a)
             out, _ = decode_array(wire)
             np.testing.assert_array_equal(out, np.ascontiguousarray(a))
+
+
+class TestZeroCopyDecode:
+    """The decode twin of TestZeroCopyViews (ISSUE 14): wire frames
+    decoded over ``memoryview``s of the receive buffer alias it --
+    zero payload copies from the wire to the aggregator fold -- with
+    the exotic layouts (bool bit-pack, bf16, big-endian) falling back
+    to the copying path byte-equal."""
+
+    def _fuzz_tree(self):
+        import ml_dtypes
+        rng = np.random.default_rng(7)
+        return {
+            "w": rng.standard_normal((13, 5)).astype(np.float32),
+            "h": rng.standard_normal((3, 4)).astype(ml_dtypes.bfloat16),
+            "mask": rng.random(41) > 0.5,            # bool bit-pack
+            "zero_d": np.asarray(2.25, np.float64),  # framed 0-d leaf
+            "ids": np.arange(9, dtype=np.int64),
+            "strided": np.arange(24, dtype=np.float32).reshape(4, 6)[:, ::2],
+            "be": np.arange(5, dtype=">i4"),         # big-endian input
+            "n": 30.0,
+            "note": "control",
+        }
+
+    def test_memoryview_vs_bytes_decode_byte_equal(self):
+        # the parity fuzz: the SAME wire bytes decoded as bytes, as a
+        # bytearray, and as a memoryview over a bytearray produce
+        # byte-identical trees across the full codec matrix
+        import jax
+        from fedml_tpu.compression.codec import decode_tree, encode_tree
+        wire = encode_tree(self._fuzz_tree())
+        ref = decode_tree(wire)
+        for form in (bytearray(wire), memoryview(bytearray(wire))):
+            got = decode_tree(form)
+            for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(got)):
+                if isinstance(a, np.ndarray):
+                    assert a.dtype == b.dtype and a.shape == b.shape
+                    assert a.tobytes() == b.tobytes()
+                else:
+                    assert a == b
+
+    def test_legacy_json_sniff_from_memoryview(self):
+        from fedml_tpu.compression.codec import message_from_wire
+        from fedml_tpu.core.message import Message
+        legacy = Message("res_sync", 0, 3)
+        legacy.add("round", 2)
+        wire = legacy.to_json().encode()
+        for form in (wire, bytearray(wire), memoryview(bytearray(wire))):
+            back = message_from_wire(form)
+            assert back.get_type() == "res_sync"
+            assert back.get("round") == 2
+
+    def test_decoded_payload_shares_receive_buffer(self):
+        # THE zero-copy pin: a contiguous native-dtype tensor decoded
+        # from a memoryview over the receive buffer is an aliasing view
+        # (np.shares_memory), marked read-only because the buffer is
+        # mutable; bool/bf16 leaves are the documented copying fallback
+        import ml_dtypes
+        from fedml_tpu.compression.codec import decode_tree, encode_tree
+        tree = {"w": np.arange(20, dtype=np.float32).reshape(4, 5),
+                "ids": np.arange(6, dtype=np.int32),
+                "mask": np.array([True, False] * 9),
+                "h": np.ones((2, 3), ml_dtypes.bfloat16)}
+        buf = bytearray(encode_tree(tree))
+        raw = np.frombuffer(buf, np.uint8)
+        out = decode_tree(memoryview(buf))
+        for k in ("w", "ids"):
+            assert np.shares_memory(out[k], raw), k
+            assert not out[k].flags.writeable, k
+        for k in ("mask", "h"):
+            assert not np.shares_memory(out[k], raw), k
+        # bytes input (immutable) also aliases; numpy already freezes it
+        out2 = decode_tree(bytes(buf))
+        assert not out2["w"].flags.writeable
+
+    def test_alias_safety_fold_contract(self):
+        # the buffer-retention contract, pinned: (a) a decoded view is
+        # READ-ONLY, so no consumer can mutate it into a folded entry;
+        # (b) the view keeps its frame buffer alive by reference, so
+        # "recycling" can only mean the transport allocating a FRESH
+        # buffer per frame (which the event loop does -- rx_buf is a new
+        # bytearray per frame) -- dropping every external reference to
+        # the buffer cannot invalidate a buffered entry's bytes.
+        import gc
+        from fedml_tpu.compression.codec import decode_tree, encode_tree
+        from fedml_tpu.resilience.async_agg import (AsyncAggPolicy,
+                                                    BufferedAggregator)
+        tree = {"w": np.full((8,), 3.0, np.float32)}
+        buf = bytearray(encode_tree(tree))
+        out = decode_tree(memoryview(buf))
+        with pytest.raises((ValueError, RuntimeError)):
+            out["w"][0] = 99.0  # decoded views cannot be written through
+        agg = BufferedAggregator(AsyncAggPolicy(buffer_k=1,
+                                                staleness_decay=0.0))
+        agg.fold(1, 10.0, out)
+        del buf, out  # the transport/dispatcher drop their references
+        gc.collect()
+        res = agg.flush()
+        assert (res.params["w"] == 3.0).all()
+
+    def test_peek_wire_envelope_routes_without_payload_decode(self):
+        from fedml_tpu.compression.codec import (message_to_wire,
+                                                 peek_wire_envelope)
+        from fedml_tpu.core.message import Message
+        msg = Message("res_report", 3, 0)
+        msg.add("params", {"w": np.ones((64, 64), np.float32)})
+        wire = message_to_wire(msg)
+        assert peek_wire_envelope(wire) == ("res_report", 3, 0)
+        # corrupt every array byte: the envelope still routes (the hub
+        # relays raw; the DESTINATION validates payloads)
+        corrupt = bytearray(wire)
+        corrupt[-16:] = b"\xff" * 16
+        assert peek_wire_envelope(corrupt) == ("res_report", 3, 0)
+        # legacy JSON frames peek too
+        legacy = Message("__goodbye__", 5, 0).to_json().encode()
+        assert peek_wire_envelope(legacy) == ("__goodbye__", 5, 0)
+
+    def test_decode_frames_batch_matches_single(self):
+        from fedml_tpu.compression.codec import (decode_frames,
+                                                 message_from_wire,
+                                                 message_to_wire)
+        from fedml_tpu.core.message import Message
+        frames = []
+        for r in range(1, 4):
+            m = Message("res_report", r, 0)
+            m.add("params", {"w": np.full((4,), float(r), np.float32)})
+            m.add("num_samples", 10.0 * r)
+            frames.append(bytearray(message_to_wire(m)))
+        frames.append(bytearray(b"\x9e\x01junkjunkjunk"))  # undecodable
+        out = decode_frames(frames)
+        assert isinstance(out[3], Exception)
+        for r, got in enumerate(out[:3], start=1):
+            want = message_from_wire(frames[r - 1])
+            assert got.get_type() == want.get_type() == "res_report"
+            assert got.get_sender_id() == r
+            assert (got.get("params")["w"]
+                    == want.get("params")["w"]).all()
+            assert got.get("num_samples") == want.get("num_samples")

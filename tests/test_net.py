@@ -32,6 +32,66 @@ def _free_port():
     return port
 
 
+def _decode_path_ab(side=512, iters=200):
+    """Decode-seconds-per-report A/B at a model-sized report: the
+    pre-ISSUE-14 per-frame COPYING decode (payload slices materialized
+    per array -- replicated inline, since the shipped codec no longer
+    copies) vs the shipped batched zero-copy ``decode_frames``.
+    Returns ``(per_frame_s, batched_s)`` per report."""
+    from fedml_tpu.compression import codec
+    from fedml_tpu.compression.codec import message_to_wire
+
+    rep = Message("res_report", 7, 0)
+    rep.add("params", {"w": np.zeros((side, side), np.float32)})
+    rep.add("num_samples", 70.0)
+    rep.add("round", 1)
+    frame = message_to_wire(rep)
+
+    def legacy_decode_array(buf, offset):
+        # the pre-pipeline decode_array, verbatim semantics: the
+        # payload slice is MATERIALIZED (one copy per tensor)
+        (nlen,) = struct.unpack_from("!B", buf, offset)
+        offset += 1
+        name = buf[offset:offset + nlen].decode("ascii")
+        offset += nlen
+        (ndim,) = struct.unpack_from("!B", buf, offset)
+        offset += 1
+        shape = []
+        for _ in range(ndim):
+            (dim,) = struct.unpack_from("!I", buf, offset)
+            shape.append(dim)
+            offset += 4
+        (nbytes,) = struct.unpack_from("!I", buf, offset)
+        offset += 4
+        payload = bytes(buf[offset:offset + nbytes])
+        offset += nbytes
+        arr = np.frombuffer(payload, np.dtype(name)).reshape(shape)
+        return arr, offset
+
+    def legacy_message_from_wire(data):
+        header, off = codec.parse_wire_header(data)
+        arrays = []
+        while off < len(data):
+            arr, off = legacy_decode_array(data, off)
+            arrays.append(arr)
+        return codec._message_from_params(
+            Message, codec._restore(header, arrays))
+
+    data = bytes(frame)  # built once: only the DECODE is timed
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        legacy_message_from_wire(data)
+    per_frame_s = (time.perf_counter() - t0) / iters
+
+    frames = [bytearray(frame) for _ in range(16)]
+    t0 = time.perf_counter()
+    for _ in range(max(1, iters // 16)):
+        codec.decode_frames(frames)
+    batched_s = ((time.perf_counter() - t0)
+                 / (max(1, iters // 16) * 16))
+    return per_frame_s, batched_s
+
+
 class Recorder:
     def __init__(self):
         self.messages = []
@@ -337,6 +397,102 @@ class TestTransportEquivalence:
             for k in ga:
                 assert (ga[k] == gb[k]).all(), k
 
+    def test_decode_worker_count_changes_no_trajectory(self):
+        """ISSUE 14 acceptance: the parallel decode stage (workers > 1)
+        and the inline workers=1 default produce bitwise-identical
+        trajectories for BOTH paradigms -- per-peer order is preserved
+        by rank sharding and every fold is arrival-order independent,
+        so worker count moves decode throughput and nothing else."""
+        from fedml_tpu.resilience import RoundPolicy, run_tcp_fedavg
+        from fedml_tpu.resilience.async_agg import (AsyncAggPolicy,
+                                                    run_async_tcp_fedavg)
+
+        w0 = {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+              "b": np.ones(4, np.float32)}
+        s1 = run_tcp_fedavg(4, 3, RoundPolicy(), w0,
+                            transport="eventloop", join_timeout=60,
+                            decode_workers=1)
+        s4 = run_tcp_fedavg(4, 3, RoundPolicy(), w0,
+                            transport="eventloop", join_timeout=60,
+                            decode_workers=4)
+        assert s1.failed is None and s4.failed is None
+        assert s1.reporting_log == s4.reporting_log
+        for ga, gb in zip(s1.history, s4.history):
+            for k in ga:
+                assert (ga[k] == gb[k]).all(), k
+        pol = AsyncAggPolicy(buffer_k=10 ** 9, staleness_decay=0.0)
+        a1 = run_async_tcp_fedavg(4, 3, pol, w0, transport="eventloop",
+                                  join_timeout=60, decode_workers=1)
+        a4 = run_async_tcp_fedavg(4, 3, pol, w0, transport="eventloop",
+                                  join_timeout=60, decode_workers=4)
+        assert a1.failed is None and a4.failed is None
+        assert a1.flush_log == a4.flush_log
+        for ga, gb in zip(a1.history, a4.history):
+            for k in ga:
+                assert (ga[k] == gb[k]).all(), k
+        # the worker stage really decoded: its counters carry the frames
+        st = a4.com_manager.ingest_stats()
+        assert st["workers"] == 4 and st["frames"] > 0
+
+    def test_batched_dispatch_matches_per_message_bitwise(self):
+        """The async server's batched handler (one _advance_lock
+        acquisition + fold_many per run) vs the per-message path, over
+        the SAME deterministic report sequence with a small K that
+        forces flush boundaries INSIDE the batch: identical histories,
+        flush logs, counters, and outbound re-syncs."""
+        from fedml_tpu.core.message import Message
+        from fedml_tpu.resilience.async_agg import (
+            AsyncAggPolicy, AsyncBufferedFedAvgServer)
+        from fedml_tpu.resilience.integration import MSG_C2S_REPORT
+
+        class _NullComm:
+            def __init__(self):
+                self.sent = []
+
+            def add_observer(self, obs):
+                pass
+
+            def send_message(self, msg, is_resend=False):
+                self.sent.append((int(msg.get_receiver_id()),
+                                  msg.get_type(), msg.get("round")))
+
+            def stop_receive_message(self):
+                pass
+
+        def report(rank, born, val):
+            m = Message(MSG_C2S_REPORT, rank, 0)
+            m.add("params", {"w": np.full((3,), val, np.float32)})
+            m.add("num_samples", float(10 * rank))
+            m.add("round", born)
+            return m
+
+        w0 = {"w": np.zeros(3, np.float32)}
+        pol = AsyncAggPolicy(buffer_k=2, staleness_decay=0.5)
+        # 5 reports, K=2: two flushes land mid-batch, the 5th buffers
+        msgs = [report(1, 0, 1.0), report(2, 0, 2.0), report(3, 0, 3.0),
+                report(4, 1, 4.0), report(1, 1, 5.0)]
+
+        def run(batched):
+            comm = _NullComm()
+            srv = AsyncBufferedFedAvgServer(None, comm, 5, w0, 10, pol)
+            srv.register_message_receive_handlers()
+            if batched:
+                srv.receive_message_batch(MSG_C2S_REPORT, msgs)
+            else:
+                for m in msgs:
+                    srv.receive_message(MSG_C2S_REPORT, m)
+            return srv, comm
+
+        sb, cb = run(True)
+        ss, cs = run(False)
+        assert sb.flush_log == ss.flush_log == [(1, 2), (3, 4)]
+        assert sb.counters == ss.counters
+        assert cb.sent == cs.sent  # flush re-syncs, same order
+        assert sb.agg.depth == ss.agg.depth == 1
+        for ga, gb in zip(sb.history, ss.history):
+            for k in ga:
+                assert (ga[k] == gb[k]).all(), k
+
     def test_chaos_kill_stall_with_stitched_observability(self):
         """The ci.sh chaos scenario over the event loop: kill + stall
         completes degraded; the race audit is clean; client local-train
@@ -473,22 +629,48 @@ class TestSoak:
         assert count >= 400 and total > 0
         assert obs.registry.histogram_quantile(
             "fed_report_latency_seconds", 0.99) is not None
+        # ingest pipeline evidence (ISSUE 14): every report was decoded
+        # through the counted batch path, and the registry carries the
+        # frames counter + decode-seconds histogram the ledger gates
+        st = server.com_manager.ingest_stats()
+        assert st["frames"] >= 400 and st["decode_s"] > 0
+        frames = obs.registry.get("fed_ingest_frames_total",
+                                  transport="eventloop")
+        assert frames and frames >= 400
+        dsum, dcount = obs.registry.get("fed_ingest_decode_seconds",
+                                        transport="eventloop")
+        assert dcount > 0 and dsum > 0
 
     @pytest.mark.slow
     def test_soak_10k(self):
         """The headline acceptance: a 10k-connection soak on one host
         completes >= 3 async rounds with a parseable final status.json
-        and a populated fed_report_latency_seconds straggler tail."""
+        and a populated fed_report_latency_seconds straggler tail.
+
+        ISSUE 14 re-measure: on a multi-core host the parallel +
+        batched + zero-copy ingest must clear 2x the committed ~1.7k
+        reports/sec single-thread ceiling; a 1-core host (where decode
+        workers cannot parallelize) instead pins that the batched
+        path's decode-seconds-per-report beats the pre-pipeline
+        per-frame decode of the same report shape, measured on the
+        same run."""
+        import os
         import tempfile
+        import time as time_mod
 
         from fedml_tpu.observability import enable
         from fedml_tpu.net.soak import run_soak
 
+        cores = os.cpu_count() or 1
+        workers = min(4, cores) if cores > 1 else 1
         d = tempfile.mkdtemp(prefix="soak_10k_")
+        t0 = time_mod.time()
         with enable(perfmon=True, status_path=d + "/status.json",
                     compile_events=False) as obs:
             server, summary = run_soak(10_000, total_updates=3,
-                                       jitter_s=1.0, join_timeout=480)
+                                       jitter_s=1.0, join_timeout=480,
+                                       decode_workers=workers)
+        wall_s = time_mod.time() - t0
         assert server.failed is None
         assert server.agg.version == 3
         assert summary.get("connections") == 10_000
@@ -499,6 +681,21 @@ class TestSoak:
         assert count >= 30_000
         assert obs.registry.histogram_quantile(
             "fed_report_latency_seconds", 0.99) is not None
+        st = server.com_manager.ingest_stats()
+        assert st["frames"] >= 30_000
+        reports_per_sec = server.counters["reports"] / wall_s
+        if cores > 1:
+            # the committed single-thread figure was ~1.7k reports/sec
+            assert reports_per_sec >= 2 * 1700, (
+                reports_per_sec, st, wall_s)
+        else:
+            # 1-core branch (decode workers cannot parallelize): pin
+            # that the batched ZERO-COPY decode beats the pre-pipeline
+            # per-frame COPYING decode at a model-sized report -- the
+            # payload-proportional half of the win (the soak's own toy
+            # 48-byte payloads are header-parse-bound either way)
+            per_frame_s, batched_s = _decode_path_ab()
+            assert batched_s < per_frame_s, (batched_s, per_frame_s)
 
 
 class TestRegistryQuantile:

@@ -178,6 +178,46 @@ class TestController:
         assert reg.get("fed_pace_decisions_total",
                        reason="track-tail") == 1
 
+    def test_sub_250ms_quantile_resolution(self):
+        """ISSUE 14 satellite: the controller reads bucket UPPER EDGES,
+        so its resolution IS the layout. With the finer sub-1 s
+        ROUND_BUCKETS a ~120 ms latency regime resolves to the 0.15
+        edge (the old 0.1/0.25/0.5 ladder pinned it to 0.25), and the
+        UNCHANGED tail-tracking law converts that into a tighter
+        steered deadline -- 1.25 * 0.15 instead of 1.25 * 0.25."""
+        from fedml_tpu.observability.perfmon import ROUND_BUCKETS
+
+        # the layout itself: enough sub-250 ms edges that adjacent
+        # edges in the steerable 50 ms - 1 s region are at most 2x
+        # apart (tracker resolution ~= its geometric rate limit)
+        sub = [e for e in ROUND_BUCKETS if e < 0.25]
+        assert len(sub) >= 4
+        steerable = [e for e in ROUND_BUCKETS if 0.05 <= e <= 1.0]
+        assert all(b / a <= 2.0 + 1e-9
+                   for a, b in zip(steerable, steerable[1:]))
+
+        def settle(buckets):
+            reg = MetricsRegistry()
+            ctl = PaceController(deadline_s=1.0)
+            p90 = None
+            for _ in range(4):  # past the geometric rate limit
+                for _ in range(50):
+                    reg.observe("fed_report_latency_seconds", 0.12,
+                                buckets=buckets)
+                obs = ctl.observe_registry(reg)
+                p90 = obs["latency_p90"]
+                d = ctl.decide(obs=obs)
+            return p90, d.deadline_s
+
+        p90, settled = settle(ROUND_BUCKETS)
+        assert p90 == 0.15
+        assert settled == round(1.25 * 0.15, 3)  # 0.188 (1 ms quantum)
+        # same regime through the OLD coarse ladder for contrast: the
+        # tracker (same law) can never settle below 1.25 * 0.25
+        p90_old, settled_old = settle((0.1, 0.25, 0.5, 1.0))
+        assert p90_old == 0.25
+        assert settled_old == round(1.25 * 0.25, 3)  # 0.312
+
 
 class TestDiurnalTrace:
     def test_json_roundtrip_and_locate(self, tmp_path):
