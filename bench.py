@@ -358,7 +358,7 @@ def run_massive_cohort(args):
         client_chunk=args.massive_chunk, bucket_edges="geometric",
         async_agg=int(args.massive_async), buffer_k=args.buffer_k,
         staleness_decay=args.staleness_decay, async_window=4,
-        device_resident="0")
+        device_resident="0", compressor=args.compressor)
     from fedml_tpu.observability.costmodel import CostModel, set_cost_model
 
     api = FedAvgAPI(dataset, spec, run_args)
@@ -384,13 +384,17 @@ def run_massive_cohort(args):
     finally:
         set_cost_model(prev_cm)
     round_s = float(np.median(times))
+    comp_tag = (f", {args.compressor} streaming-EF"
+                if api.compressor is not None else "")
     out = {
         "metric": f"massive-cohort clients/sec (bucketed streaming, "
                   f"{C} ragged LR clients"
                   + (", async buffered" if args.massive_async else "")
-                  + ")",
+                  + comp_tag + ")",
         "value": round(C / round_s, 1),
         "unit": "clients/sec",
+        "compressor": (args.compressor if api.compressor is not None
+                       else None),
         "clients_per_round": C,
         "rounds_measured": rounds,
         "round_s": round(round_s, 3),
@@ -422,6 +426,11 @@ def run_massive_cohort(args):
     if args.massive_async:
         out["async"] = {k.split("/", 1)[1]: v for k, v in metrics.items()
                         if k.startswith("async/")}
+    if api.compressor is not None:
+        # uplink accounting from the streaming-EF round (static per-client
+        # encoded bytes x cohort; the EF convergence gate is tier-1)
+        out["bytes_on_wire"] = metrics["bytes_on_wire"]
+        out["compression_ratio"] = metrics["compression_ratio"]
     print(json.dumps(out), flush=True)
     if args.ledger:
         from fedml_tpu.observability.perfmon import append_ledger
@@ -778,17 +787,47 @@ def run_steering_bench(args):
     return 0 if ok else 1
 
 
+def _soak_report_frame_nbytes(init_params, compressor=None):
+    """Exact on-wire bytes of one swarm report frame for this model --
+    plain (full params) or compressed (EF delta schema). Static given
+    the template: encoded sizes are shape-only for every wire
+    compressor, so the plain/compressed byte ratio needs no second
+    measurement run."""
+    from fedml_tpu.compression.codec import message_to_wire
+    from fedml_tpu.compression.wire import (ef_step, encode_rng,
+                                            host_compressor)
+    from fedml_tpu.core.message import Message
+
+    params = {k: np.asarray(v, np.float32) for k, v in init_params.items()}
+    out = Message("res_report", 1, 0)
+    comp = host_compressor(compressor)
+    if comp is None:
+        out.add("params", params)
+    else:
+        enc, _dec, _res = ef_step(
+            comp, {k: np.zeros_like(v) for k, v in params.items()},
+            None, encode_rng((0, 0, 0)))
+        out.add("cdelta", enc)
+        out.add("compressor", comp.spec)
+    out.add("num_samples", 1.0)
+    out.add("round", 0)
+    out.add("attempt", 0)
+    return len(message_to_wire(out))
+
+
 def run_soak_bench(args):
     """``--soak [N]``: the event-loop control-plane bench. One JSON
-    record: reports/sec headline, connection count, and the
-    ``fed_report_latency_seconds`` tail -- the ledger's evidence that
-    the transport keeps its connections/sec and latency behavior."""
+    record: reports/sec headline, connection count, bytes-per-report
+    (with the wire-compression reduction when --compressor is set), and
+    the ``fed_report_latency_seconds`` tail -- the ledger's evidence
+    that the transport keeps its connections/sec and latency behavior."""
     import tempfile
 
     from fedml_tpu.net.soak import run_soak
     from fedml_tpu.observability import enable
 
     n = int(args.soak)
+    soak_params = {"w": np.zeros(int(args.soak_params), np.float32)}
     d = tempfile.mkdtemp(prefix="bench_soak_")
     status_path = os.path.join(d, "status.json")
     trace_file = None
@@ -808,7 +847,8 @@ def run_soak_bench(args):
             n, total_updates=int(args.soak_updates),
             jitter_s=float(args.soak_jitter), trace_path=trace_file,
             join_timeout=max(300.0, n / 10.0),
-            decode_workers=int(args.soak_decode_workers))
+            decode_workers=int(args.soak_decode_workers),
+            init_params=soak_params, compressor=args.compressor)
     wall_s = time.time() - t0
     if server.failed is not None:
         print(json.dumps({"metric": "eventloop-soak", "error":
@@ -825,11 +865,34 @@ def run_soak_bench(args):
     ingest = server.com_manager.ingest_stats()
     decode_s_per_report = (ingest["decode_s"] / ingest["frames"]
                            if ingest["frames"] else None)
+    # bytes-on-wire accounting (fedsqueeze headline): measured uplink
+    # bytes per report on the server transport vs the STATIC plain-frame
+    # floor for the same model -- wire_reduction is what --compressor
+    # buys (>= 8x gated in ci.sh for qsgd)
+    raw_frame = _soak_report_frame_nbytes(soak_params)
+    this_frame = _soak_report_frame_nbytes(soak_params, args.compressor)
+    measured_per_report = (server.com_manager.bytes_received / reports
+                           if reports else None)
+    comp_tag = (f", {summary['compressor']} compressed"
+                if summary.get("compressor") else "")
+    jitter_model = "diurnal-trace" if trace_file else "uniform"
+    # the metric string carries the regime (report size, arrival model,
+    # compressor): ledger lineages must never judge a diurnal-trace row
+    # against a jitter-free one or a compressed row against plain
     out = {
         "metric": f"eventloop-soak reports/sec ({n} connections, "
-                  "async buffered)",
+                  f"{int(args.soak_params)}-float reports, "
+                  f"{jitter_model}, async buffered{comp_tag})",
         "value": round(reports / wall_s, 1),
         "unit": "reports/sec",
+        "compressor": summary.get("compressor"),
+        "soak_params": int(args.soak_params),
+        "report_frame_bytes": this_frame,
+        "raw_report_frame_bytes": raw_frame,
+        "measured_bytes_per_report": (round(measured_per_report, 1)
+                                      if measured_per_report else None),
+        "wire_reduction": (round(raw_frame / measured_per_report, 2)
+                           if measured_per_report else None),
         "connections": summary.get("connections"),
         "connections_per_sec": round(n / wall_s, 1),
         "updates": server.agg.version,
@@ -841,7 +904,7 @@ def run_soak_bench(args):
         "sheds": getattr(server.com_manager, "sheds", 0),
         "status_outcome": status.get("outcome"),
         "transport": "eventloop",
-        "jitter_model": ("diurnal-trace" if trace_file else "uniform"),
+        "jitter_model": jitter_model,
         "swarm_dropped": summary.get("dropped", 0),
         "decode_workers": ingest["workers"],
         "ingest_frames": ingest["frames"],
@@ -858,9 +921,14 @@ def run_soak_bench(args):
             # second -- higher is better, so --check-regress's one-sided
             # gate fires on a decode slowdown even when wall-clock
             # reports/sec is masked by reply jitter)
+            # the decode lineage carries the arrival model too: diurnal
+            # bursts batch more frames per drain than uniform jitter, so
+            # frames/decode-sec amortizes differently (measured ~0.8x
+            # swing) -- regimes must not judge each other
             decode_rec = {
                 "metric": f"eventloop-soak decode frames/sec "
-                          f"({n} connections)",
+                          f"({n} connections, {int(args.soak_params)}"
+                          f"-float reports, {jitter_model}{comp_tag})",
                 "value": round(ingest["frames"] / ingest["decode_s"], 1),
                 "unit": "frames/decode-sec",
                 "decode_workers": ingest["workers"],
@@ -869,6 +937,24 @@ def run_soak_bench(args):
             }
             print(json.dumps(decode_rec), flush=True)
             append_ledger(decode_rec, args.ledger)
+        if out["compressor"] and out["wire_reduction"]:
+            # third ledger row, compressed runs only: the measured
+            # bytes-on-wire reduction as its own one-sided metric, so a
+            # RATIO regression (compressor silently shipping fatter
+            # frames) fires --check-regress even when reports/sec is
+            # masked by reply jitter
+            ratio_rec = {
+                "metric": f"eventloop-soak wire reduction "
+                          f"({n} connections, {out['compressor']})",
+                "value": out["wire_reduction"],
+                "unit": "x-vs-plain-frames",
+                "report_frame_bytes": out["report_frame_bytes"],
+                "raw_report_frame_bytes": out["raw_report_frame_bytes"],
+                "measured_bytes_per_report":
+                    out["measured_bytes_per_report"],
+            }
+            print(json.dumps(ratio_rec), flush=True)
+            append_ledger(ratio_rec, args.ledger)
     return 0
 
 
@@ -1059,6 +1145,22 @@ def main():
                         "the swarm's reply model instead of uniform "
                         "--soak_jitter ('diurnal' = the built-in "
                         "day/outage/night/flash curve, dropout-free)")
+    p.add_argument("--compressor", type=str, default=None,
+                   help="wire/update compression spec for --soak and "
+                        "--massive_cohort (e.g. 'qsgd', 'topk:0.01', "
+                        "'signsgd'). --soak: swarm clients ship "
+                        "EF-compressed report deltas over the real "
+                        "eventloop wire (compression.wire, "
+                        "sub-byte-packed qsgd codes); --massive_cohort: "
+                        "the bucketed chunk program runs streaming-EF "
+                        "(engine.py). Records gain bytes-on-wire + "
+                        "reduction fields; the compressed rows land on "
+                        "the ledger as their own metric strings")
+    p.add_argument("--soak_params", type=int, default=16384,
+                   help="soak bench: model floats per report (the "
+                        "report payload is ~4x this in bytes "
+                        "uncompressed; sized so byte effects are "
+                        "measurable over the frame headers)")
     p.add_argument("--soak_decode_workers", type=int, default=1,
                    help="soak bench: parallel frame-decode workers on "
                         "the server transport (net/ingest.py DecodeStage"

@@ -111,9 +111,11 @@ class FedAvgAPI:
         # are bucketed by local step count, streamed chunk-by-chunk
         # through one compiled program per bucket shape, and folded on
         # host in fp64 -- the cohort axis is unbounded (engine.py
-        # BucketedStreamRunner; docs/PERFORMANCE.md round 6). Validated
-        # BEFORE any round fn is built: a bogus mesh/compressor combo must
-        # fail loudly here, not deep in shard_map.
+        # BucketedStreamRunner; docs/PERFORMANCE.md round 6). Composes
+        # with --compressor (streaming-EF: the chunk program compresses
+        # each lane's update delta with per-client error feedback).
+        # Validated BEFORE any round fn is built: a bogus mesh combo
+        # must fail loudly here, not deep in shard_map.
         self.bucket_runner = None
         self.async_agg = None
         from fedml_tpu.resilience.async_agg import AsyncAggPolicy
@@ -126,16 +128,20 @@ class FedAvgAPI:
                     "--bucket_edges/--async_agg run the single-chip "
                     "bucketed streaming path; it does not compose with "
                     "--mesh (the sharded-lane path owns multi-chip)")
-            if self.compressor is not None:
-                raise ValueError(
-                    "--bucket_edges/--async_agg do not compose with "
-                    "--compressor yet: EF residual state for unbounded "
-                    "cohorts is the compression follow-up (ROADMAP)")
+            if (self.compressor is not None
+                    and self.compressor.name == "none"):
+                # the identity compressor has no wire transform to
+                # stream: keep the plain chunk program so --compressor
+                # none stays bitwise-identical to no flag at all
+                logging.info("bucketed streaming: --compressor none is "
+                             "the identity -- running the plain chunk "
+                             "program (bitwise)")
+                self.compressor = None
 
         self.compressed_round_fn = None
         if mesh is None:
             self.round_fn = make_sim_round(spec, cfg, payload_fn, server_fn)
-            if self.compressor is not None:
+            if self.compressor is not None and not use_buckets:
                 from fedml_tpu.compression import make_compressed_sim_round
                 self.compressed_round_fn = make_compressed_sim_round(
                     spec, cfg, self.compressor, payload_fn, server_fn)
@@ -167,7 +173,7 @@ class FedAvgAPI:
                 spec, cfg, payload_fn, server_fn,
                 client_chunk=getattr(args, "client_chunk", 8) or 8,
                 batch_size=eff_bs, epochs=args.epochs,
-                edges=edges)
+                edges=edges, compressor=self.compressor)
             if async_policy is not None:
                 from fedml_tpu.resilience.async_agg import BufferedAggregator
                 self.async_agg = BufferedAggregator(async_policy)
@@ -263,7 +269,7 @@ class FedAvgAPI:
         self.round_idx = 0
         self.history = []
 
-        if self.compressed_round_fn is not None:
+        if self.compressor is not None:
             from fedml_tpu.compression import (ResidualStore,
                                                compressed_payload_nbytes,
                                                raw_payload_nbytes)
@@ -272,7 +278,10 @@ class FedAvgAPI:
             # they are sampled into -- DGC/EF-SignSGD semantics). Keyed by
             # STABLE client id, never cohort slot: re-sampled cohorts must
             # not cross-contaminate accumulators (regression-pinned in
-            # tests/test_compression.py)
+            # tests/test_compression.py). Shared by the packed compressed
+            # round and the bucketed streaming-EF path: dense device rows
+            # when the population fits dense_cap_gb, lazy host spill
+            # beyond (the unbounded-population contract)
             self._ef_store = ResidualStore(
                 self.global_state["params"],
                 num_clients=len(self.train_data_local_dict),
@@ -411,8 +420,13 @@ class FedAvgAPI:
                     self.global_state, self.server_state, datasets,
                     round_rng, data_rng=self._data_rng,
                     aggregator=self.async_agg,
-                    async_window=getattr(self, "_async_window", 4))
+                    async_window=getattr(self, "_async_window", 4),
+                    client_ids=client_indexes,
+                    residual_store=(self._ef_store
+                                    if self.compressor is not None
+                                    else None))
             self._last_bucket_info = info
+            self._last_cohort_size = len(client_indexes)
         elif self.device_data is not None:
             import jax.numpy as jnp
             client_indexes = self._sample_cohort(self.round_idx)
@@ -514,10 +528,13 @@ class FedAvgAPI:
             # async runs (metrics.jsonl observability contract) even when
             # the registry is off
             train_metrics.update(self._last_bucket_info.get("async") or {})
-        if self.compressed_round_fn is not None:
+        if self.compressor is not None:
             # client->server update traffic this round (uplink; the
             # downlink model broadcast is uncompressed and identical in
             # both regimes, so the ratio isolates what compression buys)
+            # -- the packed compressed round and the bucketed
+            # streaming-EF path account identically: per-client encoded
+            # bytes are static given the template
             cohort = self._last_cohort_size
             wire = self._payload_bytes * cohort
             raw = self._raw_payload_bytes * cohort

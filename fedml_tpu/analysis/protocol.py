@@ -117,15 +117,19 @@ class _TypeRef:
 
 class _MsgBuild:
     """One ``Message(TYPE, ...)`` build site and its observed payload:
-    the literal keys ``add()``-ed to it, and whether the schema is *open*
-    (a non-literal key, or the message escaping into a call the pass
-    cannot see may add more)."""
+    the literal keys ``add()``-ed to it, NAME-bound keys (module-level
+    string constants like ``WIRE_DELTA_KEY`` -- resolved through the
+    same constant/import machinery as message types, so the compressed-
+    report schema stays judged instead of going open), and whether the
+    schema is *open* (a computed key, or the message escaping into a
+    call the pass cannot see may add more)."""
 
-    __slots__ = ("type_ref", "keys", "open")
+    __slots__ = ("type_ref", "keys", "named_keys", "open")
 
     def __init__(self, type_ref):
         self.type_ref = type_ref
-        self.keys = {}     # key -> add-call node
+        self.keys = {}       # key -> add-call node
+        self.named_keys = []  # [_TypeRef] constant-named keys
         self.open = False
 
 
@@ -266,6 +270,33 @@ def _sent_types(func, class_sends):
     return sent
 
 
+def _const_named_key(expr, bound):
+    """True when a payload-key expression names something the constant
+    index can meaningfully resolve: a bare Name not bound locally, or a
+    ``Mod.CONST``-style Attribute (instance attrs -- ``self.x`` -- and
+    locally bound names are runtime values, not module constants)."""
+    if isinstance(expr, ast.Name):
+        return expr.id not in bound
+    if isinstance(expr, ast.Attribute):
+        return not (isinstance(expr.value, ast.Name)
+                    and (expr.value.id == "self" or expr.value.id in bound))
+    return False
+
+
+def _locally_bound(meth):
+    """Names bound anywhere inside ``meth`` (params, assignments, loop/
+    with/comprehension targets): a key NAMED by one of these is a local
+    value, never the module constant of the same spelling -- resolving
+    it through the constant index would be unsound (the FL115 scoping
+    lesson), so such keys keep the old open/opaque disposition."""
+    bound = {a.arg for a in meth.args.args}
+    bound.update(a.arg for a in meth.args.kwonlyargs)
+    for node in ast.walk(meth):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            bound.add(node.id)
+    return bound
+
+
 def _extract_builds(meth):
     """``Message(TYPE, ...)`` build sites in one method with their
     ``add()``-ed literal keys (FL128's send-side schema). A non-literal
@@ -274,6 +305,7 @@ def _extract_builds(meth):
     the pass then refuses to judge read-never-set for that type."""
     builds = {}       # id(Message call node) -> _MsgBuild
     var_builds = {}   # local var name -> _MsgBuild
+    bound = _locally_bound(meth)
     for node in ast.walk(meth):
         if not isinstance(node, ast.Call):
             continue
@@ -302,6 +334,12 @@ def _extract_builds(meth):
                 if node.args and isinstance(node.args[0], ast.Constant) \
                         and isinstance(node.args[0].value, str):
                     b.keys.setdefault(node.args[0].value, node)
+                elif node.args and _const_named_key(node.args[0], bound):
+                    # constant-NAMED key (msg.add(WIRE_DELTA_KEY, ...)):
+                    # resolved at check time through the module-constant
+                    # + import index; unresolvable names open the schema
+                    b.named_keys.append(
+                        _type_expr_ref(node.args[0], node))
                 else:
                     b.open = True
                 continue
@@ -318,20 +356,37 @@ def _extract_builds(meth):
     return list(builds.values())
 
 
-def _handler_reads(meth):
+def _handler_reads(meth, resolve_helper=None, _param_idx=1, _depth=0,
+                   _seen=None):
     """Literal payload reads of a handler's message parameter ->
-    ``(reads {key: node}, transparent)``. ``transparent`` is False when
-    the handler's reads are not fully visible to this pass: the
-    parameter escapes (passed to a call, aliased, rebound), a dynamic
-    read hides the key (``msg.get(var)``, ``msg.get_params()`` -- the
-    whole dict walks away), or the message is subscript-written (the
-    handler mutates/forwards it). Set-never-read judgments are then
-    suppressed for its type."""
+    ``(reads {key: node}, named_reads [_TypeRef], transparent)``.
+    ``named_reads`` are constant-NAMED keys (``msg.get(WIRE_DELTA_KEY)``
+    / ``msg[SOME_KEY]``), resolved at check time through the module-
+    constant + import index -- the compressed-report vocabulary rides
+    shared constants, and treating those reads as dynamic would turn
+    the whole report schema opaque.
+
+    ``resolve_helper(name) -> methodDef|None`` lets the walk FOLLOW the
+    message into same-class helpers (``self._report_payload(msg)`` --
+    both servers route compressed reports through one): the helper's
+    reads merge into the handler's, positionally mapped onto the
+    forwarded parameter. Unresolvable helpers, non-positional forwards
+    and recursion keep the old escape disposition.
+
+    ``transparent`` is False when the handler's reads are not fully
+    visible to this pass: the parameter escapes (passed to an
+    un-followable call, aliased, rebound), a truly dynamic read hides
+    the key (``msg.get(f())``, ``msg.get_params()`` -- the whole dict
+    walks away), or the message is subscript-written (the handler
+    mutates/forwards it). Set-never-read judgments are then suppressed
+    for its type."""
     params = [a.arg for a in meth.args.args]
-    if meth.args.vararg or meth.args.kwarg or len(params) < 2:
-        return {}, False
-    msg = params[1]  # (self, msg, ...)
-    reads, allowed = {}, set()
+    if meth.args.vararg or meth.args.kwarg or len(params) <= _param_idx:
+        return {}, [], False
+    msg = params[_param_idx]
+    reads, named, allowed = {}, [], set()
+    bound = _locally_bound(meth)
+    _seen = set() if _seen is None else _seen
     opaque = False
     for node in ast.walk(meth):
         if isinstance(node, ast.Call) \
@@ -346,8 +401,10 @@ def _handler_reads(meth):
                         and isinstance(node.args[0], ast.Constant) \
                         and isinstance(node.args[0].value, str):
                     reads.setdefault(node.args[0].value, node)
+                elif node.args and _const_named_key(node.args[0], bound):
+                    named.append(_type_expr_ref(node.args[0], node))
                 else:
-                    opaque = True  # dynamic key: a read we cannot see
+                    opaque = True  # computed key: a read we cannot see
             elif node.func.attr in ("get_params", "to_string"):
                 # the whole payload dict escapes: any key may be read
                 opaque = True
@@ -360,8 +417,41 @@ def _handler_reads(meth):
             elif isinstance(node.slice, ast.Constant) \
                     and isinstance(node.slice.value, str):
                 reads.setdefault(node.slice.value, node)
+            elif _const_named_key(node.slice, bound):
+                named.append(_type_expr_ref(node.slice, node))
             else:
-                opaque = True  # msg[var]: dynamic read
+                opaque = True  # msg[computed]: dynamic read
+        elif (isinstance(node, ast.Call) and resolve_helper is not None
+              and _depth < 4
+              and isinstance(node.func, ast.Attribute)
+              and isinstance(node.func.value, ast.Name)
+              and node.func.value.id == "self"):
+            # self._helper(.., msg, ..): follow the forward when the
+            # helper resolves in this class context and msg rides a
+            # plain positional slot (anything fancier stays an escape)
+            pos = [i for i, a in enumerate(node.args)
+                   if isinstance(a, ast.Name) and a.id == msg]
+            in_kw = any(isinstance(kw.value, ast.Name)
+                        and kw.value.id == msg for kw in node.keywords)
+            if not pos and not in_kw:
+                continue
+            helper = (resolve_helper(node.func.attr)
+                      if len(pos) == 1 and not in_kw else None)
+            key = (node.func.attr, pos[0] if pos else -1)
+            if helper is None or key in _seen:
+                opaque = True
+                continue
+            h_reads, h_named, h_transparent = _handler_reads(
+                helper, resolve_helper, _param_idx=pos[0] + 1,
+                _depth=_depth + 1, _seen=_seen | {key})
+            for k, n in h_reads.items():
+                reads.setdefault(k, n)
+            named.extend(h_named)
+            if not h_transparent:
+                opaque = True
+            for a in node.args:
+                if isinstance(a, ast.Name) and a.id == msg:
+                    allowed.add(id(a))
     transparent = not opaque
     for node in ast.walk(meth):
         # params are ast.arg nodes, so every Name here is a USE; any use
@@ -370,7 +460,7 @@ def _handler_reads(meth):
         if isinstance(node, ast.Name) and node.id == msg \
                 and id(node) not in allowed:
             transparent = False
-    return reads, transparent
+    return reads, named, transparent
 
 
 class _ActContext:
@@ -816,6 +906,21 @@ def _check_payload_schema(index, fsms, emit):
                 t, {"keys": {}, "open": False})
             for k, node in b.keys.items():
                 ent["keys"].setdefault(k, (mod, node))
+            for kref in b.named_keys:
+                # constant-named key (WIRE_DELTA_KEY): resolved through
+                # the same constant/import index as message types. Out
+                # of static reach (single-file runs: the constant's
+                # defining module is outside the fileset), the key is
+                # credited by NAME -- the PEER_LOST precedent -- and
+                # pairs against a same-named read at judgment time
+                k = _resolved(index, mod, kref)
+                if k is not None:
+                    ent["keys"].setdefault(k, (mod, kref.node))
+                elif kref.name is not None:
+                    ent.setdefault("named", {}).setdefault(
+                        kref.name, (mod, kref.node))
+                else:
+                    ent["open"] = True
             ent["open"] = ent["open"] or b.open
         for (tref, hname) in cls.handler_map:
             t = _resolved(index, mod, tref)
@@ -829,18 +934,31 @@ def _check_payload_schema(index, fsms, emit):
             if meth is None:
                 ent["opaque"] = True
                 continue
-            reads, transparent = _handler_reads(meth)
+            reads, named_reads, transparent = _handler_reads(
+                meth, resolve_helper=lambda n, _c=cls, _m=mod:
+                    _resolve_handler(index, _c, _m, n)[2])
             ent["opaque"] = ent["opaque"] or not transparent
             for k, node in reads.items():
                 ent["keys"].setdefault(k, (omod, node))
+            for kref in named_reads:
+                k = _resolved(index, omod, kref)
+                if k is not None:
+                    ent["keys"].setdefault(k, (omod, kref.node))
+                elif kref.name is not None:
+                    ent.setdefault("named", {}).setdefault(
+                        kref.name, (omod, kref.node))
+                else:
+                    ent["opaque"] = True
 
     def merged(table, role):
         out = {}
         for r in _WANT[role]:
             for t, ent in table.get(r, {}).items():
-                cur = out.setdefault(t, {"keys": {}, "open": False,
-                                         "opaque": False, "n": 0})
+                cur = out.setdefault(t, {"keys": {}, "named": {},
+                                         "open": False, "opaque": False,
+                                         "n": 0})
                 cur["keys"].update(ent["keys"])
+                cur["named"].update(ent.get("named", {}))
                 cur["open"] = cur["open"] or ent.get("open", False)
                 cur["opaque"] = cur["opaque"] or ent.get("opaque", False)
                 cur["n"] += ent.get("n", 0)
@@ -853,9 +971,15 @@ def _check_payload_schema(index, fsms, emit):
             sch = peer_schema.get(t)
             if sch is None:
                 continue  # nothing sends the type at all: FL120's finding
+            # an UNRESOLVED named add with no same-named read could be
+            # setting any key (incl. one a resolved read wants): it
+            # opens the schema for this judgment; name-paired adds are
+            # accounted for by their paired read
+            sch_open = sch["open"] or bool(
+                set(sch["named"]) - set(ent.get("named", {})))
             for k, (kmod, knode) in sorted(ent["keys"].items()):
                 if k in _RESERVED_KEYS or k.startswith("__") \
-                        or k in sch["keys"] or sch["open"] \
+                        or k in sch["keys"] or sch_open \
                         or ("r", t, k) in emitted:
                     continue
                 emitted.add(("r", t, k))
@@ -869,8 +993,13 @@ def _check_payload_schema(index, fsms, emit):
         peer_reads = merged(readers, role)
         for t, ent in sorted(schemas[role].items()):
             rd = peer_reads.get(t)
-            if rd is None or rd["opaque"] or rd["n"] == 0:
+            if rd is None or rd["n"] == 0:
                 continue  # unhandled type (FL120) or unseeable reads
+            # an UNRESOLVED named read with no same-named add may be
+            # reading any key: treat the reader as opaque here
+            if rd["opaque"] or bool(set(rd["named"])
+                                    - set(ent.get("named", {}))):
+                continue
             for k, (kmod, knode) in sorted(ent["keys"].items()):
                 if k in _RESERVED_KEYS or k.startswith("__") \
                         or k in rd["keys"] or ("s", t, k) in emitted:

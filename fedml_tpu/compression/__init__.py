@@ -14,6 +14,11 @@ Two layers, composable and separately usable:
   round (error feedback carried per client across rounds) and on-wire byte
   accounting behind the per-round ``bytes_on_wire`` /
   ``compression_ratio`` metrics fields.
+- :mod:`~fedml_tpu.compression.wire` -- host (numpy-only) compressors for
+  the DISTRIBUTED uplink: clients ship EF-compressed update deltas
+  (``cdelta`` + ``compressor`` report keys) and the servers fold them
+  sparsely/quantized through the canonical fp64 fold without densifying
+  per report. Importable without jax (the soak swarm's path).
 
 Exports resolve lazily so that importing :mod:`.codec` (directly or from
 the transports) never drags in jax via this package ``__init__`` --
@@ -33,6 +38,10 @@ _EXPORTS = {
     "fedml_tpu.compression.integration": (
         "make_compressed_sim_round", "ResidualStore",
         "compressed_payload_nbytes", "raw_payload_nbytes"),
+    "fedml_tpu.compression.wire": (
+        "host_compressor", "HostCompressor", "CompressedUpdate",
+        "ef_step", "encode_rng", "wire_payload_nbytes",
+        "WIRE_DELTA_KEY", "WIRE_SPEC_KEY"),
 }
 
 __all__ = [name for names in _EXPORTS.values() for name in names]

@@ -1,0 +1,392 @@
+"""Host-side wire compressors: the distributed uplink's byte diet.
+
+The jit compressors (:mod:`.compressors`) run inside the simulated round
+on device; this module is their host twin for the REAL wire -- pure
+numpy, importable without jax (the soak swarm and the transports must
+stay jax-free), and free to exploit what the binary codec can frame that
+device storage cannot: sub-byte code packing.
+
+A compressed report replaces the ``params`` payload with
+
+    cdelta      encoded pytree of the client's EF-compressed update delta
+    compressor  the spec string the client encoded with
+
+and keeps ``round`` as the delta's BASE reference: the delta is relative
+to the model the client trained on, so the server reconstructs against
+the params it issued at that round/version. Error feedback follows
+DGC/EF-SignSGD for the BIASED compressors (topk, signsgd): the client
+compresses ``delta + residual`` and keeps ``residual' = input -
+decoded``, with the residual keyed by the client's STABLE rank id -- one
+accumulator per client across every round it reports into. qsgd is
+UNBIASED stochastic rounding and deliberately runs WITHOUT feedback
+(``HostQSGD.ef = False``): composing EF with a wide-cell unbiased
+quantizer is an amplifier, not a corrector -- the residual absorbs
+per-entry noise of magnitude ~``scale = max|x|``, which inflates the
+next round's scale, which inflates the noise; measured on the ternary
+wire spec, the closed loop's residual grows EXPONENTIALLY (pinned in
+``TestWireCompressors::test_qsgd_closed_loop_is_stable``'s with-feedback
+counterexample). Unbiased quantizers converge by averaging (the QSGD
+argument); feedback is what makes biased contractions converge.
+
+Encoded leaf schemas (all values numpy; ``shape``/``dtype`` ride the
+frame's JSON header as plain scalars):
+
+- qsgd:    ``{"qp": uint8 bit-packed codes, "scale": f32[], "bits": B,
+             "shape": [...], "dtype": name}`` -- codes are stochastic
+  uniform quantization to ``2^(B-1)-1`` signed levels, packed at B bits
+  per element. On the wire, ``bits`` finally buys bytes (the device
+  codec stores int8 regardless -- its documented tradeoff), so the bare
+  ``qsgd`` spec here defaults to B=2: ternary codes + per-leaf fp32
+  scale + error feedback (the TernGrad regime), 16x smaller than fp32.
+- topk:    ``{"values": f32[k], "indices": int32[k] (sorted), "shape",
+             "dtype"}`` -- magnitude top-k, k = ceil(ratio * size).
+- signsgd: ``{"sign": bool[...], "scale": f32[], "dtype"}`` -- the codec
+  bit-packs bool arrays, so signs cost 1 bit/element on the wire.
+
+The server never densifies a topk report to O(model): the
+:class:`CompressedUpdate` payload folds its decoded update INTO the
+shared fp64 accumulator sparsely (O(k) per report), and the canonical
+fold (:func:`~fedml_tpu.resilience.policy.fold_entries_fp64`) adds each
+distinct BASE exactly once, scaled by its entries' weight sum. See
+docs/COMPRESSION.md "Distributed wire path" for what the bitwise
+contract means under lossy compression.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+
+#: report-message keys of the compressed schema (shared vocabulary for
+#: the FSMs, the swarm, and the fedcheck FL128 payload-schema pass)
+WIRE_DELTA_KEY = "cdelta"
+WIRE_SPEC_KEY = "compressor"
+
+
+def pack_codes(codes, bits: int) -> np.ndarray:
+    """Signed codes in ``[-L, L]`` (``L = 2^(bits-1) - 1``) -> uint8
+    array of ``ceil(n * bits / 8)`` bytes (offset-binary, big-endian bit
+    order). ``bits == 8`` passes through as the two's-complement byte.
+
+    The even widths (2/4 bits: 4 or 2 codes per byte) pack by shifts
+    over the flat uint8 array -- the swarm encodes thousands of reports
+    per second on one core, and the generic ``unpackbits`` matrix walk
+    was the measured encode hot spot (~10x slower). Odd widths keep the
+    generic path; both produce identical bytes (fuzz-pinned)."""
+    codes = np.asarray(codes)
+    if bits == 8:
+        return codes.astype(np.int8).view(np.uint8).reshape(-1)
+    levels = 2 ** (bits - 1) - 1
+    u = (codes.reshape(-1).astype(np.int16) + levels).astype(np.uint8)
+    if bits in (2, 4):
+        per = 8 // bits
+        pad = (-len(u)) % per
+        if pad:
+            u = np.concatenate([u, np.zeros(pad, np.uint8)])
+        m = u.reshape(-1, per)
+        out = np.zeros(len(m), np.uint8)
+        for j in range(per):  # big-endian bit order, MSB field first
+            out |= m[:, j] << (8 - bits * (j + 1))
+        return out
+    bitmat = np.unpackbits(u[:, None], axis=1)[:, 8 - bits:]
+    return np.packbits(bitmat.reshape(-1))
+
+
+def unpack_codes(packed, n: int, bits: int) -> np.ndarray:
+    """Inverse of :func:`pack_codes`: first ``n`` codes as int8."""
+    packed = np.asarray(packed, np.uint8)
+    if bits == 8:
+        return packed.view(np.int8)[:n].copy()
+    levels = 2 ** (bits - 1) - 1
+    if bits in (2, 4):
+        per = 8 // bits
+        mask = (1 << bits) - 1
+        shifts = [8 - bits * (j + 1) for j in range(per)]
+        m = np.empty((len(packed), per), np.uint8)
+        for j, s in enumerate(shifts):
+            m[:, j] = (packed >> s) & mask
+        u = m.reshape(-1)[:n]
+        return (u.astype(np.int16) - levels).astype(np.int8)
+    bitmat = np.unpackbits(packed, count=n * bits).reshape(n, bits)
+    weights = (1 << np.arange(bits - 1, -1, -1)).astype(np.uint8)
+    u = bitmat.astype(np.int16) @ weights.astype(np.int16)
+    return (u - levels).astype(np.int8)
+
+
+def packed_nbytes(size: int, bits: int) -> int:
+    return (size * bits + 7) // 8
+
+
+class HostCompressor:
+    """Per-leaf numpy ``encode``/``decode`` lifted over flat param dicts
+    (the control plane's payloads are ``{name: ndarray}``; nested
+    pytrees are not needed on this path)."""
+
+    name = "none"
+    spec = "none"
+    #: whether :func:`ef_step` accumulates an error-feedback residual
+    #: through this compressor. True for biased contractions (topk,
+    #: signsgd -- EF is what makes them converge); False for unbiased
+    #: quantizers (qsgd -- feedback amplifies their variance into an
+    #: exponentially growing residual, see the module docstring).
+    ef = True
+
+    def encode_leaf(self, x, rng):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def decode_leaf(self, enc):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def fold_leaf(self, acc, enc, scale: float):
+        """Accumulate ``scale * float64(decode_leaf(enc))`` into the f64
+        array ``acc`` in place. Subclasses override where the decoded
+        form is sparse (topk: O(k), never densified)."""
+        acc += float(scale) * self.decode_leaf(enc).astype(np.float64)
+
+    def encode(self, tree, rng):
+        return {k: self.encode_leaf(np.asarray(tree[k], np.float32), rng)
+                for k in sorted(tree)}
+
+    def decode(self, enc_tree):
+        return {k: self.decode_leaf(enc_tree[k]) for k in sorted(enc_tree)}
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.spec!r})"
+
+
+class HostQSGD(HostCompressor):
+    """Stochastic uniform quantization, bit-packed at the code width.
+
+    ``bits`` in [2, 8]; levels = ``2^(bits-1) - 1``. Unlike the device
+    compressor (int8 storage either way), the wire packs codes at
+    exactly ``bits`` bits per element, so the bare ``qsgd`` wire spec
+    defaults to 2 -- ternary {-1, 0, +1} codes (the TernGrad regime).
+    Unbiased by stochastic rounding, so it runs WITHOUT error feedback
+    (``ef = False``; see the module docstring for the measured
+    instability feedback causes here)."""
+
+    name = "qsgd"
+    ef = False
+
+    def __init__(self, bits=2):
+        if not 2 <= int(bits) <= 8:
+            raise ValueError(f"qsgd bits must be in [2, 8], got {bits}")
+        self.bits = int(bits)
+        self.levels = 2 ** (self.bits - 1) - 1
+        self.spec = f"qsgd:{self.bits}"
+
+    def encode_leaf(self, x, rng):
+        scale = float(np.max(np.abs(x))) if x.size else 0.0
+        safe = max(scale, 1e-30)
+        # f32 throughout: the quantizer's correctness is its value range
+        # (stochastic rounding stays unbiased given the scale), and the
+        # f64 walk doubled the swarm's per-report encode cost
+        y = x.astype(np.float32) * np.float32(self.levels / safe)
+        noise = rng.random(x.shape, dtype=np.float32)
+        q = np.clip(np.floor(y + noise),
+                    -self.levels, self.levels).astype(np.int8)
+        return {"qp": pack_codes(q, self.bits),
+                "scale": np.float32(scale), "bits": self.bits,
+                "shape": [int(d) for d in x.shape], "dtype": str(x.dtype)}
+
+    def decode_leaf(self, enc):
+        shape = tuple(enc["shape"])
+        size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        bits = int(enc["bits"])
+        levels = 2 ** (bits - 1) - 1
+        q = unpack_codes(np.asarray(enc["qp"]), size, bits)
+        y = q.astype(np.float32) * (np.float32(enc["scale"])
+                                    / np.float32(levels))
+        return y.reshape(shape).astype(enc["dtype"])
+
+
+class HostTopK(HostCompressor):
+    """Magnitude top-k sparsification; indices sorted ascending (one
+    canonical encoded form, and the sparse fold walks memory in order)."""
+
+    name = "topk"
+
+    def __init__(self, ratio=0.01):
+        if not 0 < ratio <= 1:
+            raise ValueError(f"topk ratio must be in (0, 1], got {ratio}")
+        self.ratio = float(ratio)
+        self.spec = f"topk:{self.ratio}"
+
+    def encode_leaf(self, x, rng):
+        del rng
+        flat = x.reshape(-1)
+        k = max(1, int(math.ceil(self.ratio * max(flat.size, 1))))
+        if k >= flat.size:
+            idx = np.arange(flat.size, dtype=np.int32)
+        else:
+            idx = np.sort(np.argpartition(np.abs(flat), -k)[-k:]
+                          ).astype(np.int32)
+        return {"values": flat[idx].astype(np.float32), "indices": idx,
+                "shape": [int(d) for d in x.shape], "dtype": str(x.dtype)}
+
+    def decode_leaf(self, enc):
+        shape = tuple(enc["shape"])
+        size = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        flat = np.zeros(size, enc["dtype"])
+        flat[np.asarray(enc["indices"])] = np.asarray(
+            enc["values"]).astype(enc["dtype"])
+        return flat.reshape(shape)
+
+    def fold_leaf(self, acc, enc, scale: float):
+        # O(k): only the kept coordinates touch the accumulator -- the
+        # decoded update is zeros elsewhere, so this IS
+        # scale * f64(decode), never densified per report
+        vals = np.asarray(enc["values"]).astype(
+            enc["dtype"]).astype(np.float64)
+        np.add.at(acc.reshape(-1), np.asarray(enc["indices"]),
+                  float(scale) * vals)
+
+
+class HostSignSGD(HostCompressor):
+    """1-bit sign + per-leaf mean-|x| magnitude; the codec bit-packs the
+    bool sign array to 1 bit/element on the wire."""
+
+    name = "signsgd"
+    spec = "signsgd"
+
+    def encode_leaf(self, x, rng):
+        del rng
+        return {"sign": x >= 0,
+                "scale": np.float32(np.mean(np.abs(x)) if x.size else 0.0),
+                "dtype": str(x.dtype)}
+
+    def decode_leaf(self, enc):
+        sign = np.asarray(enc["sign"])
+        scale = np.float32(enc["scale"])
+        return np.where(sign, scale, -scale).astype(enc["dtype"])
+
+
+_HOST_REGISTRY = {"qsgd": HostQSGD, "topk": HostTopK,
+                  "signsgd": HostSignSGD}
+
+
+def host_compressor(spec):
+    """Spec string -> host compressor (``None``/``none``/empty -> None:
+    the driver keeps today's plain-``params`` path, bitwise-identical to
+    before -- there is no identity wire transform, by design).
+
+    Grammar matches :func:`.compressors.get_compressor` (``qsgd:4``,
+    ``topk:0.01``, ``signsgd``) with one documented divergence: bare
+    ``qsgd`` defaults to 2 bits here (the wire packs sub-byte codes, so
+    narrow widths finally buy bytes) while the device compressor's
+    int8-storage default stays 8."""
+    if spec is None or isinstance(spec, HostCompressor):
+        return spec
+    s = str(spec).strip().lower()
+    if not s or s in ("0", "off", "false", "none"):
+        return None
+    name, _, arg = s.partition(":")
+    if name == "randk":
+        raise ValueError("randk is a sim-only compressor (unbiased "
+                         "sparsification needs the shared rng stream); "
+                         "use topk on the wire")
+    if name not in _HOST_REGISTRY:
+        raise ValueError(f"unknown wire compressor {name!r} "
+                         f"(known: {sorted(_HOST_REGISTRY)})")
+    cls = _HOST_REGISTRY[name]
+    if not arg:
+        return cls()
+    if name == "topk":
+        return cls(ratio=float(arg))
+    if name == "qsgd":
+        return cls(bits=int(arg))
+    raise ValueError(f"wire compressor {name!r} takes no argument "
+                     f"(got {arg!r})")
+
+
+def encode_rng(seed_tuple) -> np.random.Generator:
+    """The one seeded stream rule for wire encodes: keyed (never
+    sequential) on ``(rank, round/version, attempt)`` so two runs over
+    the same schedule encode bit-identically regardless of thread
+    timing."""
+    return np.random.default_rng((0x5EED, *map(int, seed_tuple)))
+
+
+def ef_step(compressor: HostCompressor, delta, residual, rng):
+    """One uplink compression step over flat param dicts (numpy). For
+    EF compressors (``compressor.ef``, the biased contractions):
+    ``enc = encode(delta + residual)``, ``decoded`` is the server's view,
+    ``residual' = (delta + residual) - decoded``; ``residual`` of None
+    means a zero accumulator (first report of this client). For unbiased
+    compressors (qsgd): ``enc = encode(delta)`` and the returned residual
+    is always None -- feedback deliberately off (module docstring)."""
+    if not compressor.ef:
+        enc = compressor.encode(
+            {k: np.asarray(delta[k], np.float32) for k in sorted(delta)},
+            rng)
+        return enc, compressor.decode(enc), None
+    comp_in = {k: np.asarray(delta[k], np.float32)
+               + (np.float32(0) if residual is None
+                  else residual[k]) for k in sorted(delta)}
+    enc = compressor.encode(comp_in, rng)
+    decoded = compressor.decode(enc)
+    new_residual = {k: comp_in[k] - decoded[k] for k in comp_in}
+    return enc, decoded, new_residual
+
+
+@dataclass(frozen=True)
+class CompressedUpdate:
+    """A compressed report's payload as the fold sees it: the encoded
+    delta plus the BASE params it is relative to (resolved by the server
+    from the round/version the client reported against).
+
+    :func:`~fedml_tpu.resilience.policy.fold_entries_fp64` folds these
+    without densifying: each entry contributes
+    ``scale * float64(decode(enc))`` into the shared f64 accumulator
+    (O(k) for topk), and each DISTINCT base contributes
+    ``(sum of its entries' scales) * float64(base)`` exactly once, in
+    sorted ``base_key`` order -- so the fold stays sorted-key
+    deterministic and the async oracle (decay 0, one shared base per
+    window) still equals the synchronous fold bitwise.
+    """
+
+    enc: dict
+    spec: str
+    base: dict
+    base_key: int = 0
+    _comp: HostCompressor = field(default=None, compare=False, repr=False)
+
+    def compressor(self) -> HostCompressor:
+        c = self._comp or host_compressor(self.spec)
+        if c is None:
+            raise ValueError(f"CompressedUpdate with a plain spec "
+                             f"{self.spec!r}")
+        return c
+
+    def fold_delta(self, acc, scale: float):
+        """Accumulate this entry's decoded-delta contribution into
+        ``acc`` (``{name: float64 ndarray}``; None allocates zeros from
+        the base's shapes) and return it."""
+        if acc is None:
+            acc = {k: np.zeros(np.shape(self.base[k]), np.float64)
+                   for k in sorted(self.base)}
+        comp = self.compressor()
+        for k in sorted(self.enc):
+            comp.fold_leaf(acc[k], self.enc[k], scale)
+        return acc
+
+
+def wire_payload_nbytes(compressor, template) -> int:
+    """Exact on-wire bytes of one compressed report's ``cdelta`` section
+    through the binary codec, computed from the template's shapes alone
+    (encode a zero update -- sizes are shape-static). The uncompressed
+    floor is :func:`tree_wire_nbytes` of the raw template."""
+    from fedml_tpu.compression.codec import tree_wire_nbytes
+
+    zeros = {k: np.zeros(np.shape(v), np.float32)
+             for k, v in template.items()}
+    enc = compressor.encode(zeros, encode_rng((0, 0, 0)))
+    return tree_wire_nbytes(enc)
+
+
+__all__ = ["WIRE_DELTA_KEY", "WIRE_SPEC_KEY", "HostCompressor", "HostQSGD",
+           "HostTopK", "HostSignSGD", "host_compressor", "encode_rng",
+           "ef_step", "CompressedUpdate", "pack_codes", "unpack_codes",
+           "packed_nbytes", "wire_payload_nbytes"]

@@ -11,6 +11,8 @@ it is XLA collectives inside the jitted round step.
 from __future__ import annotations
 
 import abc
+import time
+from collections import deque
 
 #: Synthesized by transports when a peer's pipe dies WITHOUT a clean in-band
 #: shutdown (process crash, power-off, network partition). ``sender_id`` is
@@ -27,6 +29,45 @@ MSG_TYPE_PEER_LOST = "__peer_lost__"
 #: future cohorts; without one the event is logged and dropped (rejoin
 #: then only restores the transport route, not cohort membership).
 MSG_TYPE_PEER_JOIN = "__peer_join__"
+
+
+class RejoinWindow:
+    """Sliding-window admission limiter for rejoin HELLOs, shared by the
+    threaded tcp hub and the event-loop hub so the contract cannot
+    diverge: at most ``burst`` re-admissions per ``window_s``; excess
+    arrivals park on ``deferred`` (connection open, handshake held) and
+    admit in arrival order as the window refills -- deferred, never
+    dropped. Single-consumer: each transport drives it from the one
+    thread that owns its accept path (no lock)."""
+
+    def __init__(self, burst, window_s):
+        self.burst = max(1, int(burst))
+        self.window_s = float(window_s)
+        self._admits = deque()   # monotonic admission times in the window
+        self.deferred = deque()  # (conn, rank) parked by the limiter
+
+    def _prune(self, now):
+        while self._admits and now - self._admits[0] > self.window_s:
+            self._admits.popleft()
+
+    def try_admit(self):
+        """One fresh arrival: True = admitted (counted against the
+        window); False = the caller must park it on ``deferred`` (a
+        fresh arrival never jumps ahead of earlier parks)."""
+        now = time.monotonic()
+        self._prune(now)
+        if self.deferred or len(self._admits) >= self.burst:
+            return False
+        self._admits.append(now)
+        return True
+
+    def drain(self):
+        """Yield parked ``(conn, rank)`` entries admissible now, oldest
+        first, counting each against the window."""
+        self._prune(time.monotonic())
+        while self.deferred and len(self._admits) < self.burst:
+            self._admits.append(time.monotonic())
+            yield self.deferred.popleft()
 
 
 class Observer(abc.ABC):

@@ -45,7 +45,7 @@ from fedml_tpu.compression.codec import (DECODE_ERRORS, MAGIC,
                                          parse_wire_header)
 from fedml_tpu.core.comm.base import (BaseCommunicationManager,
                                       MSG_TYPE_PEER_JOIN,
-                                      MSG_TYPE_PEER_LOST)
+                                      MSG_TYPE_PEER_LOST, RejoinWindow)
 from fedml_tpu.core.message import Message
 from fedml_tpu.net.ingest import note_ingest
 
@@ -121,10 +121,22 @@ class TcpCommManager(BaseCommunicationManager):
     """
 
     def __init__(self, host, port, rank, world_size, timeout=60.0,
-                 binary=True, metrics_logger=None):
+                 binary=True, metrics_logger=None, rejoin_burst=16,
+                 rejoin_window_s=1.0):
         self.rank = int(rank)
         self.world_size = int(world_size)
         self._binary = bool(binary)
+        # rejoin-storm rate limit (rank 0): at most rejoin_burst
+        # re-admissions per rejoin_window_s sliding window. A healed
+        # partition HELLOs everyone back at once; unthrottled, the
+        # admission burst (serve threads + PEER_JOIN dispatch + per-rank
+        # re-sync each) lands on the FSM as one spike. Excess HELLOs are
+        # DEFERRED -- the connection parks with its handshake held, and
+        # admits as the window refills -- never dropped; counted by
+        # fed_peer_rejoins_deferred_total.
+        self.rejoin_burst = max(1, int(rejoin_burst))
+        self.rejoin_window_s = float(rejoin_window_s)
+        self.rejoins_deferred = 0
         #: payload bytes through this manager (sends + relays / receives),
         #: excluding the 4-byte length prefix; callers can poll these and
         #: forward to MetricsLogger.count_wire for bytes_on_wire accounting
@@ -376,52 +388,87 @@ class TcpCommManager(BaseCommunicationManager):
         announced to the observers as ``MSG_TYPE_PEER_JOIN`` so the FSM
         can return it to the alive set. Invalid or duplicate HELLOs
         close the connection -- the loop itself must never die to one
-        bad dialer."""
+        bad dialer.
+
+        Rejoin-storm rate limiting: admissions are throttled to
+        ``rejoin_burst`` per ``rejoin_window_s`` sliding window; excess
+        HELLOs park on a deferral queue (connection open, handshake
+        held) and admit as the window refills, in arrival order --
+        validity (duplicate/out-of-range) is judged at ADMIT time,
+        since a deferred rank's state can change while it waits."""
         try:
             self._listener.settimeout(0.25)
         except OSError:
             return  # already closed: teardown won the race
-        while self._running:
-            try:
-                conn, _addr = self._listener.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                return  # listener closed: teardown
-            try:
-                conn.settimeout(10.0)
-                hello = json.loads(_recv_frame(conn).decode())
-                peer_rank = int(hello["rank"])
-                conn.settimeout(None)  # see __init__: idle != dead
-                _enable_keepalive(conn)
-            except (ValueError, KeyError, TypeError, UnicodeDecodeError,
-                    ConnectionError, OSError):
-                logging.warning("tcp hub: undecodable rejoin HELLO -- "
-                                "closing")
+        window = RejoinWindow(self.rejoin_burst, self.rejoin_window_s)
+        try:
+            while self._running:
+                for conn, peer_rank in window.drain():
+                    self._admit_rejoin(conn, peer_rank)
+                try:
+                    conn, _addr = self._listener.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return  # listener closed: teardown
+                try:
+                    conn.settimeout(10.0)
+                    hello = json.loads(_recv_frame(conn).decode())
+                    peer_rank = int(hello["rank"])
+                    conn.settimeout(None)  # see __init__: idle != dead
+                    _enable_keepalive(conn)
+                except (ValueError, KeyError, TypeError, UnicodeDecodeError,
+                        ConnectionError, OSError):
+                    logging.warning("tcp hub: undecodable rejoin HELLO -- "
+                                    "closing")
+                    _hard_close(conn)
+                    continue
+                if not window.try_admit():
+                    window.deferred.append((conn, peer_rank))
+                    self._note_rejoin_deferred(peer_rank)
+                    continue
+                self._admit_rejoin(conn, peer_rank)
+        finally:
+            for conn, _rank in window.deferred:  # teardown: no rejoin
                 _hard_close(conn)
-                continue
-            with self._lock:
-                bad = (peer_rank <= 0 or peer_rank >= self.world_size
-                       or peer_rank in self._peers)
-                if not bad:
-                    self._peers[peer_rank] = conn
-                    self._send_locks[peer_rank] = io_lock()
-                    self._lost_notified.discard(peer_rank)
-            if bad:
-                logging.warning(
-                    "tcp hub: rejected rejoin HELLO rank %s (duplicate "
-                    "or out-of-range for world size %s)", peer_rank,
-                    self.world_size)
-                _hard_close(conn)
-                continue
-            t = threading.Thread(target=self._serve_peer,
-                                 args=(conn, peer_rank), daemon=True,
-                                 name=f"tcp-serve-{peer_rank}")
-            with self._lock:
-                self._serve_threads.append(t)
-            t.start()
-            logging.warning("tcp hub: rank %d rejoined", peer_rank)
-            self._notify_peer_join(peer_rank)
+
+    def _admit_rejoin(self, conn, peer_rank):
+        """Route one accepted rejoin HELLO (validity judged here)."""
+        with self._lock:
+            bad = (peer_rank <= 0 or peer_rank >= self.world_size
+                   or peer_rank in self._peers)
+            if not bad:
+                self._peers[peer_rank] = conn
+                self._send_locks[peer_rank] = io_lock()
+                self._lost_notified.discard(peer_rank)
+        if bad:
+            logging.warning(
+                "tcp hub: rejected rejoin HELLO rank %s (duplicate "
+                "or out-of-range for world size %s)", peer_rank,
+                self.world_size)
+            _hard_close(conn)
+            return
+        t = threading.Thread(target=self._serve_peer,
+                             args=(conn, peer_rank), daemon=True,
+                             name=f"tcp-serve-{peer_rank}")
+        with self._lock:
+            self._serve_threads.append(t)
+        t.start()
+        logging.warning("tcp hub: rank %d rejoined", peer_rank)
+        self._notify_peer_join(peer_rank)
+
+    def _note_rejoin_deferred(self, peer_rank):
+        with self._ctr_lock:
+            self.rejoins_deferred += 1
+        logging.warning("tcp hub: rejoin HELLO rank %s deferred by the "
+                        "admission window (%d/%ss)", peer_rank,
+                        self.rejoin_burst, self.rejoin_window_s)
+        reg = get_registry()
+        if reg is not None:
+            reg.inc("fed_peer_rejoins_deferred_total",
+                    help="rejoin HELLOs deferred by the admission-rate "
+                         "window (admitted later, never dropped)",
+                    transport="tcp")
 
     def _serve_peer(self, conn, peer_rank):
         while self._running:

@@ -61,7 +61,7 @@ from fedml_tpu.compression.codec import (DECODE_ERRORS, MAGIC,
                                          parse_wire_header)
 from fedml_tpu.core.comm.base import (BaseCommunicationManager,
                                       MSG_TYPE_PEER_JOIN,
-                                      MSG_TYPE_PEER_LOST)
+                                      MSG_TYPE_PEER_LOST, RejoinWindow)
 from fedml_tpu.core.comm.tcp import MSG_TYPE_GOODBYE, _enable_keepalive
 from fedml_tpu.core.message import Message
 from fedml_tpu.net.ingest import DecodeStage, note_ingest
@@ -90,8 +90,8 @@ class _Conn:
     are shared with sender threads under the manager's state lock."""
 
     __slots__ = ("sock", "rank", "hello", "tx", "tx_bytes", "congested_at",
-                 "closing", "shed", "dead", "want_write", "rx_hdr",
-                 "rx_buf", "rx_view", "rx_got")
+                 "closing", "shed", "dead", "want_write", "parked",
+                 "rx_hdr", "rx_buf", "rx_view", "rx_got")
 
     def __init__(self, sock, rank=None):
         self.sock = sock
@@ -104,6 +104,7 @@ class _Conn:
         self.shed = False
         self.dead = False         # closed (dedups the dispatcher post)
         self.want_write = False   # loop-owned: WRITE interest registered
+        self.parked = False       # loop-owned: deferred rejoin, unread
         self.rx_hdr = memoryview(bytearray(_HDR.size))
         self.rx_buf = None        # bytearray of the in-flight frame
         self.rx_view = None
@@ -150,13 +151,24 @@ class EventLoopCommManager(BaseCommunicationManager):
     def __init__(self, host, port, rank, world_size, timeout=60.0,
                  binary=True, metrics_logger=None,
                  high_watermark=32 * 2 ** 20, low_watermark=8 * 2 ** 20,
-                 drain_grace_s=10.0, backlog=4096, decode_workers=1):
+                 drain_grace_s=10.0, backlog=4096, decode_workers=1,
+                 rejoin_burst=16, rejoin_window_s=1.0):
         self.rank = int(rank)
         self.world_size = int(world_size)
         self._binary = bool(binary)
         self.high_watermark = int(high_watermark)
         self.low_watermark = int(low_watermark)
         self.drain_grace_s = float(drain_grace_s)
+        # rejoin-storm rate limit (hub): at most rejoin_burst
+        # re-admissions per rejoin_window_s sliding window; excess
+        # HELLOs park unread (selector-unregistered, connection open)
+        # and admit as the window refills -- deferred, never dropped.
+        # Same contract as TcpCommManager._accept_rejoins.
+        self.rejoin_burst = max(1, int(rejoin_burst))
+        self.rejoin_window_s = float(rejoin_window_s)
+        self.rejoins_deferred = 0
+        # loop-thread only; same contract object as the tcp hub's
+        self._rejoin_window = RejoinWindow(rejoin_burst, rejoin_window_s)
         #: payload bytes through this manager (same contract as tcp)
         self.bytes_sent = 0
         self.bytes_received = 0
@@ -753,6 +765,7 @@ class EventLoopCommManager(BaseCommunicationManager):
                     cb(conn, mask)
                 self._service_kicks()
                 self._check_congestion()
+                self._service_deferred_rejoins()
                 if self._stop_deadline is not None:
                     with self._lock:
                         idle = not self._peers
@@ -794,7 +807,7 @@ class EventLoopCommManager(BaseCommunicationManager):
             self._flush_conn(conn)
 
     def _read_conn(self, conn):
-        while True:
+        while not conn.parked:
             try:
                 if conn.rx_buf is None:
                     n = conn.sock.recv_into(conn.rx_hdr[conn.rx_got:])
@@ -869,6 +882,49 @@ class EventLoopCommManager(BaseCommunicationManager):
             return
         rejoin = self._joined.is_set()  # a late HELLO is a (re)join
         with self._lock:
+            rejoin = rejoin or peer_rank in self._lost_notified
+        if rejoin and not self._rejoin_window.try_admit():
+            # rejoin-storm rate limit: park the connection unread (its
+            # frames stay in the kernel buffer -- ``parked`` stops
+            # _read_conn's drain loop, so a frame already queued behind
+            # the HELLO is not misparsed as a second HELLO) and admit
+            # it when the window refills -- deferred, never dropped.
+            # Validity is judged at ADMIT time; loop-thread state only.
+            conn.parked = True
+            self._rejoin_window.deferred.append((conn, peer_rank))
+            with self._ctr_lock:
+                self.rejoins_deferred += 1
+            logging.warning("eventloop hub: rejoin HELLO rank %s "
+                            "deferred by the admission window (%d/%ss)",
+                            peer_rank, self.rejoin_burst,
+                            self.rejoin_window_s)
+            reg = get_registry()
+            if reg is not None:
+                reg.inc("fed_peer_rejoins_deferred_total",
+                        help="rejoin HELLOs deferred by the admission-"
+                             "rate window (admitted later, never "
+                             "dropped)",
+                        transport="eventloop")
+            try:
+                self._sel.unregister(conn.sock)
+            except (KeyError, ValueError, OSError):
+                pass
+            return
+        self._admit_hello(conn, peer_rank, rejoin, registered=True)
+
+    def _service_deferred_rejoins(self):
+        """Admit parked rejoin HELLOs as the window refills (one loop
+        tick granularity, arrival order preserved)."""
+        for conn, peer_rank in self._rejoin_window.drain():
+            self._admit_hello(conn, peer_rank, rejoin=True,
+                              registered=False)
+
+    def _admit_hello(self, conn, peer_rank, rejoin, registered):
+        """Route one HELLO'd connection (validity judged here, at admit
+        time -- a deferred rank's state can change while parked).
+        ``registered`` = the socket is still in the selector."""
+        conn.parked = False
+        with self._lock:
             bad = (peer_rank <= 0 or peer_rank >= self.world_size
                    or peer_rank in self._peers)
             if not bad:
@@ -880,15 +936,23 @@ class EventLoopCommManager(BaseCommunicationManager):
                 # initial join completed (crash + re-dial mid-startup);
                 # the dedup clears unconditionally so a second death
                 # notifies again (same contract as tcp._accept_rejoins)
-                rejoin = rejoin or peer_rank in self._lost_notified
                 self._lost_notified.discard(peer_rank)
         if bad:
             logging.warning(
                 "eventloop hub: invalid HELLO rank %s for world size %s "
                 "(duplicate or out-of-range -- misconfigured launch?)",
                 peer_rank, self.world_size)
+            # _close_conn's unregister tolerates a parked (already-
+            # unregistered) socket
             self._close_conn(conn, post=False)
             return
+        if not registered:
+            try:
+                self._sel.register(conn.sock, selectors.EVENT_READ,
+                                   (self._on_conn_event, conn))
+            except (KeyError, ValueError, OSError):
+                self._close_conn(conn, post=False)
+                return
         if rejoin:
             logging.warning("eventloop hub: rank %d rejoined", peer_rank)
             self._post_rank_item(peer_rank, ("join", peer_rank))
@@ -1012,6 +1076,11 @@ class EventLoopCommManager(BaseCommunicationManager):
             self._peers.clear()
             self._congested.clear()
             self._kick.clear()
+        # parked rejoin HELLOs sit OUTSIDE the selector map: close them
+        # explicitly (nothing to rejoin after teardown)
+        while self._rejoin_window.deferred:
+            conn, _rank = self._rejoin_window.deferred.popleft()
+            _hard_close(conn.sock)
         try:  # the selector map also holds mid-handshake connections
             socks = [key.fileobj for key in
                      list(self._sel.get_map().values())]

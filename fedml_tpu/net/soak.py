@@ -44,7 +44,7 @@ class _SwarmClient:
     """One multiplexed soak client: rx framing state + tx queue."""
 
     __slots__ = ("sock", "rank", "tx", "rx_hdr", "rx_buf", "rx_view",
-                 "rx_got", "reports", "want_write", "due")
+                 "rx_got", "reports", "want_write", "due", "residual")
 
     def __init__(self, sock, rank):
         self.sock = sock
@@ -57,6 +57,7 @@ class _SwarmClient:
         self.reports = 0
         self.want_write = False
         self.due = None  # (send_at_monotonic, frame_views) jittered reply
+        self.residual = None  # per-client EF accumulator (wire compression)
 
 
 def _quadratic_step(params, rank, lr=0.25):
@@ -73,7 +74,7 @@ def _quadratic_step(params, rank, lr=0.25):
 
 def run_swarm(host, port, clients, world_size, rank_base=1, jitter_s=0.0,
               seed=0, connect_timeout=120.0, idle_timeout=600.0,
-              trace_path=None):
+              trace_path=None, compressor=None):
     """Drive ``clients`` soak clients over one selector loop until the
     server stops or disconnects every one of them. Returns a summary
     dict (connections made, reports sent, wall seconds).
@@ -85,11 +86,20 @@ def run_swarm(host, port, clients, world_size, rank_base=1, jitter_s=0.0,
     flash crowds) and phase-dark ranks (correlated dropouts) send no
     reply at all -- the same seeded format the pace-steering bench and
     the distributed drivers consume, so the soak's latency histogram
-    carries a realistic arrival curve."""
+    carries a realistic arrival curve.
+
+    ``compressor`` (spec string, e.g. ``"qsgd"``) makes every swarm
+    client ship compressed update deltas (``cdelta`` +
+    ``compressor`` report keys) through the same numpy-only
+    :mod:`fedml_tpu.compression.wire` path the real client FSM uses --
+    the swarm stays jax-free, and the async server folds the deltas
+    sparsely against each report's base version."""
     from fedml_tpu.compression.codec import message_to_wire_views
+    from fedml_tpu.compression.wire import ef_step, encode_rng, host_compressor
     from fedml_tpu.core.message import Message
     from fedml_tpu.compression.codec import message_from_wire
 
+    comp = host_compressor(compressor)
     gen = None
     if trace_path:
         from fedml_tpu.resilience.faults import DiurnalTrace, TraceLoadGen
@@ -179,11 +189,27 @@ def run_swarm(host, port, clients, world_size, rank_base=1, jitter_s=0.0,
                 dropped += 1
                 return
             delay = action[1]
-        params, n = _quadratic_step(msg.get("params"), c.rank)
+        base = msg.get("params")
+        params, n = _quadratic_step(base, c.rank)
+        version = int(msg.get("round"))
         out = Message("res_report", c.rank, 0)
-        out.add("params", params)
+        if comp is None:
+            out.add("params", params)
+        else:
+            # wire compression: ship the compressed update DELTA
+            # (numpy-only ef_step; EF residual only for the biased
+            # compressors -- the swarm stays jax-free); the rng
+            # is keyed (rank, version, report-ordinal) so reruns encode
+            # deterministically
+            delta = {k: np.asarray(params[k], np.float32)
+                     - np.asarray(base[k], np.float32) for k in params}
+            enc, _dec, c.residual = ef_step(
+                comp, delta, c.residual,
+                encode_rng((c.rank, version, c.reports)))
+            out.add("cdelta", enc)
+            out.add("compressor", comp.spec)
         out.add("num_samples", n)
-        out.add("round", int(msg.get("round")))
+        out.add("round", version)
         out.add("attempt", int(msg.get("attempt")))
         views = [memoryview(v) if not isinstance(v, memoryview) else v
                  for v in message_to_wire_views(out)]
@@ -248,6 +274,7 @@ def run_swarm(host, port, clients, world_size, rank_base=1, jitter_s=0.0,
     return {"connections": connected, "reports": reports,
             "dropped": dropped, "unfinished": len(conns),
             "trace": bool(gen is not None),
+            "compressor": comp.spec if comp is not None else None,
             "wall_s": round(time.monotonic() - t_start, 3)}
 
 
@@ -256,7 +283,7 @@ def run_soak(n_clients, total_updates=3, host="localhost", port=None,
              high_watermark=32 * 2 ** 20, join_timeout=600.0,
              handshake_timeout=None, init_params=None,
              metrics_logger=None, trace_path=None, pace_controller=None,
-             decode_workers=1):
+             decode_workers=1, compressor=None):
     """The soak scenario: a real buffered-async server over the event
     loop, ``n_clients`` swarm connections from a subprocess. Arm
     ``observability.enable(perfmon=True, status_path=...)`` around this
@@ -266,8 +293,11 @@ def run_soak(n_clients, total_updates=3, host="localhost", port=None,
     ``pace_controller`` arms closed-loop pace steering on the server;
     ``decode_workers`` sizes the server transport's parallel frame-
     decode stage (1 = today's inline dispatcher decode -- trajectories
-    are identical at any setting, only decode throughput moves).
-    Returns ``(server, swarm_summary_dict)``."""
+    are identical at any setting, only decode throughput moves);
+    ``compressor`` (e.g. ``"qsgd"``) makes the swarm ship compressed
+    report deltas that the server folds sparsely (see
+    :func:`run_swarm` -- reports/sec and bytes-per-report move, the
+    protocol does not). Returns ``(server, swarm_summary_dict)``."""
     import socket as _socket
 
     from fedml_tpu.net.eventloop import EventLoopCommManager
@@ -292,6 +322,8 @@ def run_soak(n_clients, total_updates=3, host="localhost", port=None,
            "--world", str(world), "--jitter_s", str(jitter_s)]
     if trace_path:
         cmd += ["--trace", str(trace_path)]
+    if compressor:
+        cmd += ["--compressor", str(compressor)]
     proc = subprocess.Popen(
         cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
     try:
@@ -345,6 +377,10 @@ def _main(argv=None):
                    help="DiurnalTrace JSON file: replay its arrival "
                         "curve (per-phase reply delays + correlated "
                         "dropouts) instead of uniform --jitter_s")
+    p.add_argument("--compressor", type=str, default=None,
+                   help="wire-compression spec (qsgd/topk:R/signsgd): "
+                        "ship compressed report deltas instead of "
+                        "full params (compression.wire, numpy-only)")
     args = p.parse_args(argv)
     if not args.swarm:
         p.error("only the --swarm role has a CLI; run_soak is the "
@@ -352,7 +388,7 @@ def _main(argv=None):
     logging.basicConfig(level=logging.INFO)
     summary = run_swarm(args.host, args.port, args.clients, args.world,
                         jitter_s=args.jitter_s, seed=args.seed,
-                        trace_path=args.trace)
+                        trace_path=args.trace, compressor=args.compressor)
     sys.stdout.write(json.dumps(summary) + "\n")
     return 0
 

@@ -361,11 +361,27 @@ class BucketedStreamRunner:
     MID-ROUND, and chunks dispatched before a flush fold in staleness-
     discounted. With an unbounded buffer and decay 0 this reduces to the
     synchronous fold bit-for-bit (the CI oracle).
+
+    Streaming-EF (``compressor=``): the chunk program additionally runs
+    the client->server half of the wire per lane -- compress the local
+    update delta plus the client's error-feedback residual, reconstruct
+    the server's view, and aggregate the RECONSTRUCTED states -- so the
+    payload partial sums are exactly what a real compressed transport
+    would deliver. Residuals are gathered/scattered by STABLE client id
+    through a ``compression.ResidualStore`` handed to :meth:`run_round`
+    (dense device rows when the population fits, lazy host spill
+    beyond), the residual arrays share the chunk's ONE compiled shape
+    per bucket edge (``[client_chunk, ...]`` rows -- the compressor
+    changes no shape), and the scatter-back happens at the fold point,
+    so the dense path keeps the ``async_window`` pipeline fully
+    asynchronous. Zero steady-state retraces and ``compiled_shapes() ==
+    buckets_used`` hold exactly as in the plain path (CI-gated).
     """
 
     def __init__(self, spec: TrainSpec, cfg: ClientUpdateConfig,
                  payload_fn=None, server_fn=None, client_chunk=256,
-                 batch_size=32, epochs=1, edges=(8,), step_bucket=8):
+                 batch_size=32, epochs=1, edges=(8,), step_bucket=8,
+                 compressor=None):
         self.payload_fn = payload_fn or _default_payload
         self.server_fn = server_fn or _default_server
         self.client_chunk = max(1, int(client_chunk))
@@ -373,15 +389,12 @@ class BucketedStreamRunner:
         self.epochs = int(epochs)
         self.edges = sorted(int(e) for e in edges)
         self.step_bucket = int(step_bucket)
+        self.compressor = compressor
         client_update = make_streamed_client_update(spec, cfg)
         payload_fn_ = self.payload_fn
         server_fn_ = self.server_fn
 
-        @jax.jit
-        def chunk_fn(global_state, batches, ns, trip, rngs):
-            local_states, aux, metrics = jax.vmap(
-                client_update, in_axes=(None, 0, 0, None, 0))(
-                    global_state, batches, ns, trip, rngs)
+        def _aggregate(global_state, local_states, aux, metrics):
             payloads = jax.vmap(payload_fn_, in_axes=(0, None, 0))(
                 local_states, global_state, aux)
             w = aux["n"].astype(jnp.float32)
@@ -392,6 +405,46 @@ class BucketedStreamRunner:
             metrics_sum = jax.tree.map(lambda m: jnp.sum(m, axis=0),
                                        metrics)
             return pay_sum, jnp.sum(w), metrics_sum
+
+        if compressor is None:
+            @jax.jit
+            def chunk_fn(global_state, batches, ns, trip, rngs):
+                local_states, aux, metrics = jax.vmap(
+                    client_update, in_axes=(None, 0, 0, None, 0))(
+                        global_state, batches, ns, trip, rngs)
+                return _aggregate(global_state, local_states, aux, metrics)
+        else:
+            from fedml_tpu.compression.compressors import ErrorFeedback
+            ef = ErrorFeedback(compressor)
+
+            @jax.jit
+            def chunk_fn(global_state, batches, ns, trip, rngs,
+                         residuals, crngs):
+                local_states, aux, metrics = jax.vmap(
+                    client_update, in_axes=(None, 0, 0, None, 0))(
+                        global_state, batches, ns, trip, rngs)
+
+                def compress_one(local_state, residual, crng):
+                    # the client->server wire half, per lane: EF-compress
+                    # the update delta, aggregate the server's RECON view
+                    # (make_compressed_sim_round's exact semantics,
+                    # streamed); only "params" is lossy -- batch_stats
+                    # and other state average at full fidelity
+                    delta = pytree.tree_sub(local_state["params"],
+                                            global_state["params"])
+                    _, dec, new_residual = ef.step(
+                        delta, residual, global_state["params"], crng)
+                    recon = dict(local_state)
+                    recon["params"] = pytree.tree_add(
+                        global_state["params"], dec)
+                    return recon, new_residual
+
+                with jax.named_scope("ef-compress"):
+                    recon_states, new_residuals = jax.vmap(compress_one)(
+                        local_states, residuals, crngs)
+                pay_sum, w_sum, metrics_sum = _aggregate(
+                    global_state, recon_states, aux, metrics)
+                return pay_sum, w_sum, metrics_sum, new_residuals
 
         @partial(jax.jit, donate_argnums=(0, 1))
         def advance_fn(global_state, server_state, avg_payload, rng):
@@ -422,14 +475,20 @@ class BucketedStreamRunner:
             return -1
 
     def run_round(self, global_state, server_state, datasets, rng,
-                  data_rng=None, aggregator=None, async_window=4):
+                  data_rng=None, aggregator=None, async_window=4,
+                  client_ids=None, residual_store=None):
         """One federated round over ``datasets`` (the cohort's raw client
         shards, list of ``{"x", "y"}``), streamed bucket by bucket.
 
         ``aggregator`` (optional ``BufferedAggregator``) switches the
         host-side fold to buffered-async; otherwise the partials fold
-        synchronously. Returns ``(new_global, new_server_state, info)``
-        with ``info["bucket"]`` (waste accounting) and ``info["async"]``
+        synchronously. With a ``compressor`` armed, ``residual_store``
+        (a ``compression.ResidualStore``) carries each client's EF
+        residual across the rounds it is sampled into, keyed by
+        ``client_ids`` (stable ids aligned with ``datasets``; defaults
+        to cohort ordinals for store-owning callers like the direct
+        tests). Returns ``(new_global, new_server_state, info)`` with
+        ``info["bucket"]`` (waste accounting) and ``info["async"]``
         (buffer counters) next to the usual ``aux``/``metrics``.
         """
         import numpy as np
@@ -442,6 +501,11 @@ class BucketedStreamRunner:
         C = len(datasets)
         if C == 0:
             raise ValueError("bucketed round over an empty cohort")
+        if self.compressor is not None and residual_store is None:
+            raise ValueError(
+                "streaming-EF needs a residual_store: the error-feedback "
+                "accumulator is keyed by stable client id ACROSS rounds "
+                "(compression.ResidualStore; FedAvgAPI owns one)")
         ns = [len(d["y"]) for d in datasets]
         if sum(ns) == 0:
             raise ValueError("bucketed round: every client shard is empty")
@@ -460,6 +524,14 @@ class BucketedStreamRunner:
             jax.random.split(jax.random.fold_in(rng, 1), C))
         dtypes = self._payload_dtypes(global_state)
         flush_rng = jax.random.fold_in(rng, 2)
+        comp_keys = None
+        if self.compressor is not None:
+            # fold 3 is the compression stream -- the same derivation
+            # rule as make_compressed_sim_round, per stable cohort slot
+            comp_keys = np.asarray(
+                jax.random.split(jax.random.fold_in(rng, 3), C))
+            if client_ids is None:
+                client_ids = list(range(C))
 
         gs, ss = global_state, server_state
         cm = get_cost_model()  # one global read when attribution is off
@@ -488,7 +560,16 @@ class BucketedStreamRunner:
 
         def fold_oldest():
             nonlocal flushes, metrics_acc
-            ordinal, born, k_real, handles = inflight.popleft()
+            ordinal, born, k_real, handles, scatter = inflight.popleft()
+            if scatter is not None:
+                # EF residual write-back, deferred to the fold point (the
+                # documented sync point): the dense store's at[].set is
+                # pure device work and keeps the pipeline asynchronous;
+                # the sparse (host-spill) backing pays its np.asarray
+                # sync here, where the chunk's outputs sync anyway
+                ids, new_res = scatter
+                residual_store.scatter(
+                    ids, jax.tree.map(lambda x: x[:len(ids)], new_res))
             # FIRST host touch of this chunk's outputs: the device sync
             # point. Everything stays a device handle until here, so up
             # to async_window chunks genuinely overlap host packing/H2D
@@ -548,10 +629,34 @@ class BucketedStreamRunner:
             batches_dev = {"x": jnp.asarray(xb), "y": jnp.asarray(yb),
                            "mask": jnp.asarray(maskb)}
             ns_dev, rngs_dev = jnp.asarray(n_arr), jnp.asarray(rngs)
+            args = (gs, batches_dev, ns_dev, jnp.int32(trip), rngs_dev)
+            ids = None
+            if self.compressor is not None:
+                # EF residual rows for this chunk, gathered by STABLE
+                # client id; padded lanes carry zero rows that share the
+                # bucket's one compiled shape and are sliced off before
+                # the scatter-back (their updates are discarded)
+                ids = [client_ids[i] for i in chunk]
+                res = residual_store.gather(ids)
+                crngs = comp_keys[chunk]
+                if k < self.client_chunk:
+                    pad = self.client_chunk - k
+                    res = jax.tree.map(
+                        lambda x: jnp.concatenate(
+                            [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)]),
+                        res)
+                    crngs = np.concatenate(
+                        [crngs, crngs[:1].repeat(pad, 0)])
+                args = args + (res, jnp.asarray(crngs))
             with tracer.span("bucket-chunk", edge=edge, clients=int(k),
                              trip=trip):
-                pay_sum, w_sum, msum = self._chunk_fn(
-                    gs, batches_dev, ns_dev, jnp.int32(trip), rngs_dev)
+                out = self._chunk_fn(*args)
+            if self.compressor is None:
+                pay_sum, w_sum, msum = out
+                scatter = None
+            else:
+                pay_sum, w_sum, msum, new_res = out
+                scatter = (ids, new_res)
             if cm is not None:
                 if edge not in self._edge_costs:
                     # abstract AOT probe of this bucket shape's program
@@ -559,17 +664,19 @@ class BucketedStreamRunner:
                     # ShapeDtypeStructs only, so the probe never holds
                     # or syncs device buffers
                     abst = lambda t: jax.tree.map(
-                        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
-                        t)
+                        lambda a: jax.ShapeDtypeStruct(
+                            a.shape, a.dtype), t)
                     self._edge_costs[edge] = program_cost(
-                        self._chunk_fn, abst(gs), abst(batches_dev),
-                        abst(ns_dev), jax.ShapeDtypeStruct((), jnp.int32),
-                        abst(rngs_dev))
+                        self._chunk_fn,
+                        *(abst(a) if i != 3
+                          else jax.ShapeDtypeStruct((), jnp.int32)
+                          for i, a in enumerate(args)))
                 # note() every time (setdefault-idempotent): a CostModel
                 # armed AFTER the runner warmed its edge cache must
                 # still collect the catalog
                 cm.note(f"bucket_chunk_s{edge}", self._edge_costs[edge])
-            inflight.append((ordinal, born, k, (pay_sum, w_sum, msum)))
+            inflight.append((ordinal, born, k, (pay_sum, w_sum, msum),
+                             scatter))
             ordinal += 1
             st = b_stats[edge]
             st["clients"] += k

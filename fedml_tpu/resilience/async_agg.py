@@ -57,6 +57,8 @@ from fedml_tpu.core.locks import audited_lock, audited_rlock
 from fedml_tpu.core.comm.base import MSG_TYPE_PEER_JOIN, MSG_TYPE_PEER_LOST
 from fedml_tpu.core.message import Message
 from fedml_tpu.core.managers import ServerManager
+from fedml_tpu.compression.wire import (
+    WIRE_DELTA_KEY, WIRE_SPEC_KEY, CompressedUpdate)
 from fedml_tpu.observability.perfmon import get_perf_monitor
 from fedml_tpu.observability.registry import get_registry
 from fedml_tpu.observability.tracing import get_tracer
@@ -391,7 +393,18 @@ class AsyncBufferedFedAvgServer(ServerManager):
         self.flush_log = []   # per-flush sorted contributor ranks
         self.counters = {"reports": 0, "late_reports": 0,
                          "clients_dropped": 0, "clients_rejoined": 0,
-                         "retries": 0}
+                         "retries": 0, "stale_base_reports": 0}
+        # compressed-report base retention: version -> the params that
+        # version issued. A compressed report born at version v decodes
+        # against base v (its delta is relative to the model the client
+        # trained on), so a base stays retained while any alive rank's
+        # last sync is still <= that version (_rank_version records the
+        # version each sync carried). Buffered CompressedUpdate entries
+        # hold their OWN base reference -- pruning here can never
+        # invalidate an already-accepted report, only force a
+        # stale_base drop of a report nobody should still be sending.
+        self._bases = {0: self.params}
+        self._rank_version = {}
         # closed-loop pace steering (resilience/steering.py): when armed,
         # each flush re-decides buffer_k/flush_deadline from the live
         # arrival rate + windowed latency tail, within operator bounds.
@@ -442,7 +455,38 @@ class AsyncBufferedFedAvgServer(ServerManager):
         m.add("params", self.params)
         m.add("round", self.agg.version)
         m.add("attempt", 0)  # schema parity with the synchronous client
+        self._rank_version[rank] = self.agg.version
         return m
+
+    def _report_payload_locked(self, msg):
+        """Plain reports stay numpy param dicts; a compressed report
+        (``cdelta``) becomes a :class:`CompressedUpdate` against the
+        base of the version the client trained on (``round`` in the
+        report = the version its sync carried). The fold decodes-and-
+        folds the delta sparsely (O(k) for topk) at flush time, and
+        each distinct base version is densified exactly once per flush
+        -- never per report. Returns None when the base was pruned (a
+        report no live sync should still produce): the caller drops it
+        into ``stale_base_reports``."""
+        enc = msg.get(WIRE_DELTA_KEY)
+        if enc is None:
+            return {k: np.asarray(v) for k, v in msg.get("params").items()}
+        born = int(msg.get("round"))
+        base = self._bases.get(born)
+        if base is None:
+            return None
+        return CompressedUpdate(enc=enc, spec=str(msg.get(WIRE_SPEC_KEY)),
+                                base=base, base_key=born)
+
+    def _prune_bases_locked(self):
+        """Drop base versions no alive rank can still report against
+        (every alive rank's last sync is newer). The current version is
+        always retained -- a rejoin syncs it next."""
+        floor = min((self._rank_version.get(r, 0) for r in self.alive),
+                    default=self.agg.version)
+        for v in [v for v in self._bases
+                  if v < floor and v < self.agg.version]:
+            del self._bases[v]
 
     def _send_syncs(self, syncs):
         for m in syncs:
@@ -484,11 +528,17 @@ class AsyncBufferedFedAvgServer(ServerManager):
                     continue
                 # payload/weight/sender converted ONCE per report --
                 # only staleness depends on the flush segment
+                payload = self._report_payload_locked(msg)
+                if payload is None:
+                    self.counters["stale_base_reports"] += 1
+                    logging.warning(
+                        "async server: compressed report from rank %d "
+                        "against pruned base version %d -- dropped",
+                        int(msg.get_sender_id()), int(msg.get("round")))
+                    continue
                 reports.append((
                     int(msg.get_sender_id()), float(msg.get("num_samples")),
-                    {k: np.asarray(v)
-                     for k, v in msg.get("params").items()},
-                    int(msg.get("round"))))
+                    payload, int(msg.get("round"))))
             i = 0
             while i < len(reports) and not done:
                 # staleness (and the latency window origin) is constant
@@ -556,10 +606,15 @@ class AsyncBufferedFedAvgServer(ServerManager):
                 return
             born = int(msg.get("round"))
             staleness = max(0, self.agg.version - born)
-            depth = self.agg.fold(
-                rank, float(msg.get("num_samples")),
-                {k: np.asarray(v) for k, v in msg.get("params").items()},
-                staleness=staleness)
+            payload = self._report_payload_locked(msg)
+            if payload is None:
+                self.counters["stale_base_reports"] += 1
+                logging.warning("async server: compressed report from "
+                                "rank %d against pruned base version %d "
+                                "-- dropped", rank, born)
+                return
+            depth = self.agg.fold(rank, float(msg.get("num_samples")),
+                                  payload, staleness=staleness)
             self.counters["reports"] += 1
             if self.pace is not None:
                 self._pace_window_reports += 1
@@ -591,6 +646,8 @@ class AsyncBufferedFedAvgServer(ServerManager):
                 return
             if rank in self.alive:
                 self.alive.discard(rank)
+                self._rank_version.pop(rank, None)
+                self._prune_bases_locked()
                 self.counters["clients_dropped"] += 1
                 logging.warning("async server: client rank %d lost "
                                 "(%d alive)", rank, len(self.alive))
@@ -683,6 +740,8 @@ class AsyncBufferedFedAvgServer(ServerManager):
             self._window_t0 = now  # next window's report-latency origin
         res = self.agg.flush(reason)
         self.params = res.params
+        self._bases[res.version] = res.params
+        self._prune_bases_locked()
         self.history.append(dict(res.params))
         self.flush_log.append(tuple(sorted(res.contributors)))
         degraded = res.clients < min(self.async_policy.buffer_k,
@@ -784,7 +843,7 @@ def run_async_tcp_fedavg(world_size, total_updates, async_policy,
                          host="localhost", port=None, timeout=60.0,
                          join_timeout=90.0, transport="tcp",
                          pace_controller=None, late_clients=(),
-                         decode_workers=1):
+                         decode_workers=1, compressor=None):
     """Drive a multi-rank TCP buffered-async FedAvg scenario in one
     process (the async analog of ``integration.run_tcp_fedavg``; clients
     are the unchanged :class:`ResilientFedAvgClient`). ``transport``
@@ -793,6 +852,10 @@ def run_async_tcp_fedavg(world_size, total_updates, async_policy,
     ``late_clients`` is a list of ``(rank, delay_s)`` re-dials -- a
     fresh unfaulted client that HELLOs back in after its original
     (usually killed/shed) incarnation, exercising the rejoin protocol.
+    ``compressor`` (e.g. ``"qsgd"``/``"topk:0.01"``) arms wire
+    compression on every client: reports ship compressed deltas and
+    the server folds them sparsely against each report's base version
+    (``None``/``"none"`` = today's plain reports, byte-identical).
     Returns the server (``.history``, ``.flush_log``, ``.counters``,
     ``.failed``)."""
     import socket
@@ -829,7 +892,8 @@ def run_async_tcp_fedavg(world_size, total_updates, async_policy,
             return
         if faulted and fault_plan is not None:
             comm = fault_plan.wrap(comm, rank)
-        fsm = ResilientFedAvgClient(None, comm, rank, world_size, trainer)
+        fsm = ResilientFedAvgClient(None, comm, rank, world_size, trainer,
+                                    compressor=compressor)
         fsm.run()
 
     threads = [threading.Thread(target=run_client, args=(r,), daemon=True,

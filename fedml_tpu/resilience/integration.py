@@ -35,6 +35,9 @@ from fedml_tpu.core.locks import audited_rlock
 from fedml_tpu.core.comm.base import MSG_TYPE_PEER_JOIN, MSG_TYPE_PEER_LOST
 from fedml_tpu.core.managers import ClientManager, ServerManager
 from fedml_tpu.core.message import Message
+from fedml_tpu.compression.wire import (
+    WIRE_DELTA_KEY, WIRE_SPEC_KEY, CompressedUpdate, ef_step, encode_rng,
+    host_compressor)
 from fedml_tpu.observability.perfmon import get_perf_monitor
 from fedml_tpu.observability.tracing import get_tracer
 from fedml_tpu.resilience.policy import (
@@ -42,7 +45,8 @@ from fedml_tpu.resilience.policy import (
     aggregate_reports, send_with_retry)
 
 MSG_S2C_SYNC = "res_sync"        # server -> client: params, round, attempt
-MSG_C2S_REPORT = "res_report"    # client -> server: params, n, round, attempt
+MSG_C2S_REPORT = "res_report"    # client -> server: params (plain) OR
+# cdelta+compressor (compressed update delta), n, round, attempt
 
 
 def add_resilience_args(parser):
@@ -202,13 +206,29 @@ class ResilientFedAvgClient(ClientManager):
     over numpy pytrees. A lost server ends the loop cleanly (there is
     nobody left to report to; the default fail-fast would raise out of a
     worker thread instead).
+
+    ``compressor`` (spec string, e.g. ``"qsgd"``/``"topk:0.01"``) arms
+    wire compression: the report ships the compressed update DELTA
+    (``cdelta`` + ``compressor`` keys) instead of full params. Biased
+    compressors (topk/signsgd) carry an error-feedback residual -- a
+    plain per-client host accumulator owned by this FSM object (the
+    process IS the stable rank, so the accumulator survives shed/rejoin
+    cycles of OTHER ranks and re-keyed cohort slots can never
+    cross-contaminate it; same shape as the jax-free soak swarm's);
+    unbiased qsgd runs feedback-free (``wire.ef_step``'s rule -- see
+    compression/wire.py for the measured instability feedback causes
+    there). ``None``/``"none"`` keeps today's plain-``params`` report,
+    byte-for-byte.
     """
 
     def __init__(self, args, comm, rank, size, local_train_fn,
-                 retry_policy: Optional[RetryPolicy] = None):
+                 retry_policy: Optional[RetryPolicy] = None,
+                 compressor=None):
         super().__init__(args, comm, rank=rank, size=size)
         self.local_train_fn = local_train_fn
         self.retry_policy = retry_policy
+        self.compressor = host_compressor(compressor)
+        self._ef_residual = None  # zero accumulator until first report
         self.counters = {"retries": 0}
 
     def register_message_receive_handlers(self):
@@ -227,10 +247,17 @@ class ResilientFedAvgClient(ClientManager):
                                             self.rank)
         with tracer.span("report", rank=self.rank, round=rnd):
             out = Message(MSG_C2S_REPORT, self.rank, 0)
-            out.add("params", params)
+            attempt = int(msg.get("attempt"))
+            if self.compressor is None:
+                out.add("params", params)
+            else:
+                enc = self._compress_update(msg.get("params"), params,
+                                            rnd, attempt)
+                out.add(WIRE_DELTA_KEY, enc)
+                out.add(WIRE_SPEC_KEY, self.compressor.spec)
             out.add("num_samples", float(n))
             out.add("round", rnd)
-            out.add("attempt", int(msg.get("attempt")))
+            out.add("attempt", attempt)
             tracer.inject(out)  # stitch the server's report handling here
             try:
                 if self.retry_policy is not None:
@@ -243,6 +270,20 @@ class ResilientFedAvgClient(ClientManager):
                 # server gone mid-report; the peer-lost path ends the loop
                 logging.warning("rank %d: report send failed (server "
                                 "lost?)", self.rank)
+
+    def _compress_update(self, base, params, rnd, attempt):
+        """EF-compress ``params - base`` for the uplink. The residual is
+        this object's own host accumulator (this process IS the stable
+        rank -- no device traffic in the report hot path); the encode
+        rng is keyed (rank, round, attempt) so two runs over the same
+        schedule encode bit-identically."""
+        base = {k: np.asarray(v, np.float32) for k, v in base.items()}
+        delta = {k: np.asarray(params[k], np.float32) - base[k]
+                 for k in base}
+        enc, _decoded, self._ef_residual = ef_step(
+            self.compressor, delta, self._ef_residual,
+            encode_rng((self.rank, rnd, attempt)))
+        return enc
 
     def _on_server_lost(self, msg):
         # sender is the LOST rank: only rank 0 dying concerns a client.
@@ -459,8 +500,26 @@ class ResilientFedAvgServer(ServerManager):
                                round=int(msg.get("round"))):
             self._controller.report(
                 msg.get("round"), msg.get("attempt"), msg.get_sender_id(),
-                msg.get("num_samples"),
-                {k: np.asarray(v) for k, v in msg.get("params").items()})
+                msg.get("num_samples"), self._report_payload(msg))
+
+    def _report_payload(self, msg):
+        """Plain reports stay numpy param dicts; a compressed report
+        (``cdelta``) becomes a :class:`CompressedUpdate` against the
+        OPEN round's params -- read under ``_advance_lock``, which also
+        serializes round turnover, so whenever the controller accepts
+        the report (round/attempt match) the captured base IS the model
+        that round broadcast; a mismatched base only ever pairs with a
+        report the controller rejects as late. The fold decodes-and-
+        folds the delta sparsely (O(k) for topk) at the turnover -- the
+        hub relayed the payload on a header peek and nothing densified
+        it per report."""
+        enc = msg.get(WIRE_DELTA_KEY)
+        if enc is None:
+            return {k: np.asarray(v) for k, v in msg.get("params").items()}
+        with self._advance_lock:
+            base = self.params
+        return CompressedUpdate(enc=enc, spec=str(msg.get(WIRE_SPEC_KEY)),
+                                base=base, base_key=0)
 
     def _on_peer_lost(self, msg):
         rank = int(msg.get_sender_id())
@@ -687,7 +746,7 @@ def run_tcp_fedavg(world_size, rounds, round_policy, init_params,
                    metrics_logger=None, host="localhost", port=None,
                    timeout=60.0, join_timeout=90.0, transport="tcp",
                    pace_controller=None, late_clients=(),
-                   decode_workers=1):
+                   decode_workers=1, compressor=None):
     """Drive a full multi-rank TCP FedAvg scenario in one process.
 
     Clients run in daemon threads (rank r wrapped by ``fault_plan`` when
@@ -698,10 +757,14 @@ def run_tcp_fedavg(world_size, rounds, round_policy, init_params,
     steering on the server (``--pace_steering``); ``late_clients`` is a
     list of ``(rank, delay_s)`` re-dials exercising the rejoin protocol
     (a fresh unfaulted client HELLOing back in after its original
-    incarnation was killed or shed). Returns the server (``.history``,
-    ``.reporting_log``, ``.counters``, ``.failed``). Used by the ci.sh
-    chaos/steering smokes and test_resilience.py / test_net.py /
-    test_steering.py.
+    incarnation was killed or shed). ``compressor`` (e.g. ``"qsgd"``)
+    arms wire compression on every client: reports ship compressed
+    deltas (error feedback on the biased compressors) and the server
+    folds them sparsely against the round's base (``None``/``"none"`` =
+    today's plain reports, byte-identical).
+    Returns the server (``.history``, ``.reporting_log``, ``.counters``,
+    ``.failed``). Used by the ci.sh chaos/steering/compression smokes
+    and test_resilience.py / test_net.py / test_steering.py.
     """
     import socket
 
@@ -738,7 +801,8 @@ def run_tcp_fedavg(world_size, rounds, round_policy, init_params,
             return
         if faulted and fault_plan is not None:
             comm = fault_plan.wrap(comm, rank)
-        fsm = ResilientFedAvgClient(None, comm, rank, world_size, trainer)
+        fsm = ResilientFedAvgClient(None, comm, rank, world_size, trainer,
+                                    compressor=compressor)
         fsm.run()
 
     threads = [threading.Thread(target=run_client, args=(r,), daemon=True,

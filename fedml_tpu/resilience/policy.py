@@ -316,6 +316,22 @@ def fold_entries_fp64(entries) -> tuple:
     streaming engine feeds PRE-WEIGHTED partial sums with
     ``scale == staleness_weight`` and ``weight == w_sum * staleness_weight``.
 
+    A payload may also be a
+    :class:`~fedml_tpu.compression.wire.CompressedUpdate` (a compressed
+    report's encoded delta + the base params it is relative to): its
+    logical contribution is ``scale * float64(base + decoded_delta)``,
+    folded WITHOUT densifying per report -- the decoded delta
+    accumulates sparsely/quantized (O(k) for a topk report) in sorted
+    entry order, and each DISTINCT base is added exactly once, scaled by
+    the sum of its entries' scales, in sorted ``base_key`` order. The
+    fold stays arrival-order independent; what "bitwise" means under
+    lossy compression is pinned in docs/COMPRESSION.md ("Distributed
+    wire path"): the compressed fold is its own canonical f64 order --
+    NOT bit-equal to reconstructing each report in f32 first -- and the
+    async oracle (decay 0) still equals the synchronous compressed fold
+    bit for bit, because both run this exact function over the same
+    entries.
+
     Returns ``(params_f32, weight_total)``. Folding in sorted-key order
     (never arrival order) is what makes the result bitwise deterministic:
     :class:`~fedml_tpu.resilience.async_agg.BufferedAggregator` flushes
@@ -325,17 +341,37 @@ def fold_entries_fp64(entries) -> tuple:
     """
     import jax
 
+    from fedml_tpu.compression.wire import CompressedUpdate
+
     entries = sorted(entries, key=lambda e: e[0])
     if not entries:
         raise ValueError("weighted fold over an empty entry set "
                          "(abandon/skip instead)")
     total = 0.0
-    acc = None
+    acc = None          # dense contributions (f64 pytree)
+    cacc = None         # compressed-delta contributions ({name: f64})
+    base_acc = {}       # base_key -> [scale_sum, base params]
     for _key, weight, payload, scale in entries:
         total += float(weight)
+        if isinstance(payload, CompressedUpdate):
+            cacc = payload.fold_delta(cacc, float(scale))
+            slot = base_acc.setdefault(payload.base_key,
+                                       [0.0, payload.base])
+            slot[0] += float(scale)
+            continue
         contrib = jax.tree.map(
             lambda x: np.asarray(x, np.float64) * float(scale), payload)
         acc = contrib if acc is None else jax.tree.map(np.add, acc, contrib)
+    # canonical combine order: dense entries (sorted), then each distinct
+    # base (sorted by key), then the sparse delta accumulator
+    for bk in sorted(base_acc):
+        scale_sum, base = base_acc[bk]
+        bcontrib = jax.tree.map(
+            lambda x: np.asarray(x, np.float64) * float(scale_sum), base)
+        acc = bcontrib if acc is None else jax.tree.map(np.add, acc,
+                                                        bcontrib)
+    if cacc is not None:
+        acc = cacc if acc is None else jax.tree.map(np.add, acc, cacc)
     if total <= 0:
         raise ValueError("weighted fold has zero total weight")
     return jax.tree.map(lambda x: (x / total).astype(np.float32), acc), total

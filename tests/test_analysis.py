@@ -1932,8 +1932,9 @@ class TestCrossClass:
         # same string race_audit()/the flight recorder would report
         # (line shifts when integration.py grows above __init__; PR 11
         # moved it 307 -> 321 adding the --transport flag, PR 13 moved
-        # it 321 -> 333 adding the pace-steering/rejoin state)
-        assert "integration.py:333" in msg
+        # it 321 -> 333 adding the pace-steering/rejoin state, PR 15
+        # moved it 333 -> 374 adding the wire-compression client half)
+        assert "integration.py:374" in msg
         assert "_send_frame" in msg and "TcpCommManager" in msg
 
 
@@ -2031,10 +2032,14 @@ class TestFsmSequencing:
 
     def test_fl127_helper_transitivity(self):
         # a same-class helper that acts on all of ITS paths acts for the
-        # handler; a helper with a silent path does not
+        # handler; a helper with a silent path does not. (The helper
+        # reads 'flag': FL128's helper-following walk -- fedsqueeze --
+        # sees through the forward, so an unread key would correctly be
+        # a set-never-read finding, not an opaque escape.)
         acting = self._with_on_b(
             "        self._reply(msg)\n") + (
             "    def _reply(self, msg):\n"
+            "        logging.info('flag=%s', msg.get('flag'))\n"
             "        self.send_message(Message(MSG_A, 0, 1))\n")
         assert codes(acting, path=self.FSM_PATH) == []
         silent = self._with_on_b(
@@ -2081,9 +2086,8 @@ class TestFsmSequencing:
             "            self._controller.report(\n"
             "                msg.get(\"round\"), msg.get(\"attempt\"), "
             "msg.get_sender_id(),\n"
-            "                msg.get(\"num_samples\"),\n"
-            "                {k: np.asarray(v) for k, v in "
-            "msg.get(\"params\").items()})")
+            "                msg.get(\"num_samples\"), "
+            "self._report_payload(msg))")
         assert needle in src, "integration.py report handler changed"
         clean = lint_source(src, path="fedml_tpu/resilience/integration.py")
         assert [f.code for f in clean] == []
@@ -2205,6 +2209,208 @@ class TestPayloadSchema:
         msgs = " | ".join(f.message for f in found)
         assert "reads payload key 'num_samples'" in msgs
         assert "'n_samples' of message type 'res_report' is set" in msgs
+
+
+class TestPayloadSchemaNamedKeys:
+    """FL128 named-key resolution (fedsqueeze satellite): payload keys
+    spelled as module constants (the compressed-report vocabulary --
+    WIRE_DELTA_KEY/'cdelta') resolve through the constant/import index,
+    pair by NAME when out of static reach (single-file runs), and the
+    walk follows the message into same-class helpers."""
+
+    FSM_PATH = "fedml_tpu/core/fsm_fake.py"
+
+    HEADER = (
+        "import logging\n"
+        "from fedml_tpu.core.managers import ClientManager, ServerManager\n"
+        "from fedml_tpu.core.comm.base import MSG_TYPE_PEER_LOST\n"
+        "from fedml_tpu.core.message import Message\n"
+        "MSG_A = 'a'\n"
+        "MSG_B = 'b'\n"
+        "K_DELTA = 'cdelta'\n"
+        "K_SPEC = 'compressor'\n"
+        "class Cli(ClientManager):\n"
+        "    def register_message_receive_handlers(self):\n"
+        "        self.register_message_receive_handler(MSG_A, self._on_a)\n"
+        "        self.register_message_receive_handler(\n"
+        "            MSG_TYPE_PEER_LOST, self._on_lost)\n"
+        "    def _on_a(self, msg):\n"
+        "        m = Message(MSG_B, 1, 0)\n"
+        "        m.add(K_DELTA, 1)\n"
+        "        m.add(K_SPEC, 'qsgd')\n"
+        "        self.send_message(m)\n"
+        "    def _on_lost(self, msg):\n"
+        "        self.finish()\n"
+        "class Srv(ServerManager):\n"
+        "    def register_message_receive_handlers(self):\n"
+        "        self.register_message_receive_handler(MSG_B, self._on_b)\n"
+        "        self.register_message_receive_handler(\n"
+        "            MSG_TYPE_PEER_LOST, self._on_lost)\n"
+        "    def _on_lost(self, msg):\n"
+        "        self.finish()\n")
+
+    def _with_on_b(self, body):
+        return self.HEADER + "    def _on_b(self, msg):\n" + body
+
+    def test_named_keys_resolve_and_pair_clean(self):
+        # the compressed-report shape: constant-named adds paired with
+        # constant-named reads -- zero findings, schema fully judged
+        src = self._with_on_b(
+            "        if msg.get(K_DELTA) and msg.get(K_SPEC):\n"
+            "            self.send_message(Message(MSG_A, 0, 1))\n"
+            "        else:\n"
+            "            self.finish()\n")
+        assert codes(src, path=self.FSM_PATH) == []
+
+    def test_named_key_read_never_set_fires(self):
+        # the schema is RESOLVED, not open: a named read with no
+        # counterpart add is caught (the old behavior -- dynamic key ->
+        # opaque -- would have silently suppressed this)
+        src = self._with_on_b(
+            "        if msg.get(K_DELTA) and msg.get(K_SPEC):\n"
+            "            self.send_message(Message(MSG_A, 0, 1))\n"
+            "        else:\n"
+            "            self.finish()\n").replace(
+            "        m.add(K_SPEC, 'qsgd')\n", "")
+        found = lint_source(src, path=self.FSM_PATH)
+        assert [f.code for f in found] == ["FL128"]
+        assert "reads payload key 'compressor'" in found[0].message
+
+    def test_named_key_set_never_read_fires(self):
+        src = self._with_on_b(
+            "        if msg.get(K_DELTA):\n"
+            "            self.send_message(Message(MSG_A, 0, 1))\n"
+            "        else:\n"
+            "            self.finish()\n")
+        found = lint_source(src, path=self.FSM_PATH)
+        assert [f.code for f in found] == ["FL128"]
+        assert "'compressor' of message type 'b' is set" in found[0].message
+
+    def test_unresolvable_names_pair_by_name(self):
+        # constants imported from OUTSIDE the fileset (single-file runs:
+        # the real FSMs import WIRE_DELTA_KEY from compression.wire):
+        # same-named add/read pair by NAME, zero findings -- and the
+        # schema stays judged for the literal keys around them
+        src = self._with_on_b(
+            "        if msg.get(EXT_KEY):\n"
+            "            self.send_message(Message(MSG_A, 0, 1))\n"
+            "        else:\n"
+            "            self.finish()\n").replace(
+            "K_DELTA = 'cdelta'\n",
+            "from fedml_tpu.compression.wire import EXT_KEY\n"
+            "K_DELTA = 'cdelta'\n").replace(
+            "        m.add(K_DELTA, 1)\n"
+            "        m.add(K_SPEC, 'qsgd')\n",
+            "        m.add(EXT_KEY, 1)\n"
+            "        m.add('n', 2.0)\n")
+        found = lint_source(src, path=self.FSM_PATH)
+        # EXT_KEY pairs by name; the literal 'n' is genuinely unread
+        assert [f.code for f in found] == ["FL128"]
+        assert "'n' of message type 'b' is set" in found[0].message
+
+    def test_unpaired_unresolvable_named_add_opens_schema(self):
+        # an out-of-reach named add with NO matching named read could be
+        # setting any key: read-never-set must stay conservative
+        src = self._with_on_b(
+            "        if msg.get('something'):\n"
+            "            self.send_message(Message(MSG_A, 0, 1))\n"
+            "        else:\n"
+            "            self.finish()\n").replace(
+            "K_DELTA = 'cdelta'\n",
+            "from fedml_tpu.compression.wire import EXT_KEY\n"
+            "K_DELTA = 'cdelta'\n").replace(
+            "        m.add(K_DELTA, 1)\n", "        m.add(EXT_KEY, 1)\n")
+        found = lint_source(src, path=self.FSM_PATH)
+        # 'something' is NOT judged read-never-set (EXT_KEY might be it)
+        # but K_SPEC's resolved 'compressor' is still set-never-read?
+        # no -- the unpaired named READ-side is empty; the reader reads
+        # 'something' only, so 'compressor' IS set-never-read... except
+        # the reader's reads are fully visible; assert exactly that one
+        assert [f.code for f in found] == ["FL128"]
+        assert "'compressor'" in found[0].message
+
+    def test_locally_bound_name_key_stays_opaque(self):
+        # a key named by a LOCAL variable is a runtime value, never the
+        # module constant of the same spelling (the FL115 scoping
+        # lesson): no resolution, schema opens, zero findings
+        src = self._with_on_b(
+            "        for K_DELTA in ('x', 'y'):\n"
+            "            logging.info('%s', msg.get(K_DELTA))\n"
+            "        self.send_message(Message(MSG_A, 0, 1))\n")
+        assert codes(src, path=self.FSM_PATH) == []
+
+    def test_helper_following_sees_through_report_payload_split(self):
+        # the fedsqueeze server shape: the handler forwards msg to a
+        # same-class helper that does the payload reads -- the walk
+        # follows it, so the schema stays judged (and a renamed key
+        # still fires the pair THROUGH the helper)
+        src = self._with_on_b(
+            "        payload = self._payload(msg)\n"
+            "        if payload:\n"
+            "            self.send_message(Message(MSG_A, 0, 1))\n"
+            "        else:\n"
+            "            self.finish()\n") + (
+            "    def _payload(self, msg):\n"
+            "        if msg.get(K_DELTA) is None:\n"
+            "            return msg.get(K_SPEC)\n"
+            "        return msg.get(K_DELTA)\n")
+        assert codes(src, path=self.FSM_PATH) == []
+        renamed = src.replace("        m.add(K_DELTA, 1)\n",
+                              "        m.add('cdeltaa', 1)\n")
+        found = lint_source(renamed, path=self.FSM_PATH)
+        assert sorted(f.code for f in found) == ["FL128", "FL128"]
+        msgs = " | ".join(f.message for f in found)
+        assert "reads payload key 'cdelta'" in msgs
+        assert "'cdeltaa' of message type 'b' is set" in msgs
+
+    def test_acceptance_compressed_report_keys_in_integration(self):
+        # the real tree: resilience/integration.py's compressed-report
+        # keys (cdelta/compressor via WIRE_DELTA_KEY/WIRE_SPEC_KEY) are
+        # covered -- single-file lint stays clean (name-pairing), and
+        # renaming the CONSTANT on just the send side fires the pair
+        path = os.path.join(REPO_ROOT,
+                            "fedml_tpu/resilience/integration.py")
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        assert "out.add(WIRE_DELTA_KEY, enc)" in src
+        assert [f.code for f in lint_source(
+            src, path="fedml_tpu/resilience/integration.py")] == []
+        # rename the add-side constant: the read half goes never-set by
+        # NAME (WIRE_DELTA_KEY read has no same-named add anymore); the
+        # renamed named add is unpaired -> conservative open on the
+        # OTHER side, so exactly the read-side finding appears... the
+        # unpaired named add suppresses read-never-set; what fires is
+        # the set-never-read of the renamed key? also name-suppressed.
+        # The honest pin: single-file mutation is conservative (no FP,
+        # no finding); the FULL-TREE lint resolves values and fires.
+        mutated = src.replace("out.add(WIRE_DELTA_KEY, enc)",
+                              "out.add(WIRE_DELTA_KEY_X, enc)")
+        assert [f.code for f in lint_source(
+            mutated, path="fedml_tpu/resilience/integration.py")] == []
+        import tempfile
+        with tempfile.TemporaryDirectory() as d:
+            pkg = os.path.join(d, "fedml_tpu")
+            for rel in ("core/managers.py", "core/comm/base.py",
+                        "core/message.py", "compression/wire.py",
+                        "resilience/integration.py"):
+                dst = os.path.join(pkg, rel)
+                os.makedirs(os.path.dirname(dst), exist_ok=True)
+                with open(os.path.join(REPO_ROOT, "fedml_tpu", rel),
+                          encoding="utf-8") as fh:
+                    body = fh.read()
+                if rel.endswith("integration.py"):
+                    body = body.replace(
+                        "out.add(WIRE_DELTA_KEY, enc)",
+                        "out.add(\"cdelta_v2\", enc)")
+                with open(dst, "w", encoding="utf-8") as fh:
+                    fh.write(body)
+                init = os.path.join(os.path.dirname(dst), "__init__.py")
+                open(init, "a").close()
+            open(os.path.join(pkg, "__init__.py"), "a").close()
+            found = [f for f in lint_paths([pkg]) if f.code == "FL128"]
+        msgs = " | ".join(f.message for f in found)
+        assert "reads payload key 'cdelta'" in msgs, msgs
+        assert "'cdelta_v2' of message type 'res_report' is set" in msgs
 
 
 class TestBodyDonationInference:

@@ -565,11 +565,142 @@ class TestFedAvgAPIWiring:
         m2 = api.train_one_round()
         assert m2["async/version"] > m["async/version"]  # carries across
 
-    def test_bucket_rejects_mesh_and_compressor(self):
+    def test_bucket_rejects_mesh_but_composes_with_compressor(self):
+        # fedsqueeze (ISSUE 15): the former compressor guard is LIFTED --
+        # --bucket_edges + --compressor runs streaming-EF (the chunk
+        # program compresses each lane's delta); only mesh still rejects
         from fedml_tpu.algorithms.fedavg import FedAvgAPI
         with pytest.raises(ValueError, match="mesh"):
             FedAvgAPI(self._dataset(), _lr_spec(), self._args(),
                       mesh=object())
-        with pytest.raises(ValueError, match="compressor"):
-            FedAvgAPI(self._dataset(), _lr_spec(),
-                      self._args(compressor="qsgd:8"))
+        api = FedAvgAPI(self._dataset(), _lr_spec(),
+                        self._args(compressor="qsgd:8"))
+        assert api.bucket_runner is not None
+        assert api.bucket_runner.compressor is api.compressor
+        m = api.train_one_round()
+        # byte accounting present (this toy model is header-dominated,
+        # so the RATIO is no gate here -- the sized gates are the soak's)
+        assert m["bytes_on_wire"] > 0 and m["compression_ratio"] > 0
+
+
+class TestStreamingEF:
+    """fedsqueeze tentpole (2): the BucketedStreamRunner's compressor
+    composition -- EF inside the jitted chunk program, residuals keyed
+    by stable client id through a ResidualStore, the compiled-shape and
+    zero-retrace contracts intact."""
+
+    def _args(self, **kw):
+        base = dict(client_num_in_total=14, client_num_per_round=14,
+                    comm_round=10, epochs=1, batch_size=4, lr=0.1, wd=0.0,
+                    client_optimizer="sgd", frequency_of_the_test=100,
+                    seed=0, client_chunk=4, bucket_edges="geometric",
+                    async_agg=0, buffer_k=4, staleness_decay=0.5,
+                    async_window=4, device_resident="0")
+        base.update(kw)
+        return types.SimpleNamespace(**base)
+
+    def _dataset(self, C=14):
+        datasets = _ragged_datasets(C, dim=6, classes=4, seed=1)
+        local = dict(enumerate(datasets))
+        nums = {c: len(d["y"]) for c, d in local.items()}
+        test = datasets[0]
+        return [sum(nums.values()), len(test["y"]), None, test, nums,
+                local, {0: test}, 4]
+
+    def _api(self, **kw):
+        from fedml_tpu.algorithms.fedavg import FedAvgAPI
+        return FedAvgAPI(self._dataset(), _lr_spec(), self._args(**kw))
+
+    def test_compressor_none_bitwise_identical_to_plain(self):
+        api_p, api_n = self._api(), self._api(compressor="none")
+        assert api_n.compressor is None  # identity: the plain program
+        for _ in range(2):
+            api_p.train_one_round()
+            api_n.train_one_round()
+        for a, b in zip(jax.tree.leaves(api_p.global_state),
+                        jax.tree.leaves(api_n.global_state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_zero_retraces_and_shapes_equal_buckets_compressed(self):
+        from fedml_tpu.analysis.runtime import audit
+        report = {}
+        with audit(metrics_logger=report.update) as auditor:
+            api = self._api(compressor="topk:0.25",
+                            client_num_per_round=10)  # re-sampled cohorts
+            m = None
+            for _ in range(3):
+                m = api.train_one_round()
+                auditor.sync_and_mark_round(api.global_state)
+        assert report["audit/steady_state_retraces"] == 0, report
+        assert api.bucket_runner.compiled_shapes() == m["bucket/shapes"] > 0
+
+    def test_async_oracle_bitwise_with_compressor(self):
+        # unbounded buffer + decay 0 == the synchronous compressed fold,
+        # bit for bit (both run the same chunk program + fp64 fold)
+        api_s = self._api(compressor="qsgd:4")
+        api_a = self._api(compressor="qsgd:4", async_agg=1,
+                          buffer_k=10 ** 9, staleness_decay=0.0)
+        for _ in range(2):
+            api_s.train_one_round()
+            api_a.train_one_round()
+        for a, b in zip(jax.tree.leaves(api_s.global_state),
+                        jax.tree.leaves(api_a.global_state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_dense_and_host_spill_residual_stores_bitwise(self):
+        # the unbounded-population path: the lazy host-spill backing
+        # produces the identical trajectory to dense device rows
+        from fedml_tpu.compression import ResidualStore
+        api_d = self._api(compressor="topk:0.25")
+        assert api_d._ef_store.dense
+        api_s = self._api(compressor="topk:0.25")
+        api_s._ef_store = ResidualStore(api_s.global_state["params"],
+                                        dense=False)
+        for _ in range(3):
+            api_d.train_one_round()
+            api_s.train_one_round()
+        for a, b in zip(jax.tree.leaves(api_d.global_state),
+                        jax.tree.leaves(api_s.global_state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_residuals_keyed_by_stable_id_across_resampled_cohorts(self):
+        # a client outside round 2's cohort must keep its round-1
+        # residual byte-for-byte (id-keyed, never cohort-slot-keyed)
+        api = self._api(compressor="topk:0.25", client_num_per_round=7,
+                        seed=3)
+        api.train_one_round()
+        from fedml_tpu.algorithms.fedavg import client_sampling
+        c1 = set(client_sampling(0, 14, 7))
+        c2 = set(client_sampling(1, 14, 7))
+        touched = sorted(c1)
+        r1 = {i: api._ef_store.peek(i) for i in range(14)}
+        for i in range(14):  # round 1 touched exactly its cohort
+            nz = any(np.any(v) for v in jax.tree.leaves(r1[i]))
+            assert nz == (i in touched), i
+        api.train_one_round()
+        for i in sorted(set(range(14)) - c2):
+            for a, b in zip(jax.tree.leaves(r1[i]),
+                            jax.tree.leaves(api._ef_store.peek(i))):
+                np.testing.assert_array_equal(a, b)
+
+    def test_ef_converges_close_to_plain(self):
+        # the convergence gate: biased compressors + EF track the plain
+        # trajectory (docs/COMPRESSION.md tolerance; seeds matched)
+        api_p, api_c = self._api(), self._api(compressor="topk:0.25")
+        mp = mc = None
+        for _ in range(8):
+            mp = api_p.train_one_round()
+            mc = api_c.train_one_round()
+        assert abs(mp["Train/Loss"] - mc["Train/Loss"]) < 0.2, (mp, mc)
+
+    def test_runner_requires_residual_store(self):
+        from fedml_tpu.compression.compressors import get_compressor
+        spec = _lr_spec()
+        runner = BucketedStreamRunner(
+            spec, ClientUpdateConfig(lr=0.1), client_chunk=4,
+            batch_size=4, epochs=1, edges=[8],
+            compressor=get_compressor("qsgd:8"))
+        gs = spec.init_fn(jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="residual_store"):
+            runner.run_round(gs, (), _ragged_datasets(4, n_hi=4),
+                             jax.random.PRNGKey(1))
