@@ -653,7 +653,8 @@ def run_steering_bench(args):
     import tempfile
 
     from fedml_tpu.observability import enable
-    from fedml_tpu.resilience import (RoundPolicy, run_tcp_fedavg,
+    from fedml_tpu.program import CohortPolicy
+    from fedml_tpu.resilience import (run_tcp_fedavg,
                                       PaceBounds, PaceController)
     from fedml_tpu.resilience.faults import DiurnalTrace, TraceLoadGen
 
@@ -718,7 +719,7 @@ def run_steering_bench(args):
         return out
 
     # unshaped full-participation reference: the convergence yardstick
-    ref = one_run(RoundPolicy(deadline_s=30.0, quorum=quorum),
+    ref = one_run(CohortPolicy(deadline_s=30.0, quorum=quorum),
                   shaped=False)
     assert "rph" in ref, f"reference run failed: {ref}"
 
@@ -726,8 +727,8 @@ def run_steering_bench(args):
     quality_tol = float(args.steering_quality_tol)
     fixed = []
     for d_s, eps in sweep_cfgs:
-        r = one_run(RoundPolicy(deadline_s=d_s, overselect=eps,
-                                quorum=quorum))
+        r = one_run(CohortPolicy(deadline_s=d_s, overselect=eps,
+                                  quorum=quorum))
         r["config"] = {"deadline_s": d_s, "overselect": eps}
         if "rph" in r:
             r["quality_rel"] = round(_quality_rel(r.pop("final"),
@@ -741,7 +742,7 @@ def run_steering_bench(args):
     pace = PaceController(
         PaceBounds(deadline_s=(0.25, 8.0), overselect=(0.0, 1.0)),
         seed=args.steering_seed, deadline_s=1.0, overselect=0.0)
-    steered = one_run(RoundPolicy(deadline_s=1.0, quorum=quorum),
+    steered = one_run(CohortPolicy(deadline_s=1.0, quorum=quorum),
                       pace=pace)
     if "rph" not in steered:
         emit_failure(f"steered run failed: {steered.get('failed')}",

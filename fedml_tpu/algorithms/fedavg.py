@@ -22,35 +22,18 @@ from fedml_tpu.observability.tracing import get_tracer
 from fedml_tpu.utils.profiling import end_of_round_sync
 from fedml_tpu.parallel.engine import (
     ClientUpdateConfig, LaneRunner, ShardedLaneRunner, WaveRunner,
-    make_indexed_sim_round, make_sim_round, make_sharded_round, make_eval_fn)
+    make_indexed_sim_round, make_eval_fn)
 from fedml_tpu.parallel.mesh import shard_cohort  # noqa: F401 (re-export)
 from fedml_tpu.parallel.packing import (
     pack_cohort, pack_eval, pack_schedule, stack_clients)
-
-
-def attempt_seed(round_idx, attempt=0):
-    """Cohort-sampling seed for ``(round, attempt)``. Attempt 0 is the
-    historical per-round seed (bit-compatible with every pre-resilience
-    run); abandoned-round re-runs (``fedml_tpu.resilience``) fold the
-    attempt in to draw a fresh cohort for the same round index. The ONE
-    definition shared by the simulation path and the distributed FSM --
-    the cross-path A/B and resume contracts depend on them agreeing."""
-    return round_idx if attempt == 0 else round_idx + 1_000_003 * attempt
-
-
-def client_sampling(round_idx, client_num_in_total, client_num_per_round,
-                    attempt=0):
-    """Seeded-by-round cohort sampling, exactly the reference's
-    ``FedAVGAggregator._client_sampling`` (``FedAVGAggregator.py:89-97``):
-    reseeding with the round index makes runs reproducible and lets A/B runs
-    pick identical client subsets. ``attempt`` folds into the seed via
-    :func:`attempt_seed` for abandoned-round re-runs."""
-    num_clients = min(client_num_per_round, client_num_in_total)
-    if client_num_in_total == num_clients:
-        return list(range(client_num_in_total))
-    np.random.seed(attempt_seed(round_idx, attempt))
-    return list(np.random.choice(range(client_num_in_total),
-                                 num_clients, replace=False))
+# the cohort-seed fold and the reference's seeded sampling now live in
+# the program's cohort leg (the ONE definition shared by the simulation
+# path and the distributed FSM -- the cross-path A/B and resume
+# contracts depend on them agreeing); re-exported under their historical
+# home for the many algorithm/test callers that import them from here
+from fedml_tpu.program import RoundProgram
+from fedml_tpu.program.cohort import (  # noqa: F401 (re-export)
+    attempt_seed, client_sampling)
 
 
 class FedAvgAPI:
@@ -118,8 +101,8 @@ class FedAvgAPI:
         # must fail loudly here, not deep in shard_map.
         self.bucket_runner = None
         self.async_agg = None
-        from fedml_tpu.resilience.async_agg import AsyncAggPolicy
-        async_policy = AsyncAggPolicy.from_args(args)
+        from fedml_tpu.program import AggregationPolicy
+        async_policy = AggregationPolicy.from_args(args)
         use_buckets = (getattr(args, "bucket_edges", None) is not None
                        or async_policy is not None)
         if use_buckets:
@@ -138,20 +121,37 @@ class FedAvgAPI:
                              "program (bitwise)")
                 self.compressor = None
 
+        # the ONE RoundProgram this API executes: the arg surface's
+        # cohort/aggregation/codec legs as pure data, jitted below via
+        # compile_sim / compile_bucketed (the distributed control plane
+        # drives the same program through its host view -- the
+        # conformance suite pins the two consumers equal). Built AFTER
+        # the --compressor none bucketed identity resolution so the
+        # codec leg matches what actually runs.
+        self.program = RoundProgram.from_args(
+            args,
+            codec=(self.compressor if self.compressor is not None
+                   else "none"),
+            client_update=(spec, cfg))
+        self._host = self.program.host_view()
+
         self.compressed_round_fn = None
         if mesh is None:
-            self.round_fn = make_sim_round(spec, cfg, payload_fn, server_fn)
+            self.round_fn = self.program.compile_sim(
+                spec, cfg, payload_fn, server_fn, compressed=False)
             if self.compressor is not None and not use_buckets:
-                from fedml_tpu.compression import make_compressed_sim_round
-                self.compressed_round_fn = make_compressed_sim_round(
-                    spec, cfg, self.compressor, payload_fn, server_fn)
+                # the resolved instance is passed through: CodecSpec
+                # coercion would re-derive it from the spec string and
+                # drop instance-level configuration
+                self.compressed_round_fn = self.program.compile_sim(
+                    spec, cfg, payload_fn, server_fn, compressed=True,
+                    compressor=self.compressor)
         else:
-            self.round_fn = make_sharded_round(spec, cfg, mesh, payload_fn,
-                                               server_fn)
+            self.round_fn = self.program.compile_sim(
+                spec, cfg, payload_fn, server_fn, mesh=mesh)
         self.eval_fn = make_eval_fn(spec)
 
         if use_buckets:
-            from fedml_tpu.parallel.engine import BucketedStreamRunner
             from fedml_tpu.parallel.packing import (_steps_for,
                                                     parse_bucket_edges)
             # edges are sized from the POPULATION max so bucket shapes --
@@ -169,14 +169,13 @@ class FedAvgAPI:
             # pass the RESOLVED batch size: -1 (full-batch) must pin to
             # the population max, not each cohort's, or re-sampled
             # cohorts change the compiled [C, S, B] shape
-            self.bucket_runner = BucketedStreamRunner(
+            self.bucket_runner = self.program.compile_bucketed(
                 spec, cfg, payload_fn, server_fn,
+                compressor=self.compressor,
                 client_chunk=getattr(args, "client_chunk", 8) or 8,
-                batch_size=eff_bs, epochs=args.epochs,
-                edges=edges, compressor=self.compressor)
+                batch_size=eff_bs, epochs=args.epochs, edges=edges)
             if async_policy is not None:
-                from fedml_tpu.resilience.async_agg import BufferedAggregator
-                self.async_agg = BufferedAggregator(async_policy)
+                self.async_agg = self._host.make_aggregator()
                 self._async_window = async_policy.async_window
 
         # Device-resident data path (single-chip): upload every client's
@@ -350,6 +349,12 @@ class FedAvgAPI:
                 reporting=min(prev["res/reporting"], target))
             self.resilience.policy = dataclasses.replace(
                 self.resilience.policy, overselect=dec.overselect)
+            # the program IS the round definition: steering evolves its
+            # cohort leg in step so program readers see the live eps
+            self.program = self.program.replace(
+                cohort=dataclasses.replace(self.program.cohort,
+                                           overselect=dec.overselect))
+            self._host = self.program.host_view()
         # SimResilience.sample opens its own cohort-select span (carrying
         # the per-attempt selected/reporting attrs)
         client_indexes, record = self.resilience.sample(

@@ -207,6 +207,16 @@ RULES = {
         "sight. Use non-blocking ops on ready fds (recv_into/send) or "
         "queue the work to the dispatcher thread "
         "(fedml_tpu/net/eventloop.py is the reference shape)."),
+    "FL130": (
+        "paradigm bypass: round machinery constructed outside the program",
+        "cohort/aggregation state built directly (a legacy RoundPolicy/"
+        "AsyncAggPolicy constructor, a raw fold_entries_fp64 call) "
+        "instead of through fedml_tpu.program re-grows a paradigm-"
+        "private copy of a RoundProgram leg -- the drift the program "
+        "subsystem exists to prevent (the compressed fold landed three "
+        "times before it). Build a RoundProgram (CohortPolicy/"
+        "AggregationPolicy are its vocabulary) and drive folds through "
+        "program.host_view(); see docs/PROGRAM.md."),
 }
 
 #: SARIF rule metadata: which analysis pass owns each rule (rendered as
@@ -218,6 +228,7 @@ RULE_PASS = {
     "FL123": "fedcheck-concurrency", "FL124": "fedcheck-concurrency",
     "FL125": "fedcheck-concurrency", "FL126": "fedcheck-concurrency",
     "FL129": "fedcheck-concurrency",
+    "FL130": "fedlint-program",
 }
 
 
@@ -273,6 +284,17 @@ _FL107_PATHS = ("*/comm/*", "*transport*", "*codec*", "*compression*",
 _FL108_EXCLUDED = ("*/experiments/*", "*prepare.py", "*/scripts/*",
                    "scripts/*", "*cli.py", "bench.py", "*/bench.py",
                    "__graft_entry__.py", "*/__graft_entry__.py")
+
+#: FL130: the legacy round-machinery names whose direct call/construction
+#: outside the program package is a paradigm bypass. The program's own
+#: vocabulary (CohortPolicy/AggregationPolicy ctors, host-view methods,
+#: aggregate_reports through the facade) is NOT flagged -- only the
+#: pre-program spellings that used to be copied per paradigm. Classmethod
+#: constructors (``AsyncAggPolicy.from_args``) and ``dataclasses.replace``
+#: evolution resolve to different call names and stay legal.
+_FL130_BYPASS_NAMES = {"RoundPolicy", "AsyncAggPolicy", "fold_entries_fp64"}
+#: ...and where constructing them directly is the job, not a bypass.
+_FL130_EXEMPT_PATHS = ("*/program/*",)
 
 #: FL115: the metrics-registry write surface, how a receiver is known to
 #: BE the registry (assigned from these factories, or a `registry`-named
@@ -768,12 +790,14 @@ class _ModuleLinter:
                 out.append(p.arg)
         return out
 
-    # FL106 / FL107 / FL108 / FL109 / FL111: module-wide
+    # FL106 / FL107 / FL108 / FL109 / FL111 / FL130: module-wide
     def _check_module_wide(self, jitted_funcs):
         posix = self.path.replace(os.sep, "/")
         fl107_scoped = any(fnmatch(posix, pat) for pat in _FL107_PATHS)
         fl108_scoped = not any(fnmatch(posix, pat)
                                for pat in _FL108_EXCLUDED)
+        fl130_scoped = not any(fnmatch(posix, pat)
+                               for pat in _FL130_EXEMPT_PATHS)
         for node in ast.walk(self.tree):
             if isinstance(node, ast.Call):
                 self._check_pytree_sink(node)
@@ -782,8 +806,20 @@ class _ModuleLinter:
                 self._check_metric_labels(node)
                 if fl108_scoped:
                     self._check_debug_call(node)
+                if fl130_scoped:
+                    self._check_paradigm_bypass(node)
             elif isinstance(node, ast.ExceptHandler) and fl107_scoped:
                 self._check_except(node)
+
+    # FL130: paradigm bypass -- legacy round machinery built inline
+    def _check_paradigm_bypass(self, node):
+        _, fname = _call_root_name(node.func)
+        if fname in _FL130_BYPASS_NAMES:
+            self.add(node, "FL130",
+                     f"`{fname}(...)` constructs round machinery outside "
+                     "fedml_tpu/program/ -- build a RoundProgram "
+                     "(CohortPolicy/AggregationPolicy) and drive folds "
+                     "through program.host_view() instead")
 
     # FL115: unbounded metric label cardinality
     def _enclosing_fn(self, node):

@@ -4,7 +4,12 @@ The jit compressors (:mod:`.compressors`) run inside the simulated round
 on device; this module is their host twin for the REAL wire -- pure
 numpy, importable without jax (the soak swarm and the transports must
 stay jax-free), and free to exploit what the binary codec can frame that
-device storage cannot: sub-byte code packing.
+device storage cannot: sub-byte code packing. Both lowerings are named
+by the round program's codec leg (``fedml_tpu.program.codec.CodecSpec``
+resolves ``.device()``/``.host()`` from one spec string), and the
+twin pair is drift-gated: ``tests/test_wire_drift.py`` fuzzes every
+spec in ``wire_codecs()`` and pins the deterministic surfaces
+byte-equal across the pair.
 
 A compressed report replaces the ``params`` payload with
 

@@ -9,8 +9,9 @@ the pieces the repo already has:
   little world) and collects leaf reports with the ordinary
   :class:`~fedml_tpu.resilience.policy.RoundController` --
   deadline/quorum/partial aggregation all apply per edge;
-- a decided edge round folds its reports through
-  :func:`~fedml_tpu.resilience.policy.aggregate_reports` and forwards ONE
+- a decided edge round folds its reports through the edge's
+  :class:`~fedml_tpu.program.RoundProgram` host view
+  (:func:`~fedml_tpu.program.aggregation.aggregate_reports`) and forwards ONE
   pre-aggregated report upstream (``params`` = the edge's weighted
   average, ``num_samples`` = its reporters' sample total) over the same
   ``res_sync``/``res_report`` schema -- weighted means compose exactly:
@@ -47,9 +48,9 @@ from fedml_tpu.observability.tracing import get_tracer
 from fedml_tpu.resilience.integration import (MSG_C2S_REPORT, MSG_S2C_SYNC,
                                               ResilientFedAvgClient,
                                               quadratic_trainer)
+from fedml_tpu.program import CohortPolicy, RoundProgram
 from fedml_tpu.resilience.policy import (RetryPolicy, RoundController,
-                                         RoundPolicy, aggregate_reports,
-                                         send_with_retry)
+                                         RoundPolicy, send_with_retry)
 
 
 def round_robin_groups(ids, n_groups):
@@ -135,7 +136,13 @@ class EdgeAggregator:
                  downlink_size, round_policy: Optional[RoundPolicy] = None,
                  retry_policy: Optional[RetryPolicy] = None):
         self.edge_rank = int(edge_rank)
-        self.round_policy = round_policy or RoundPolicy()
+        # one RoundProgram per edge: the edge's round policy is its
+        # cohort leg, and the decided-round fold runs through the
+        # program's jax-free host view -- the same fold the coordinator
+        # and the sim engine execute
+        self.program = RoundProgram(cohort=round_policy or CohortPolicy())
+        self._host = self.program.host_view()
+        self.round_policy = self.program.cohort
         self.retry_policy = retry_policy or RetryPolicy()
         self.alive = set(range(1, downlink_size))
         self.rounds_forwarded = 0
@@ -193,7 +200,7 @@ class EdgeAggregator:
         self._controller.peer_lost(rank)
 
     def _on_edge_complete(self, reports, outcome):
-        params, total = aggregate_reports(reports)
+        params, total = self._host.fold_reports(reports)
         with self._lock:
             version = self._version
             self.rounds_forwarded += 1

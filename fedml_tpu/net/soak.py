@@ -301,8 +301,8 @@ def run_soak(n_clients, total_updates=3, host="localhost", port=None,
     import socket as _socket
 
     from fedml_tpu.net.eventloop import EventLoopCommManager
-    from fedml_tpu.resilience.async_agg import (AsyncAggPolicy,
-                                                AsyncBufferedFedAvgServer)
+    from fedml_tpu.program import AggregationPolicy
+    from fedml_tpu.resilience.async_agg import AsyncBufferedFedAvgServer
     if port is None:
         s = _socket.socket()
         s.bind((host, 0))
@@ -312,7 +312,7 @@ def run_soak(n_clients, total_updates=3, host="localhost", port=None,
         init_params = {"w": np.zeros(8, np.float32),
                        "b": np.ones(4, np.float32)}
     world = n_clients + 1
-    policy = AsyncAggPolicy(
+    policy = AggregationPolicy(
         buffer_k=buffer_k if buffer_k is not None else n_clients,
         staleness_decay=0.5, flush_deadline_s=float(flush_deadline_s))
     # the swarm dials with retry, so spawn it first and let the server's

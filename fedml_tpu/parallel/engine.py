@@ -23,6 +23,13 @@ Aggregator hooks (see ``fedml_tpu.algorithms``):
   server_fn(global_state, avg_payload, server_state, rng) -> (new_global, new_server_state)
       global update from the weighted-average payload (identity for FedAvg,
       optimizer step on the pseudo-gradient for FedOpt).
+
+Consumers reach these round factories through
+``RoundProgram.compile_sim`` / ``compile_bucketed``
+(:mod:`fedml_tpu.program.sim`): the program object carries the
+cohort/aggregation/codec policy and this module is its jit lowering --
+the distributed control plane lowers the SAME program host-side via
+``program.host_view()`` (docs/PROGRAM.md).
 """
 
 from __future__ import annotations

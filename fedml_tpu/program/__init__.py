@@ -1,0 +1,33 @@
+"""fedml_tpu.program: one `RoundProgram` subsystem behind both paradigms.
+
+The single definition of a federated round -- cohort selection
+(:mod:`.cohort`), aggregation (:mod:`.aggregation`), codec policy
+(:mod:`.codec`) -- as pure data plus pure functions, consumed by the
+jitted simulation engine (:meth:`RoundProgram.compile_sim`) and the
+jax-free distributed control plane (:meth:`RoundProgram.host_view`)
+alike. docs/PROGRAM.md is the contract; fedlint FL130 keeps new code
+from re-growing a paradigm-private copy of any leg.
+
+This package imports without jax (the soak swarm / transport
+requirement); only the explicit device accessors
+(``CodecSpec.device()``, ``compile_sim``) pull it in.
+"""
+
+from fedml_tpu.program.aggregation import (
+    AGG_ASYNC, AGG_SYNC, AggregationPolicy, BufferedAggregator,
+    FlushResult, aggregate_reports, fold_entries_fp64, staleness_weight)
+from fedml_tpu.program.cohort import (
+    CohortPolicy, attempt_seed, client_sampling, sample_ranks)
+from fedml_tpu.program.codec import CodecSpec, WIRE_CODEC_NAMES, wire_codecs
+from fedml_tpu.program.round import HostProgram, RoundProgram
+from fedml_tpu.program.sim import compile_bucketed, compile_sim
+
+__all__ = [
+    "RoundProgram", "HostProgram",
+    "CohortPolicy", "attempt_seed", "client_sampling", "sample_ranks",
+    "AggregationPolicy", "AGG_SYNC", "AGG_ASYNC", "BufferedAggregator",
+    "FlushResult", "aggregate_reports", "fold_entries_fp64",
+    "staleness_weight",
+    "CodecSpec", "WIRE_CODEC_NAMES", "wire_codecs",
+    "compile_sim", "compile_bucketed",
+]

@@ -1,0 +1,404 @@
+"""Aggregation: the canonical fold + the one buffered-async aggregator.
+
+The ``RoundProgram``'s aggregation leg. Two regimes behind one policy
+object:
+
+- **sync partial** (Bonawitz): the round barrier collects reports and
+  :func:`aggregate_reports` renormalizes over the *reporting* subset.
+- **FedBuff buffered** (Nguyen et al., AISTATS 2022): no barrier --
+  :class:`BufferedAggregator` folds updates as they arrive, staleness-
+  weighted, and flushes every K folds (or on a deadline).
+
+Both flush through :func:`fold_entries_fp64` -- the sorted-key float64
+normalize-late fold -- which is what makes the async oracle exact: with
+an infinite flush deadline, staleness decay 0 (weight 1) and
+``buffer_k`` = cohort size, one flush IS ``aggregate_reports`` of the
+same reports, bit for bit. Every consumer (the sim engine's bucketed
+streaming, both distributed servers, the fan-in edges) folds through
+THIS module; fedlint FL130 flags new out-of-band folds.
+
+Host-importable without jax at module scope (the fold imports jax
+lazily -- its ``jax.tree.map`` over numpy leaves never touches a
+device), which is what keeps ``RoundProgram.host_view()`` jax-free.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from fedml_tpu.core.locks import audited_lock
+from fedml_tpu.observability.perfmon import get_perf_monitor
+from fedml_tpu.observability.registry import get_registry
+from fedml_tpu.observability.tracing import get_tracer
+
+#: AggregationPolicy.mode values.
+AGG_SYNC = "sync"    # barrier round: partial aggregation over reporters
+AGG_ASYNC = "async"  # FedBuff: buffered, staleness-weighted, K/deadline
+
+
+@dataclass(frozen=True)
+class AggregationPolicy:
+    """Aggregation knobs for one :class:`~fedml_tpu.program.RoundProgram`.
+    ``resilience.AsyncAggPolicy`` is this class (a compatibility alias;
+    its historical positional field order is preserved, with ``mode``
+    appended last).
+
+    Args:
+      buffer_k: server update every K buffered client updates (FedBuff's
+        K; the flush also fires early when every still-alive client has
+        reported -- a buffer that can never fill must not deadlock).
+      staleness_decay: polynomial staleness exponent ``a``; an update
+        ``s`` versions stale is weighted ``(1 + s) ** -a``. ``0`` weights
+        every update 1 (the oracle setting); ``0.5`` is FedBuff's
+        ``1/sqrt(1+s)``.
+      flush_deadline_s: wall-clock bound from the first fold of a window
+        to its flush; ``0`` disables (flush only on K). The async analog
+        of the synchronous report deadline: a deadline flush below K is
+        counted ``degraded``.
+      async_window: simulation only -- how many in-flight bucket chunks
+        the streaming engine keeps dispatched before folding the oldest
+        (the simulated client concurrency; staleness appears when
+        ``buffer_k`` flushes fall inside the window).
+      mode: ``"async"`` (FedBuff buffered -- the historical meaning of
+        constructing this policy at all) or ``"sync"`` (barrier round;
+        the buffered knobs are inert and the program folds through
+        :func:`aggregate_reports`).
+    """
+
+    buffer_k: int = 64
+    staleness_decay: float = 0.5
+    flush_deadline_s: float = 0.0
+    async_window: int = 4
+    mode: str = AGG_ASYNC
+
+    @classmethod
+    def sync(cls) -> "AggregationPolicy":
+        """The barrier-round policy: fold reports at the round boundary
+        through :func:`aggregate_reports`, no buffer."""
+        return cls(buffer_k=0, staleness_decay=0.0, flush_deadline_s=0.0,
+                   async_window=0, mode=AGG_SYNC)
+
+    @property
+    def is_async(self) -> bool:
+        return self.mode == AGG_ASYNC
+
+    @classmethod
+    def from_args(cls, args) -> Optional["AggregationPolicy"]:
+        if not int(getattr(args, "async_agg", 0) or 0):
+            return None
+        return cls(
+            buffer_k=int(getattr(args, "buffer_k", 64) or 64),
+            staleness_decay=float(getattr(args, "staleness_decay", 0.5)),
+            flush_deadline_s=float(getattr(args, "flush_deadline", 0.0)
+                                   or 0.0),
+            async_window=int(getattr(args, "async_window", 4) or 4))
+
+
+def staleness_weight(staleness, decay) -> float:
+    """Polynomial staleness discount ``(1 + s) ** -decay`` (monotone
+    non-increasing in ``s``; exactly 1.0 at ``s == 0`` or ``decay == 0``,
+    so the oracle settings multiply by a float64-exact 1.0)."""
+    s = max(0, int(staleness))
+    if s == 0 or decay == 0:
+        return 1.0
+    return float((1.0 + s) ** -float(decay))
+
+
+def fold_entries_fp64(entries) -> tuple:
+    """THE canonical weighted fold: sorted-key, float64, normalize-late.
+
+    ``entries``: iterable of ``(sort_key, weight, payload_pytree, scale)``
+    where the entry contributes ``float64(payload) * scale`` to the
+    numerator and ``weight`` to the denominator. Per-client reports use
+    ``scale == weight == n_i`` (a plain weighted average); the bucketed
+    streaming engine feeds PRE-WEIGHTED partial sums with
+    ``scale == staleness_weight`` and ``weight == w_sum * staleness_weight``.
+
+    A payload may also be a
+    :class:`~fedml_tpu.compression.wire.CompressedUpdate` (a compressed
+    report's encoded delta + the base params it is relative to): its
+    logical contribution is ``scale * float64(base + decoded_delta)``,
+    folded WITHOUT densifying per report -- the decoded delta
+    accumulates sparsely/quantized (O(k) for a topk report) in sorted
+    entry order, and each DISTINCT base is added exactly once, scaled by
+    the sum of its entries' scales, in sorted ``base_key`` order. The
+    fold stays arrival-order independent; what "bitwise" means under
+    lossy compression is pinned in docs/COMPRESSION.md ("Distributed
+    wire path"): the compressed fold is its own canonical f64 order --
+    NOT bit-equal to reconstructing each report in f32 first -- and the
+    async oracle (decay 0) still equals the synchronous compressed fold
+    bit for bit, because both run this exact function over the same
+    entries.
+
+    Returns ``(params_f32, weight_total)``. Folding in sorted-key order
+    (never arrival order) is what makes the result bitwise deterministic:
+    :class:`BufferedAggregator` flushes through this exact function, so
+    the async path with staleness weight 1 and one flush reproduces
+    :func:`aggregate_reports` bit-for-bit no matter which order the
+    reports raced in.
+    """
+    import jax
+
+    from fedml_tpu.compression.wire import CompressedUpdate
+
+    entries = sorted(entries, key=lambda e: e[0])
+    if not entries:
+        raise ValueError("weighted fold over an empty entry set "
+                         "(abandon/skip instead)")
+    total = 0.0
+    acc = None          # dense contributions (f64 pytree)
+    cacc = None         # compressed-delta contributions ({name: f64})
+    base_acc = {}       # base_key -> [scale_sum, base params]
+    for _key, weight, payload, scale in entries:
+        total += float(weight)
+        if isinstance(payload, CompressedUpdate):
+            cacc = payload.fold_delta(cacc, float(scale))
+            slot = base_acc.setdefault(payload.base_key,
+                                       [0.0, payload.base])
+            slot[0] += float(scale)
+            continue
+        contrib = jax.tree.map(
+            lambda x: np.asarray(x, np.float64) * float(scale), payload)
+        acc = contrib if acc is None else jax.tree.map(np.add, acc, contrib)
+    # canonical combine order: dense entries (sorted), then each distinct
+    # base (sorted by key), then the sparse delta accumulator
+    for bk in sorted(base_acc):
+        scale_sum, base = base_acc[bk]
+        bcontrib = jax.tree.map(
+            lambda x: np.asarray(x, np.float64) * float(scale_sum), base)
+        acc = bcontrib if acc is None else jax.tree.map(np.add, acc,
+                                                        bcontrib)
+    if cacc is not None:
+        acc = cacc if acc is None else jax.tree.map(np.add, acc, cacc)
+    if total <= 0:
+        raise ValueError("weighted fold has zero total weight")
+    return jax.tree.map(lambda x: (x / total).astype(np.float32), acc), total
+
+
+def aggregate_reports(reports) -> tuple:
+    """Weighted average over the *reporting* subset, renormalized.
+
+    ``reports``: ``{rank: (num_samples, params_pytree)}`` (numpy leaves --
+    this is the host-side control plane). Returns ``(params, total_n)``.
+    Delegates to :func:`fold_entries_fp64` -- sorted-rank float64 fold, so
+    two runs over the same subset are bitwise identical (the chaos smoke's
+    A/B oracle) AND the buffered async aggregator (which flushes through
+    the same fold) matches it bit-for-bit under the oracle settings.
+    Weights divide by the reporters' sample total -- never the selected
+    cohort's -- so a dropped client renormalizes instead of zero-biasing;
+    an empty subset fails fast (parity with the engine's empty-cohort
+    guard, ``engine.py:325``).
+    """
+    if not reports:
+        raise ValueError("aggregate_reports over an empty reporting subset "
+                         "(abandon the round instead)")
+    # sorted-rank order for the guard sum too: the returned total must be
+    # arrival-order deterministic, exactly like the fold's denominator
+    total = float(sum(float(reports[r][0]) for r in sorted(reports)))
+    if total <= 0:
+        raise ValueError("reporting subset has zero total samples")
+    params, fold_total = fold_entries_fp64(
+        (r, float(n), payload, float(n))
+        for r, (n, payload) in reports.items())
+    assert fold_total == total  # same addends, same (sorted) order
+    return params, total
+
+
+@dataclass(frozen=True)
+class FlushResult:
+    """One server update produced by :meth:`BufferedAggregator.flush`."""
+
+    params: dict          # f32 pytree (the fold output)
+    weight: float         # renormalization denominator (post-staleness)
+    version: int          # server version AFTER this flush
+    contributors: tuple   # entry keys folded (ranks / chunk ordinals)
+    clients: int          # client updates represented by those entries
+    reason: str           # "buffer_k" | "deadline" | "drain" | "peer_lost"
+    max_staleness: int
+
+
+class BufferedAggregator:
+    """Thread-safe staleness-weighted update buffer with K/deadline flush.
+
+    ``fold`` accepts either per-client reports (``weight`` = the client's
+    sample count, payload = its params) or pre-weighted partial sums from
+    the streaming engine (``preweighted=True``: payload is already
+    ``sum_i n_i * p_i`` over ``clients`` members, ``weight`` their
+    ``sum_i n_i``). Entries are retained until ``flush`` folds them in
+    sorted-key order through :func:`fold_entries_fp64` -- memory is
+    O(buffer_k) payloads and the flushed bytes are arrival-order
+    independent. Re-folding an existing key overwrites (newest wins --
+    the older update trained on strictly staler params) and is counted.
+    """
+
+    def __init__(self, policy: AggregationPolicy):
+        self.policy = policy
+        self._lock = audited_lock()
+        self._entries = {}        # key -> (weight, payload, scale)
+        self._entry_clients = {}  # key -> client count
+        self._entry_staleness = {}
+        self.version = 0
+        self.counters = {"folds": 0, "flushes": 0, "drain_flushes": 0,
+                         "deadline_flushes": 0, "overwrites": 0,
+                         "clients_folded": 0, "max_staleness": 0,
+                         "depth_peak": 0}
+
+    @property
+    def depth(self) -> int:
+        """Distinct buffered entries (the ``fed_buffer_depth`` gauge)."""
+        with self._lock:
+            return len(self._entries)
+
+    def clients_buffered(self) -> int:
+        with self._lock:
+            return sum(self._entry_clients.values())
+
+    def fold(self, key, weight, payload, staleness=0, clients=1,
+             preweighted=False) -> int:
+        """Buffer one update; returns the post-fold distinct-entry depth.
+
+        ``staleness`` = server versions elapsed since the update's model
+        was issued (``version_now - version_born``); the entry's weight
+        (and, for pre-weighted partials, its numerator scale) is
+        multiplied by :func:`staleness_weight`.
+        """
+        with get_tracer().span("buffer-fold", staleness=int(staleness),
+                               clients=int(clients)) as sp:
+            with self._lock:
+                depth = self._fold_locked(key, weight, payload, staleness,
+                                          clients, preweighted)
+            sp.set(depth=depth)
+        self._note_fold(staleness, depth)
+        return depth
+
+    def _fold_locked(self, key, weight, payload, staleness, clients,
+                     preweighted):
+        """One entry into the buffer; callers hold ``_lock``."""
+        sw = staleness_weight(staleness, self.policy.staleness_decay)
+        w = float(weight) * sw
+        scale = sw if preweighted else w
+        if key in self._entries:
+            self.counters["overwrites"] += 1
+        else:
+            self.counters["clients_folded"] += int(clients)
+        self._entries[key] = (w, payload, scale)
+        self._entry_clients[key] = int(clients)
+        self._entry_staleness[key] = int(staleness)
+        self.counters["folds"] += 1
+        self.counters["max_staleness"] = max(
+            self.counters["max_staleness"], int(staleness))
+        depth = len(self._entries)
+        self.counters["depth_peak"] = max(
+            self.counters["depth_peak"], depth)
+        return depth
+
+    def _note_fold(self, staleness, depth):
+        reg = get_registry()
+        if reg is not None:
+            reg.set_gauge("fed_buffer_depth", depth,
+                          help="distinct updates buffered awaiting flush")
+            reg.set_gauge("fed_update_staleness", int(staleness),
+                          help="staleness (server versions) of the last "
+                               "folded update")
+        mon = get_perf_monitor()
+        if mon is not None:
+            # the histogram complement of the point gauges above (pace
+            # steering reads distributions, not last values)
+            mon.observe_fold(staleness, depth)
+
+    def fold_many(self, entries, ready_target=None):
+        """Batched-entry fold: buffer ``entries`` (a list of ``(key,
+        weight, payload, staleness)`` per-client reports) under ONE lock
+        acquisition, stopping after the entry that brings the buffered
+        client count to the flush threshold (``buffer_k`` capped by
+        ``ready_target``, exactly :meth:`ready`'s rule). Returns
+        ``(consumed, depth)``: the caller flushes and re-enters with the
+        remainder. Fold order is the list order, the flush boundary is
+        the same entry it would be folding one at a time, and
+        :meth:`flush` sorts by key anyway -- so a chunk of reports costs
+        one lock acquisition per flush window instead of one per report
+        while staying bitwise-identical to the per-report path (pinned
+        in tests/test_async_agg.py)."""
+        k = self.policy.buffer_k
+        if ready_target is not None:
+            k = min(k, int(ready_target))
+        k = max(1, k)
+        consumed = 0
+        depth = 0
+        noted = []
+        with get_tracer().span("buffer-fold", batch=len(entries)) as sp:
+            with self._lock:
+                for key, weight, payload, staleness in entries:
+                    depth = self._fold_locked(key, weight, payload,
+                                              staleness, 1, False)
+                    noted.append((staleness, depth))
+                    consumed += 1
+                    if sum(self._entry_clients.values()) >= k:
+                        break
+            sp.set(depth=depth, consumed=consumed)
+        for staleness, d in noted:
+            self._note_fold(staleness, d)
+        return consumed, depth
+
+    def ready(self, target=None) -> bool:
+        """True when the buffered client count reaches ``buffer_k`` --
+        capped by ``target`` (e.g. the number of still-alive clients)
+        so a buffer that can never fill does not deadlock the plane."""
+        k = self.policy.buffer_k
+        if target is not None:
+            k = min(k, int(target))
+        with self._lock:
+            return sum(self._entry_clients.values()) >= max(1, k)
+
+    def flush(self, reason="buffer_k") -> FlushResult:
+        """Fold + clear the buffer, bump the server version."""
+        with self._lock:
+            if not self._entries:
+                raise ValueError("flush of an empty update buffer")
+            entries = [(k, w, p, s)
+                       for k, (w, p, s) in self._entries.items()]
+            clients = sum(self._entry_clients.values())
+            max_stale = max(self._entry_staleness.values())
+            self._entries = {}
+            self._entry_clients = {}
+            self._entry_staleness = {}
+            self.version += 1
+            version = self.version
+            self.counters["flushes"] += 1
+            if reason == "deadline":
+                self.counters["deadline_flushes"] += 1
+            elif reason == "drain":
+                self.counters["drain_flushes"] += 1
+        with get_tracer().span("buffer-flush", reason=reason,
+                               entries=len(entries), clients=clients,
+                               version=version):
+            params, weight = fold_entries_fp64(entries)
+        reg = get_registry()
+        if reg is not None:
+            reg.set_gauge("fed_buffer_depth", 0,
+                          help="distinct updates buffered awaiting flush")
+            reg.inc("fed_buffer_flushes_total",
+                    help="server updates produced by the async buffer",
+                    reason=reason)
+        return FlushResult(params=params, weight=weight, version=version,
+                           contributors=tuple(k for k, _, _, _ in entries),
+                           clients=clients, reason=reason,
+                           max_staleness=max_stale)
+
+    def record(self, prefix="async/") -> dict:
+        """Cumulative counters as a metrics-record fragment (rides every
+        round record on async runs -- the buffer-depth/staleness series
+        lands in metrics.jsonl even with observability off)."""
+        with self._lock:
+            out = {prefix + k: v for k, v in self.counters.items()}
+            out[prefix + "version"] = self.version
+            out[prefix + "buffer_depth"] = len(self._entries)
+        return out
+
+
+__all__ = ["AGG_SYNC", "AGG_ASYNC", "AggregationPolicy",
+           "staleness_weight", "fold_entries_fp64", "aggregate_reports",
+           "FlushResult", "BufferedAggregator"]

@@ -1,0 +1,99 @@
+"""Codec policy: one spec string, two lowerings, zero drift.
+
+The ``RoundProgram``'s codec leg. A compressor exists twice by design:
+the jit lowering (:mod:`fedml_tpu.compression.compressors`) runs fused
+inside the simulated round on device; the host twin
+(:mod:`fedml_tpu.compression.wire`) encodes the same spec as pure numpy
+for the real transport. :class:`CodecSpec` is the pure-data knob that
+names both -- consumers ask it for the lowering they need instead of
+resolving spec strings themselves, and the codec-twin drift gate
+(tests/test_wire_drift.py) pins every spec :func:`wire_codecs` can name
+byte-equal across the pair, so a new codec cannot ship one-sided.
+
+``device()`` is the only jax-touching accessor (lazy import);
+everything else keeps the host view importable without jax.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+#: wire-capable codec families: every name the host-twin registry serves.
+#: randk is deliberately absent (sim-only -- unbiased sparsification
+#: needs the shared rng stream; ``wire.host_compressor`` rejects it).
+WIRE_CODEC_NAMES = ("qsgd", "topk", "signsgd")
+
+
+def wire_codecs():
+    """The exhaustive wire-codec spec table: every host-twin family at
+    its default arg plus the non-default points the parity contract
+    covers. The drift gate iterates THIS list -- adding a codec to the
+    wire registry without extending it (and the jax side) fails the
+    exhaustiveness check in tests/test_wire_drift.py."""
+    return ["qsgd", "qsgd:2", "qsgd:4", "qsgd:8",
+            "topk", "topk:0.01", "topk:0.25",
+            "signsgd"]
+
+
+@dataclass(frozen=True)
+class CodecSpec:
+    """Pure-data compressor selection for one ``RoundProgram``.
+
+    ``spec`` is the one grammar both registries parse (``"qsgd:4"``,
+    ``"topk:0.01"``, ``"signsgd"``, ``"none"``). The EF class policy
+    rides the spec: biased contractions (topk, signsgd) run with error
+    feedback on both lowerings; unbiased quantizers (qsgd) run without
+    (the wire twin's ``ef`` flag is authoritative -- see
+    ``compression/wire.py`` on why feedback destabilizes qsgd).
+    """
+
+    spec: str = "none"
+
+    @classmethod
+    def coerce(cls, spec) -> "CodecSpec":
+        """None / spec string / Compressor-like instance / CodecSpec ->
+        CodecSpec. Instances coerce through their ``spec`` (wire twins)
+        or ``name`` (device compressors) attribute."""
+        if isinstance(spec, cls):
+            return spec
+        if spec is None:
+            return cls("none")
+        if isinstance(spec, str):
+            return cls(spec.strip().lower() or "none")
+        s = getattr(spec, "spec", None) or getattr(spec, "name", None)
+        if not s:
+            raise TypeError(f"cannot coerce {spec!r} into a CodecSpec")
+        return cls(str(s))
+
+    @property
+    def enabled(self) -> bool:
+        return self.spec not in ("", "0", "off", "false", "none")
+
+    @property
+    def name(self) -> str:
+        return self.spec.partition(":")[0]
+
+    def device(self):
+        """The jit compressor (or None when disabled). Lazy jax import --
+        never called from a host view."""
+        if not self.enabled:
+            return None
+        from fedml_tpu.compression.compressors import get_compressor
+        return get_compressor(self.spec)
+
+    def host(self):
+        """The numpy wire twin (or None when disabled) -- what the
+        distributed clients encode with and the servers fold."""
+        if not self.enabled:
+            return None
+        from fedml_tpu.compression.wire import host_compressor
+        return host_compressor(self.spec)
+
+    def host_ef(self) -> bool:
+        """Whether the wire path runs error feedback under this spec
+        (the host twin's ``ef`` class flag; False when disabled)."""
+        c = self.host()
+        return bool(c is not None and c.ef)
+
+
+__all__ = ["CodecSpec", "WIRE_CODEC_NAMES", "wire_codecs"]

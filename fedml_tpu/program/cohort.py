@@ -1,0 +1,97 @@
+"""Cohort selection: THE one sampling vocabulary both paradigms share.
+
+A federated round begins by choosing who participates. The simulation
+engine (``algorithms/fedavg.py``) samples client *indices* from a fixed
+population; the distributed control plane (``resilience/integration.py``)
+samples live transport *ranks*; over-selection and abandoned-round
+re-attempts perturb both. Before the ``RoundProgram`` subsystem each
+path carried its own copy of this logic -- this module is now the single
+definition, and the cross-path A/B + resume contracts depend on every
+consumer delegating here (fedlint FL130 flags new bypasses).
+
+Everything in this module is pure host-side numpy: importable without
+jax (the control plane's hard requirement -- see
+:meth:`fedml_tpu.program.round.RoundProgram.host_view`).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+
+def attempt_seed(round_idx, attempt=0):
+    """Cohort-sampling seed for ``(round, attempt)``. Attempt 0 is the
+    historical per-round seed (bit-compatible with every pre-resilience
+    run); abandoned-round re-runs fold the attempt in to draw a fresh
+    cohort for the same round index. The ONE definition shared by the
+    simulation path and the distributed FSM -- the cross-path A/B and
+    resume contracts depend on them agreeing."""
+    return round_idx if attempt == 0 else round_idx + 1_000_003 * attempt
+
+
+def client_sampling(round_idx, client_num_in_total, client_num_per_round,
+                    attempt=0):
+    """Seeded-by-round cohort sampling, exactly the reference's
+    ``FedAVGAggregator._client_sampling`` (``FedAVGAggregator.py:89-97``):
+    reseeding with the round index makes runs reproducible and lets A/B
+    runs pick identical client subsets. ``attempt`` folds into the seed
+    via :func:`attempt_seed` for abandoned-round re-runs."""
+    num_clients = min(client_num_per_round, client_num_in_total)
+    if client_num_in_total == num_clients:
+        return list(range(client_num_in_total))
+    np.random.seed(attempt_seed(round_idx, attempt))
+    return list(np.random.choice(range(client_num_in_total),
+                                 num_clients, replace=False))
+
+
+def sample_ranks(round_idx, attempt, ranks, k):
+    """Sample ``k`` transport ranks from ``ranks`` with the SAME seeded
+    stream as :func:`client_sampling` (the distributed control plane's
+    cohort draw). Returns a sorted list; ``k >= len(ranks)`` selects
+    everyone. Sorting the candidate set first makes the draw independent
+    of set-iteration order -- two servers with the same alive set pick
+    the same cohort."""
+    ranks = sorted(int(r) for r in ranks)
+    if k >= len(ranks):
+        return list(ranks)
+    np.random.seed(attempt_seed(round_idx, attempt))
+    return sorted(int(r) for r in np.random.choice(ranks, int(k),
+                                                   replace=False))
+
+
+@dataclass(frozen=True)
+class CohortPolicy:
+    """Server-side round knobs (Bonawitz §3 pace steering) -- the
+    ``RoundProgram``'s cohort-selection leg. ``resilience.RoundPolicy``
+    is this class (a compatibility alias).
+
+    Args:
+      deadline_s: report deadline per round attempt; 0 disables the timer
+        (the round completes only when ``target`` reports arrive).
+      overselect: eps in ``select ceil((1+eps) * C)``.
+      quorum: minimum reporting fraction of the aggregation target C for a
+        deadline round to complete (degraded); below it the round is
+        abandoned and re-run.
+      max_round_retries: abandoned-round re-runs before giving up.
+    """
+
+    deadline_s: float = 0.0
+    overselect: float = 0.0
+    quorum: float = 0.5
+    max_round_retries: int = 3
+
+    def select_count(self, target: int,
+                     available: Optional[int] = None) -> int:
+        n = int(math.ceil((1.0 + self.overselect) * target))
+        return n if available is None else min(n, available)
+
+    def quorum_count(self, target: int) -> int:
+        return max(1, int(math.ceil(self.quorum * target)))
+
+
+__all__ = ["attempt_seed", "client_sampling", "sample_ranks",
+           "CohortPolicy"]

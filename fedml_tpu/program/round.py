@@ -1,0 +1,190 @@
+"""`RoundProgram`: one federated round as pure data + pure functions.
+
+SURVEY.md's design stance -- "three user-facing paradigms as thin
+wrappers over the same core round function" -- lands here. A
+:class:`RoundProgram` bundles the three policy legs of a round:
+
+- :class:`~fedml_tpu.program.cohort.CohortPolicy` -- who participates
+  (sampling, over-selection, attempt folding, quorum/deadline);
+- :class:`~fedml_tpu.program.aggregation.AggregationPolicy` -- how
+  updates combine (sync partial vs FedBuff-buffered, staleness
+  weighting, always through the sorted-key fp64
+  :func:`~fedml_tpu.program.aggregation.fold_entries_fp64` order);
+- :class:`~fedml_tpu.program.codec.CodecSpec` -- what crosses the wire
+  (compressor family, EF class policy, host/device twin pair);
+
+plus an optional opaque ``client_update`` (a ``(TrainSpec, config)``
+pair or callable -- simulation only; the distributed plane's clients
+own their trainers).
+
+Both consumers drive the SAME program object:
+
+- the sim engine jits it: :meth:`RoundProgram.compile_sim` lowers the
+  program to the vmapped/sharded round functions in
+  ``parallel/engine.py`` / ``compression/integration.py``;
+- the distributed control plane stays jax-free:
+  :meth:`RoundProgram.host_view` returns a :class:`HostProgram` facade
+  (numpy only, backed by the wire twins) that the threaded FSMs call
+  for every cohort draw and every fold.
+
+What a consumer must NOT do is re-implement a leg inline -- fedlint
+FL130 ("paradigm bypass") flags direct constructions of the legacy
+policy/fold machinery outside this package. See docs/PROGRAM.md for the
+full contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from fedml_tpu.program.aggregation import (
+    AggregationPolicy, BufferedAggregator, aggregate_reports,
+    fold_entries_fp64, staleness_weight)
+from fedml_tpu.program.cohort import (
+    CohortPolicy, client_sampling, sample_ranks)
+from fedml_tpu.program.codec import CodecSpec
+
+
+@dataclass(frozen=True)
+class RoundProgram:
+    """One round definition both paradigms execute.
+
+    Pure data: frozen, comparable, trivially serializable minus the
+    opaque ``client_update``. Evolve it with ``dataclasses.replace``
+    (pace steering replaces the cohort/aggregation legs mid-run and
+    hands the new program to the same consumer).
+    """
+
+    cohort: CohortPolicy = field(default_factory=CohortPolicy)
+    aggregation: AggregationPolicy = field(
+        default_factory=AggregationPolicy.sync)
+    codec: CodecSpec = field(default_factory=CodecSpec)
+    client_update: Any = field(default=None, compare=False)
+
+    def __post_init__(self):
+        # the codec leg accepts the whole arg-surface vocabulary (spec
+        # string, None, a compressor instance) on ANY construction path,
+        # not just from_args -- a program always holds a CodecSpec
+        object.__setattr__(self, "codec", CodecSpec.coerce(self.codec))
+
+    @classmethod
+    def from_args(cls, args, codec=None,
+                  client_update=None) -> "RoundProgram":
+        """Build the program the arg surface describes: resilience knobs
+        -> cohort leg, ``--async_agg`` family -> aggregation leg,
+        ``--compressor`` (or the ``codec`` override) -> codec leg."""
+        cohort = CohortPolicy(
+            deadline_s=float(getattr(args, "deadline", 0.0) or 0.0),
+            overselect=float(getattr(args, "overselect", 0.0) or 0.0),
+            quorum=float(getattr(args, "quorum", 0.5) or 0.5))
+        agg = (AggregationPolicy.from_args(args)
+               or AggregationPolicy.sync())
+        spec = (codec if codec is not None
+                else getattr(args, "compressor", None))
+        return cls(cohort=cohort, aggregation=agg,
+                   codec=CodecSpec.coerce(spec),
+                   client_update=client_update)
+
+    @property
+    def is_async(self) -> bool:
+        return self.aggregation.is_async
+
+    def replace(self, **changes) -> "RoundProgram":
+        return dataclasses.replace(self, **changes)
+
+    def host_view(self) -> "HostProgram":
+        """The jax-free control-plane facade over this program (cohort
+        draws, folds, aggregator construction, wire codec)."""
+        return HostProgram(self)
+
+    def compile_sim(self, spec, cfg, payload_fn=None, server_fn=None,
+                    mesh=None, compressed=None, compressor=None):
+        """Lower this program to a jitted simulation round function --
+        see :func:`fedml_tpu.program.sim.compile_sim`."""
+        from fedml_tpu.program.sim import compile_sim
+        return compile_sim(self, spec, cfg, payload_fn=payload_fn,
+                           server_fn=server_fn, mesh=mesh,
+                           compressed=compressed, compressor=compressor)
+
+    def compile_bucketed(self, spec, cfg, payload_fn=None, server_fn=None,
+                         compressor=None, **kwargs):
+        """Lower this program to the bucketed streaming runner -- see
+        :func:`fedml_tpu.program.sim.compile_bucketed`."""
+        from fedml_tpu.program.sim import compile_bucketed
+        return compile_bucketed(self, spec, cfg, payload_fn=payload_fn,
+                                server_fn=server_fn,
+                                compressor=compressor, **kwargs)
+
+
+class HostProgram:
+    """Jax-free view of one :class:`RoundProgram` for the distributed
+    control plane (and any other host-side consumer: the fan-in edges,
+    the soak swarm). Every method is a thin delegation into the
+    program's policy legs -- the facade exists so a consumer touches ONE
+    object, and so the conformance suite (tests/test_program.py) can pin
+    "host view == sim trajectory" per program config.
+    """
+
+    def __init__(self, program: RoundProgram):
+        self.program = program
+
+    # -- cohort ----------------------------------------------------------
+    @property
+    def cohort(self) -> CohortPolicy:
+        return self.program.cohort
+
+    def sample_cohort(self, round_idx, total, per_round, attempt=0):
+        """Seeded client-index cohort (the sim population draw)."""
+        return client_sampling(round_idx, total, per_round, attempt)
+
+    def sample_ranks(self, round_idx, attempt, ranks, k):
+        """Seeded transport-rank cohort (the distributed draw)."""
+        return sample_ranks(round_idx, attempt, ranks, k)
+
+    def select_count(self, target, available=None) -> int:
+        return self.program.cohort.select_count(target, available)
+
+    def quorum_count(self, target) -> int:
+        return self.program.cohort.quorum_count(target)
+
+    # -- aggregation -----------------------------------------------------
+    @property
+    def aggregation(self) -> AggregationPolicy:
+        return self.program.aggregation
+
+    def fold_reports(self, reports) -> tuple:
+        """Sync partial aggregation over the reporting subset
+        (:func:`~fedml_tpu.program.aggregation.aggregate_reports`)."""
+        return aggregate_reports(reports)
+
+    def fold_entries(self, entries) -> tuple:
+        """The canonical sorted-key fp64 fold
+        (:func:`~fedml_tpu.program.aggregation.fold_entries_fp64`)."""
+        return fold_entries_fp64(entries)
+
+    def staleness_weight(self, staleness) -> float:
+        return staleness_weight(staleness,
+                                self.program.aggregation.staleness_decay)
+
+    def make_aggregator(self,
+                        policy: Optional[AggregationPolicy] = None
+                        ) -> BufferedAggregator:
+        """The program's buffered aggregator (async leg). ``policy``
+        overrides the program's (pace steering hands the steered policy
+        to the same aggregator class)."""
+        return BufferedAggregator(policy or self.program.aggregation)
+
+    # -- codec -----------------------------------------------------------
+    @property
+    def codec(self) -> CodecSpec:
+        return self.program.codec
+
+    def host_codec(self):
+        """The numpy wire twin for this program's spec (None when the
+        codec leg is disabled)."""
+        return self.program.codec.host()
+
+
+__all__ = ["RoundProgram", "HostProgram"]

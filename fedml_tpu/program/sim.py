@@ -1,0 +1,66 @@
+"""Lowering a `RoundProgram` onto the jitted simulation engine.
+
+The sim consumer's half of the program contract: given a program and a
+``(TrainSpec, ClientUpdateConfig)`` pair, produce the compiled round
+function the engine already knows how to run. These builders own the
+ONE decision the program's codec leg implies -- plain vs compressed vs
+sharded lowering -- so ``FedAvgAPI`` (and any future consumer) never
+re-derives it. Everything here imports jax lazily through the engine
+modules; the module itself stays importable host-side.
+"""
+
+from __future__ import annotations
+
+
+def compile_sim(program, spec, cfg, payload_fn=None, server_fn=None,
+                mesh=None, compressed=None, compressor=None):
+    """Program -> compiled simulation round function.
+
+    - ``mesh`` set: the shard_map/psum round (``make_sharded_round``).
+      The codec leg must be disabled -- mesh aggregation is ICI
+      collectives, where the wire bottleneck being compressed does not
+      exist (the caller validates and raises its own message).
+    - codec enabled (or ``compressed=True``): the fused compressed round
+      with per-client error feedback
+      (``compression.make_compressed_sim_round``).
+    - otherwise: the plain vmapped round (``make_sim_round``).
+
+    ``compressed=False`` forces the plain lowering regardless of the
+    codec leg (consumers keep a plain round function alongside the
+    compressed one for eval/A-B paths). ``compressor`` overrides the
+    device compressor instance (defaults to ``program.codec.device()``;
+    callers that already resolved one pass it through so instance-level
+    configuration survives).
+    """
+    if mesh is not None:
+        from fedml_tpu.parallel.engine import make_sharded_round
+        return make_sharded_round(spec, cfg, mesh, payload_fn, server_fn)
+    if compressed is None:
+        compressed = program.codec.enabled
+    if not compressed:
+        from fedml_tpu.parallel.engine import make_sim_round
+        return make_sim_round(spec, cfg, payload_fn, server_fn)
+    from fedml_tpu.compression import make_compressed_sim_round
+    comp = compressor if compressor is not None else program.codec.device()
+    if comp is None:
+        raise ValueError("compile_sim(compressed=True) on a program whose "
+                         "codec leg is disabled")
+    return make_compressed_sim_round(spec, cfg, comp, payload_fn,
+                                     server_fn)
+
+
+def compile_bucketed(program, spec, cfg, payload_fn=None, server_fn=None,
+                     compressor=None, **kwargs):
+    """Program -> :class:`~fedml_tpu.parallel.engine.BucketedStreamRunner`
+    (the unbounded-cohort streaming lowering; composes with the codec leg
+    as streaming-EF). ``kwargs`` pass through to the runner
+    (``client_chunk``, ``batch_size``, ``epochs``, ``edges``).
+    ``compressor`` overrides the device compressor instance exactly as
+    in :func:`compile_sim`."""
+    from fedml_tpu.parallel.engine import BucketedStreamRunner
+    comp = compressor if compressor is not None else program.codec.device()
+    return BucketedStreamRunner(spec, cfg, payload_fn, server_fn,
+                                compressor=comp, **kwargs)
+
+
+__all__ = ["compile_sim", "compile_bucketed"]

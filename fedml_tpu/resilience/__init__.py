@@ -23,6 +23,14 @@ failure a first-class, *testable* event for the control plane:
 - ``integration`` -- wiring into FedAvg-family algorithms, the comm
                      managers, MetricsLogger, and the experiment flags.
 
+Round semantics live OUTSIDE this package: both servers execute a
+:class:`fedml_tpu.program.RoundProgram` through its jax-free
+``host_view()`` (cohort draws, folds, the buffered aggregator), and
+``RoundPolicy`` / ``AsyncAggPolicy`` are aliases of the program's
+cohort/aggregation legs -- see docs/PROGRAM.md. This package owns what
+is genuinely distributed: transports, retries, deadlines as wall-clock
+events, fault injection, steering, recovery.
+
 See docs/RESILIENCE.md for the failure model and determinism contract.
 """
 

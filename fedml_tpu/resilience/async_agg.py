@@ -15,7 +15,7 @@ Design notes, in decreasing order of importance:
 
 - **Determinism over arrival order.** :meth:`BufferedAggregator.flush`
   folds the buffered entries through
-  :func:`~fedml_tpu.resilience.policy.fold_entries_fp64` -- the same
+  :func:`~fedml_tpu.program.aggregation.fold_entries_fp64` -- the same
   sorted-key float64 fold ``aggregate_reports`` uses -- NOT in arrival
   order. Two runs that buffer the same entries flush bitwise-identical
   results no matter how the reports raced. This is also what makes the
@@ -40,6 +40,15 @@ aggregator with PRE-WEIGHTED bucket-chunk partial sums (``preweighted=
 True``): a chunk dispatched at version ``v0`` and folded after later
 flushes is a stale cohort slice, exactly the semantics a real async
 population shows, simulated on one chip.
+
+The aggregation machinery itself now lives in
+:mod:`fedml_tpu.program.aggregation` (the ``RoundProgram`` subsystem's
+aggregation leg): ``AsyncAggPolicy`` is the program's
+``AggregationPolicy`` and ``BufferedAggregator`` / ``staleness_weight``
+/ ``FlushResult`` are re-exported here under their historical names.
+This module keeps the distributed FSM
+(:class:`AsyncBufferedFedAvgServer`), which drives its program's
+jax-free host view for every fold.
 """
 
 from __future__ import annotations
@@ -48,269 +57,29 @@ import dataclasses
 import logging
 import threading
 import time
-from dataclasses import dataclass
 from typing import Optional
 
 import numpy as np
 
-from fedml_tpu.core.locks import audited_lock, audited_rlock
+from fedml_tpu.core.locks import audited_rlock
 from fedml_tpu.core.comm.base import MSG_TYPE_PEER_JOIN, MSG_TYPE_PEER_LOST
 from fedml_tpu.core.message import Message
 from fedml_tpu.core.managers import ServerManager
 from fedml_tpu.compression.wire import (
     WIRE_DELTA_KEY, WIRE_SPEC_KEY, CompressedUpdate)
 from fedml_tpu.observability.perfmon import get_perf_monitor
-from fedml_tpu.observability.registry import get_registry
 from fedml_tpu.observability.tracing import get_tracer
-from fedml_tpu.resilience.policy import (
-    RetryPolicy, fold_entries_fp64, send_with_retry)
+from fedml_tpu.program import RoundProgram
+from fedml_tpu.program.aggregation import (  # noqa: F401 (re-export)
+    AggregationPolicy as AsyncAggPolicy, BufferedAggregator, FlushResult,
+    staleness_weight)
+from fedml_tpu.resilience.policy import RetryPolicy, send_with_retry
 
 # the async server speaks the SAME message schema as the synchronous FSM
 # (ResilientFedAvgClient is reused unchanged); import the types from the
 # integration module so fedcheck's pairing pass sees one vocabulary
 from fedml_tpu.resilience.integration import (  # noqa: F401 (re-export)
     MSG_C2S_REPORT, MSG_S2C_SYNC, ResilientFedAvgClient)
-
-
-@dataclass(frozen=True)
-class AsyncAggPolicy:
-    """Buffered-async aggregation knobs (FedBuff, Nguyen et al. 2022).
-
-    Args:
-      buffer_k: server update every K buffered client updates (FedBuff's
-        K; the flush also fires early when every still-alive client has
-        reported -- a buffer that can never fill must not deadlock).
-      staleness_decay: polynomial staleness exponent ``a``; an update
-        ``s`` versions stale is weighted ``(1 + s) ** -a``. ``0`` weights
-        every update 1 (the oracle setting); ``0.5`` is FedBuff's
-        ``1/sqrt(1+s)``.
-      flush_deadline_s: wall-clock bound from the first fold of a window
-        to its flush; ``0`` disables (flush only on K). The async analog
-        of the synchronous report deadline: a deadline flush below K is
-        counted ``degraded``.
-      async_window: simulation only -- how many in-flight bucket chunks
-        the streaming engine keeps dispatched before folding the oldest
-        (the simulated client concurrency; staleness appears when
-        ``buffer_k`` flushes fall inside the window).
-    """
-
-    buffer_k: int = 64
-    staleness_decay: float = 0.5
-    flush_deadline_s: float = 0.0
-    async_window: int = 4
-
-    @classmethod
-    def from_args(cls, args) -> Optional["AsyncAggPolicy"]:
-        if not int(getattr(args, "async_agg", 0) or 0):
-            return None
-        return cls(
-            buffer_k=int(getattr(args, "buffer_k", 64) or 64),
-            staleness_decay=float(getattr(args, "staleness_decay", 0.5)),
-            flush_deadline_s=float(getattr(args, "flush_deadline", 0.0)
-                                   or 0.0),
-            async_window=int(getattr(args, "async_window", 4) or 4))
-
-
-def staleness_weight(staleness, decay) -> float:
-    """Polynomial staleness discount ``(1 + s) ** -decay`` (monotone
-    non-increasing in ``s``; exactly 1.0 at ``s == 0`` or ``decay == 0``,
-    so the oracle settings multiply by a float64-exact 1.0)."""
-    s = max(0, int(staleness))
-    if s == 0 or decay == 0:
-        return 1.0
-    return float((1.0 + s) ** -float(decay))
-
-
-@dataclass(frozen=True)
-class FlushResult:
-    """One server update produced by :meth:`BufferedAggregator.flush`."""
-
-    params: dict          # f32 pytree (the fold output)
-    weight: float         # renormalization denominator (post-staleness)
-    version: int          # server version AFTER this flush
-    contributors: tuple   # entry keys folded (ranks / chunk ordinals)
-    clients: int          # client updates represented by those entries
-    reason: str           # "buffer_k" | "deadline" | "drain" | "peer_lost"
-    max_staleness: int
-
-
-class BufferedAggregator:
-    """Thread-safe staleness-weighted update buffer with K/deadline flush.
-
-    ``fold`` accepts either per-client reports (``weight`` = the client's
-    sample count, payload = its params) or pre-weighted partial sums from
-    the streaming engine (``preweighted=True``: payload is already
-    ``sum_i n_i * p_i`` over ``clients`` members, ``weight`` their
-    ``sum_i n_i``). Entries are retained until ``flush`` folds them in
-    sorted-key order through :func:`fold_entries_fp64` -- memory is
-    O(buffer_k) payloads and the flushed bytes are arrival-order
-    independent. Re-folding an existing key overwrites (newest wins --
-    the older update trained on strictly staler params) and is counted.
-    """
-
-    def __init__(self, policy: AsyncAggPolicy):
-        self.policy = policy
-        self._lock = audited_lock()
-        self._entries = {}        # key -> (weight, payload, scale)
-        self._entry_clients = {}  # key -> client count
-        self._entry_staleness = {}
-        self.version = 0
-        self.counters = {"folds": 0, "flushes": 0, "drain_flushes": 0,
-                         "deadline_flushes": 0, "overwrites": 0,
-                         "clients_folded": 0, "max_staleness": 0,
-                         "depth_peak": 0}
-
-    @property
-    def depth(self) -> int:
-        """Distinct buffered entries (the ``fed_buffer_depth`` gauge)."""
-        with self._lock:
-            return len(self._entries)
-
-    def clients_buffered(self) -> int:
-        with self._lock:
-            return sum(self._entry_clients.values())
-
-    def fold(self, key, weight, payload, staleness=0, clients=1,
-             preweighted=False) -> int:
-        """Buffer one update; returns the post-fold distinct-entry depth.
-
-        ``staleness`` = server versions elapsed since the update's model
-        was issued (``version_now - version_born``); the entry's weight
-        (and, for pre-weighted partials, its numerator scale) is
-        multiplied by :func:`staleness_weight`.
-        """
-        with get_tracer().span("buffer-fold", staleness=int(staleness),
-                               clients=int(clients)) as sp:
-            with self._lock:
-                depth = self._fold_locked(key, weight, payload, staleness,
-                                          clients, preweighted)
-            sp.set(depth=depth)
-        self._note_fold(staleness, depth)
-        return depth
-
-    def _fold_locked(self, key, weight, payload, staleness, clients,
-                     preweighted):
-        """One entry into the buffer; callers hold ``_lock``."""
-        sw = staleness_weight(staleness, self.policy.staleness_decay)
-        w = float(weight) * sw
-        scale = sw if preweighted else w
-        if key in self._entries:
-            self.counters["overwrites"] += 1
-        else:
-            self.counters["clients_folded"] += int(clients)
-        self._entries[key] = (w, payload, scale)
-        self._entry_clients[key] = int(clients)
-        self._entry_staleness[key] = int(staleness)
-        self.counters["folds"] += 1
-        self.counters["max_staleness"] = max(
-            self.counters["max_staleness"], int(staleness))
-        depth = len(self._entries)
-        self.counters["depth_peak"] = max(
-            self.counters["depth_peak"], depth)
-        return depth
-
-    def _note_fold(self, staleness, depth):
-        reg = get_registry()
-        if reg is not None:
-            reg.set_gauge("fed_buffer_depth", depth,
-                          help="distinct updates buffered awaiting flush")
-            reg.set_gauge("fed_update_staleness", int(staleness),
-                          help="staleness (server versions) of the last "
-                               "folded update")
-        mon = get_perf_monitor()
-        if mon is not None:
-            # the histogram complement of the point gauges above (pace
-            # steering reads distributions, not last values)
-            mon.observe_fold(staleness, depth)
-
-    def fold_many(self, entries, ready_target=None):
-        """Batched-entry fold: buffer ``entries`` (a list of ``(key,
-        weight, payload, staleness)`` per-client reports) under ONE lock
-        acquisition, stopping after the entry that brings the buffered
-        client count to the flush threshold (``buffer_k`` capped by
-        ``ready_target``, exactly :meth:`ready`'s rule). Returns
-        ``(consumed, depth)``: the caller flushes and re-enters with the
-        remainder. Fold order is the list order, the flush boundary is
-        the same entry it would be folding one at a time, and
-        :meth:`flush` sorts by key anyway -- so a chunk of reports costs
-        one lock acquisition per flush window instead of one per report
-        while staying bitwise-identical to the per-report path (pinned
-        in tests/test_async_agg.py)."""
-        k = self.policy.buffer_k
-        if ready_target is not None:
-            k = min(k, int(ready_target))
-        k = max(1, k)
-        consumed = 0
-        depth = 0
-        noted = []
-        with get_tracer().span("buffer-fold", batch=len(entries)) as sp:
-            with self._lock:
-                for key, weight, payload, staleness in entries:
-                    depth = self._fold_locked(key, weight, payload,
-                                              staleness, 1, False)
-                    noted.append((staleness, depth))
-                    consumed += 1
-                    if sum(self._entry_clients.values()) >= k:
-                        break
-            sp.set(depth=depth, consumed=consumed)
-        for staleness, d in noted:
-            self._note_fold(staleness, d)
-        return consumed, depth
-
-    def ready(self, target=None) -> bool:
-        """True when the buffered client count reaches ``buffer_k`` --
-        capped by ``target`` (e.g. the number of still-alive clients)
-        so a buffer that can never fill does not deadlock the plane."""
-        k = self.policy.buffer_k
-        if target is not None:
-            k = min(k, int(target))
-        with self._lock:
-            return sum(self._entry_clients.values()) >= max(1, k)
-
-    def flush(self, reason="buffer_k") -> FlushResult:
-        """Fold + clear the buffer, bump the server version."""
-        with self._lock:
-            if not self._entries:
-                raise ValueError("flush of an empty update buffer")
-            entries = [(k, w, p, s)
-                       for k, (w, p, s) in self._entries.items()]
-            clients = sum(self._entry_clients.values())
-            max_stale = max(self._entry_staleness.values())
-            self._entries = {}
-            self._entry_clients = {}
-            self._entry_staleness = {}
-            self.version += 1
-            version = self.version
-            self.counters["flushes"] += 1
-            if reason == "deadline":
-                self.counters["deadline_flushes"] += 1
-            elif reason == "drain":
-                self.counters["drain_flushes"] += 1
-        with get_tracer().span("buffer-flush", reason=reason,
-                               entries=len(entries), clients=clients,
-                               version=version):
-            params, weight = fold_entries_fp64(entries)
-        reg = get_registry()
-        if reg is not None:
-            reg.set_gauge("fed_buffer_depth", 0,
-                          help="distinct updates buffered awaiting flush")
-            reg.inc("fed_buffer_flushes_total",
-                    help="server updates produced by the async buffer",
-                    reason=reason)
-        return FlushResult(params=params, weight=weight, version=version,
-                           contributors=tuple(k for k, _, _, _ in entries),
-                           clients=clients, reason=reason,
-                           max_staleness=max_stale)
-
-    def record(self, prefix="async/") -> dict:
-        """Cumulative counters as a metrics-record fragment (rides every
-        round record on async runs -- the buffer-depth/staleness series
-        lands in metrics.jsonl even with observability off)."""
-        with self._lock:
-            out = {prefix + k: v for k, v in self.counters.items()}
-            out[prefix + "version"] = self.version
-            out[prefix + "buffer_depth"] = len(self._entries)
-        return out
 
 
 def add_async_args(parser):
@@ -386,7 +155,14 @@ class AsyncBufferedFedAvgServer(ServerManager):
         self.async_policy = async_policy
         self.retry_policy = retry_policy or RetryPolicy()
         self.metrics_logger = metrics_logger
-        self.agg = BufferedAggregator(async_policy)
+        # the ONE RoundProgram this server executes: the caller's policy
+        # is the program's aggregation leg, and the buffered aggregator
+        # plus every fold go through its jax-free host view (the sim
+        # engine lowers the same program via compile_sim -- the
+        # conformance suite pins the two consumers equal)
+        self.program = RoundProgram(aggregation=async_policy)
+        self._host = self.program.host_view()
+        self.agg = self._host.make_aggregator()
         self.alive = set(range(1, size))
         self.failed = None
         self.history = []     # params after each flush
@@ -793,6 +569,12 @@ class AsyncBufferedFedAvgServer(ServerManager):
             self.async_policy = dataclasses.replace(
                 self.async_policy, buffer_k=dec.buffer_k,
                 flush_deadline_s=dec.flush_deadline_s)
+            # the program IS the round definition: steering evolves it
+            # (pure-data replace) so program/host-view readers stay
+            # coherent with the live knobs
+            self.program = self.program.replace(
+                aggregation=self.async_policy)
+            self._host = self.program.host_view()
             self.agg.policy = self.async_policy
             logging.info("async server: pace steering -> buffer_k %d, "
                          "flush deadline %.3fs (%s)", dec.buffer_k,

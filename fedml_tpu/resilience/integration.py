@@ -40,9 +40,12 @@ from fedml_tpu.compression.wire import (
     host_compressor)
 from fedml_tpu.observability.perfmon import get_perf_monitor
 from fedml_tpu.observability.tracing import get_tracer
+from fedml_tpu.program import RoundProgram
+from fedml_tpu.program.cohort import CohortPolicy, client_sampling
+from fedml_tpu.program.cohort import sample_ranks as _program_sample_ranks
 from fedml_tpu.resilience.policy import (
     ROUND_DEGRADED, RetryPolicy, RoundController, RoundPolicy,
-    aggregate_reports, send_with_retry)
+    send_with_retry)
 
 MSG_S2C_SYNC = "res_sync"        # server -> client: params, round, attempt
 MSG_C2S_REPORT = "res_report"    # client -> server: params (plain) OR
@@ -122,8 +125,8 @@ class SimResilience:
         sp = float(getattr(args, "straggler_p", 0.0) or 0.0)
         if over <= 0 and sp <= 0:
             return None
-        policy = RoundPolicy(overselect=over,
-                             quorum=float(getattr(args, "quorum", 0.5)))
+        policy = CohortPolicy(overselect=over,
+                              quorum=float(getattr(args, "quorum", 0.5)))
         return cls(policy, straggler_p=sp,
                    seed=int(getattr(args, "seed", 0)))
 
@@ -148,8 +151,6 @@ class SimResilience:
         return bool(rng.random() < self.straggler_p)
 
     def _sample(self, round_idx, client_num_in_total, client_num_per_round):
-        from fedml_tpu.algorithms.fedavg import client_sampling
-
         target = min(client_num_per_round, client_num_in_total)
         for attempt in range(self.policy.max_round_retries + 1):
             selected = client_sampling(
@@ -323,6 +324,14 @@ class ResilientFedAvgServer(ServerManager):
         super().__init__(args, comm, rank=0, size=size)
         self.params = {k: np.asarray(v) for k, v in init_params.items()}
         self.rounds = int(rounds)
+        # the ONE RoundProgram this server executes: the caller's policy
+        # is the program's cohort leg, and every cohort draw / report
+        # fold goes through its jax-free host view (the sim engine
+        # lowers the same program via compile_sim -- the conformance
+        # suite pins the two consumers equal). round_policy stays the
+        # live steered attribute; _steer_locked re-replaces the program.
+        self.program = RoundProgram(cohort=round_policy)
+        self._host = self.program.host_view()
         self.round_policy = round_policy
         self.retry_policy = retry_policy or RetryPolicy()
         self.cohort_target = cohort_target
@@ -432,9 +441,9 @@ class ResilientFedAvgServer(ServerManager):
             cohort = list(self.cohort_override(self.round_idx, self.attempt))
             target = min(target, len(cohort))
         else:
-            cohort = _sample_ranks(self.round_idx, self.attempt, alive,
-                                   self.round_policy.select_count(
-                                       target, len(alive)))
+            cohort = self._host.sample_ranks(
+                self.round_idx, self.attempt, alive,
+                self._host.select_count(target, len(alive)))
         self._last_selected = len(cohort)
         self._last_target = target
         self._controller.begin(self.round_idx, self.attempt, cohort, target)
@@ -547,7 +556,7 @@ class ResilientFedAvgServer(ServerManager):
                     "aggregate",
                     parent=None if rspan is None else rspan.context,
                     reports=len(reports)):
-                self.params, _total = aggregate_reports(reports)
+                self.params, _total = self._host.fold_reports(reports)
             if rspan is not None:
                 rspan.set(outcome=outcome, reports=len(reports)).end()
             self.history.append(dict(self.params))
@@ -623,6 +632,11 @@ class ResilientFedAvgServer(ServerManager):
             self.round_policy = dataclasses.replace(
                 self.round_policy, deadline_s=dec.deadline_s,
                 overselect=dec.overselect)
+            # the program IS the round definition: steering evolves it
+            # (pure-data replace) so host-view cohort math reads the
+            # live knobs, not the ones the server was constructed with
+            self.program = self.program.replace(cohort=self.round_policy)
+            self._host = self.program.host_view()
             self._controller.policy = self.round_policy
             logging.info("server: pace steering -> deadline %.3fs, "
                          "overselect %.3f (%s)", dec.deadline_s,
@@ -710,17 +724,12 @@ class ResilientFedAvgServer(ServerManager):
 
 def _sample_ranks(round_idx, attempt, ranks, k):
     """Seeded-by-(round, attempt) cohort over explicit rank ids -- the
-    distributed analog of ``algorithms.fedavg.client_sampling``, sharing
-    its :func:`~fedml_tpu.algorithms.fedavg.attempt_seed` fold so both
-    paths draw agreeing cohorts for the same (round, attempt)."""
-    from fedml_tpu.algorithms.fedavg import attempt_seed
-
-    ranks = sorted(int(r) for r in ranks)
-    if k >= len(ranks):
-        return list(ranks)
-    np.random.seed(attempt_seed(round_idx, attempt))
-    return sorted(int(r) for r in
-                  np.random.choice(ranks, k, replace=False))
+    program's :func:`~fedml_tpu.program.cohort.sample_ranks` under its
+    historical name (kept for callers/tests that import it from here).
+    Shares the :func:`~fedml_tpu.program.cohort.attempt_seed` fold with
+    ``client_sampling`` so both paths draw agreeing cohorts for the same
+    (round, attempt)."""
+    return _program_sample_ranks(round_idx, attempt, ranks, k)
 
 
 def quadratic_trainer(lr=0.25):

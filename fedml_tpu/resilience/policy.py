@@ -22,24 +22,32 @@ Aggregation over the reporting subset renormalizes by construction:
 :func:`aggregate_reports` divides by the *reporting* clients' sample total,
 never the selected cohort's, so a dropped client shifts weight to its
 surviving peers instead of biasing the average toward zero.
+
+The policy/fold primitives themselves now live in
+:mod:`fedml_tpu.program` (the one ``RoundProgram`` subsystem behind both
+paradigms): ``RoundPolicy`` is the program's
+:class:`~fedml_tpu.program.cohort.CohortPolicy` and
+``fold_entries_fp64`` / ``aggregate_reports`` are the program's
+aggregation leg, re-exported here under their historical names. This
+module keeps what is genuinely control-plane: the retry/backoff layer
+and the deadline-driven :class:`RoundController`.
 """
 
 from __future__ import annotations
 
 import logging
-import math
 import threading
 import time
 from dataclasses import dataclass
-from typing import Optional
 
-import numpy as np
-
-from fedml_tpu.core.locks import audited_lock
 from fedml_tpu.core.comm.base import MSG_TYPE_PEER_LOST
+from fedml_tpu.core.locks import audited_lock
 from fedml_tpu.core.message import Message
 from fedml_tpu.observability.flightrec import get_flight_recorder
 from fedml_tpu.observability.registry import get_registry
+from fedml_tpu.program.aggregation import (  # noqa: F401 (re-export)
+    aggregate_reports, fold_entries_fp64)
+from fedml_tpu.program.cohort import CohortPolicy as RoundPolicy
 
 
 class PeerUnreachableError(ConnectionError):
@@ -128,33 +136,6 @@ def _dispatch_peer_lost(comm, receiver):
     lost = Message(MSG_TYPE_PEER_LOST, receiver, getattr(comm, "rank", 0))
     for obs in list(getattr(comm, "_observers", [])):
         obs.receive_message(MSG_TYPE_PEER_LOST, lost)
-
-
-@dataclass(frozen=True)
-class RoundPolicy:
-    """Server-side round knobs (Bonawitz §3 pace steering).
-
-    Args:
-      deadline_s: report deadline per round attempt; 0 disables the timer
-        (the round completes only when ``target`` reports arrive).
-      overselect: eps in ``select ceil((1+eps) * C)``.
-      quorum: minimum reporting fraction of the aggregation target C for a
-        deadline round to complete (degraded); below it the round is
-        abandoned and re-run.
-      max_round_retries: abandoned-round re-runs before giving up.
-    """
-
-    deadline_s: float = 0.0
-    overselect: float = 0.0
-    quorum: float = 0.5
-    max_round_retries: int = 3
-
-    def select_count(self, target: int, available: Optional[int] = None) -> int:
-        n = int(math.ceil((1.0 + self.overselect) * target))
-        return n if available is None else min(n, available)
-
-    def quorum_count(self, target: int) -> int:
-        return max(1, int(math.ceil(self.quorum * target)))
 
 
 #: RoundController outcomes.
@@ -304,106 +285,6 @@ class RoundController:
                 self._timer.cancel()
                 self._timer = None
             self._decided = True
-
-
-def fold_entries_fp64(entries) -> tuple:
-    """THE canonical weighted fold: sorted-key, float64, normalize-late.
-
-    ``entries``: iterable of ``(sort_key, weight, payload_pytree, scale)``
-    where the entry contributes ``float64(payload) * scale`` to the
-    numerator and ``weight`` to the denominator. Per-client reports use
-    ``scale == weight == n_i`` (a plain weighted average); the bucketed
-    streaming engine feeds PRE-WEIGHTED partial sums with
-    ``scale == staleness_weight`` and ``weight == w_sum * staleness_weight``.
-
-    A payload may also be a
-    :class:`~fedml_tpu.compression.wire.CompressedUpdate` (a compressed
-    report's encoded delta + the base params it is relative to): its
-    logical contribution is ``scale * float64(base + decoded_delta)``,
-    folded WITHOUT densifying per report -- the decoded delta
-    accumulates sparsely/quantized (O(k) for a topk report) in sorted
-    entry order, and each DISTINCT base is added exactly once, scaled by
-    the sum of its entries' scales, in sorted ``base_key`` order. The
-    fold stays arrival-order independent; what "bitwise" means under
-    lossy compression is pinned in docs/COMPRESSION.md ("Distributed
-    wire path"): the compressed fold is its own canonical f64 order --
-    NOT bit-equal to reconstructing each report in f32 first -- and the
-    async oracle (decay 0) still equals the synchronous compressed fold
-    bit for bit, because both run this exact function over the same
-    entries.
-
-    Returns ``(params_f32, weight_total)``. Folding in sorted-key order
-    (never arrival order) is what makes the result bitwise deterministic:
-    :class:`~fedml_tpu.resilience.async_agg.BufferedAggregator` flushes
-    through this exact function, so the async path with staleness weight 1
-    and one flush reproduces :func:`aggregate_reports` bit-for-bit no
-    matter which order the reports raced in.
-    """
-    import jax
-
-    from fedml_tpu.compression.wire import CompressedUpdate
-
-    entries = sorted(entries, key=lambda e: e[0])
-    if not entries:
-        raise ValueError("weighted fold over an empty entry set "
-                         "(abandon/skip instead)")
-    total = 0.0
-    acc = None          # dense contributions (f64 pytree)
-    cacc = None         # compressed-delta contributions ({name: f64})
-    base_acc = {}       # base_key -> [scale_sum, base params]
-    for _key, weight, payload, scale in entries:
-        total += float(weight)
-        if isinstance(payload, CompressedUpdate):
-            cacc = payload.fold_delta(cacc, float(scale))
-            slot = base_acc.setdefault(payload.base_key,
-                                       [0.0, payload.base])
-            slot[0] += float(scale)
-            continue
-        contrib = jax.tree.map(
-            lambda x: np.asarray(x, np.float64) * float(scale), payload)
-        acc = contrib if acc is None else jax.tree.map(np.add, acc, contrib)
-    # canonical combine order: dense entries (sorted), then each distinct
-    # base (sorted by key), then the sparse delta accumulator
-    for bk in sorted(base_acc):
-        scale_sum, base = base_acc[bk]
-        bcontrib = jax.tree.map(
-            lambda x: np.asarray(x, np.float64) * float(scale_sum), base)
-        acc = bcontrib if acc is None else jax.tree.map(np.add, acc,
-                                                        bcontrib)
-    if cacc is not None:
-        acc = cacc if acc is None else jax.tree.map(np.add, acc, cacc)
-    if total <= 0:
-        raise ValueError("weighted fold has zero total weight")
-    return jax.tree.map(lambda x: (x / total).astype(np.float32), acc), total
-
-
-def aggregate_reports(reports) -> tuple:
-    """Weighted average over the *reporting* subset, renormalized.
-
-    ``reports``: ``{rank: (num_samples, params_pytree)}`` (numpy leaves --
-    this is the host-side control plane). Returns ``(params, total_n)``.
-    Delegates to :func:`fold_entries_fp64` -- sorted-rank float64 fold, so
-    two runs over the same subset are bitwise identical (the chaos smoke's
-    A/B oracle) AND the buffered async aggregator (which flushes through
-    the same fold) matches it bit-for-bit under the oracle settings.
-    Weights divide by the reporters' sample total -- never the selected
-    cohort's -- so a dropped client renormalizes instead of zero-biasing;
-    an empty subset fails fast (parity with the engine's empty-cohort
-    guard, ``engine.py:325``).
-    """
-    if not reports:
-        raise ValueError("aggregate_reports over an empty reporting subset "
-                         "(abandon the round instead)")
-    # sorted-rank order for the guard sum too: the returned total must be
-    # arrival-order deterministic, exactly like the fold's denominator
-    total = float(sum(float(reports[r][0]) for r in sorted(reports)))
-    if total <= 0:
-        raise ValueError("reporting subset has zero total samples")
-    params, fold_total = fold_entries_fp64(
-        (r, float(n), payload, float(n))
-        for r, (n, payload) in reports.items())
-    assert fold_total == total  # same addends, same (sorted) order
-    return params, total
 
 
 __all__ = ["RetryPolicy", "RoundPolicy", "RoundController",

@@ -1933,8 +1933,9 @@ class TestCrossClass:
         # (line shifts when integration.py grows above __init__; PR 11
         # moved it 307 -> 321 adding the --transport flag, PR 13 moved
         # it 321 -> 333 adding the pace-steering/rejoin state, PR 15
-        # moved it 333 -> 374 adding the wire-compression client half)
-        assert "integration.py:374" in msg
+        # moved it 333 -> 374 adding the wire-compression client half,
+        # PR 16 moved it 374 -> 383 wiring the server onto RoundProgram)
+        assert "integration.py:383" in msg
         assert "_send_frame" in msg and "TcpCommManager" in msg
 
 
@@ -3074,3 +3075,89 @@ class TestContainerElementTyping:
         msg = found[0].message
         assert "element of `self._observers`" in msg
         assert "EventLoopCommManager._dispatch_batch" in msg
+
+
+class TestParadigmBypass:
+    """FL130: round machinery constructed outside fedml_tpu/program/.
+
+    ISSUE 16 fixture: the RoundProgram subsystem made cohort/aggregation/
+    codec logic single-home; this rule is the regression fence. The legacy
+    spellings (RoundPolicy/AsyncAggPolicy ctors, raw fold_entries_fp64
+    calls) flag anywhere but the program package; the program's own
+    vocabulary never does."""
+
+    def test_legacy_spellings_flagged(self):
+        src = (
+            "from fedml_tpu.resilience.policy import (RoundPolicy,\n"
+            "                                         fold_entries_fp64)\n"
+            "from fedml_tpu.resilience.async_agg import AsyncAggPolicy\n"
+            "def f(entries):\n"
+            "    pol = RoundPolicy(deadline_s=1.0)\n"
+            "    apol = AsyncAggPolicy(buffer_k=4)\n"
+            "    return fold_entries_fp64(entries), pol, apol\n")
+        found = [f for f in lint_source(src, path=LIB_PATH)
+                 if f.code == "FL130"]
+        assert len(found) == 3, found
+        assert "RoundProgram" in found[0].message
+        assert "host_view" in found[0].message
+
+    def test_dotted_call_flagged(self):
+        # the name is matched on the trailing attribute, so a re-exported
+        # module-dotted call is still a bypass
+        src = (
+            "from fedml_tpu.resilience import policy\n"
+            "def f(entries):\n"
+            "    return policy.fold_entries_fp64(entries)\n")
+        assert [f.code for f in lint_source(src, path=LIB_PATH)
+                if f.code == "FL130"] == ["FL130"]
+
+    def test_program_package_exempt(self):
+        # inside fedml_tpu/program/ constructing the legs IS the job
+        src = (
+            "def f(entries):\n"
+            "    return fold_entries_fp64(entries)\n")
+        assert [f.code for f in
+                lint_source(src, path="fedml_tpu/program/aggregation.py")
+                if f.code == "FL130"] == []
+
+    def test_program_vocabulary_clean(self):
+        # the blessed spellings: program-leg ctors, classmethod
+        # constructors, dataclasses.replace evolution, host-view folds
+        src = (
+            "import dataclasses\n"
+            "from fedml_tpu.program import (AggregationPolicy, CohortPolicy,\n"
+            "                               RoundProgram)\n"
+            "from fedml_tpu.resilience.async_agg import AsyncAggPolicy\n"
+            "def f(args, reports):\n"
+            "    prog = RoundProgram(cohort=CohortPolicy(overselect=0.2),\n"
+            "                        aggregation=AggregationPolicy(buffer_k=8))\n"
+            "    prog = prog.replace(\n"
+            "        cohort=dataclasses.replace(prog.cohort, quorum=0.6))\n"
+            "    apol = AsyncAggPolicy.from_args(args)\n"
+            "    host = prog.host_view()\n"
+            "    return host.fold_reports(reports), apol\n")
+        assert [f.code for f in lint_source(src, path=LIB_PATH)
+                if f.code == "FL130"] == []
+
+    def test_alias_assignment_clean(self):
+        # `RoundPolicy = CohortPolicy` (the shims' compatibility alias)
+        # is an assignment, not a construction
+        src = (
+            "from fedml_tpu.program.cohort import CohortPolicy\n"
+            "RoundPolicy = CohortPolicy\n")
+        assert [f.code for f in lint_source(src, path=LIB_PATH)
+                if f.code == "FL130"] == []
+
+    def test_post_refactor_consumers_pinned_zero(self):
+        # the tentpole's acceptance: both paradigms' consumer modules
+        # drive the ONE program -- no legacy construction survives
+        for rel in ("fedml_tpu/resilience/integration.py",
+                    "fedml_tpu/resilience/async_agg.py",
+                    "fedml_tpu/resilience/policy.py",
+                    "fedml_tpu/net/fanin.py",
+                    "fedml_tpu/net/soak.py",
+                    "fedml_tpu/algorithms/fedavg.py"):
+            with open(os.path.join(REPO_ROOT, rel), encoding="utf-8") as fh:
+                src = fh.read()
+            assert [f for f in lint_source(src, path=rel)
+                    if f.code == "FL130"] == [], rel

@@ -1,0 +1,165 @@
+"""Codec-twin drift gate (ISSUE 16 satellite).
+
+Every codec a :class:`~fedml_tpu.program.codec.CodecSpec` can name exists
+twice by design: the jit lowering (``compression/compressors.py``) and
+the numpy wire twin (``compression/wire.py``). This gate
+fuzzes the exhaustive spec table (:func:`fedml_tpu.program.codec.
+wire_codecs`) across the pair and pins every deterministic surface
+byte-equal, so a codec change cannot ship one-sided:
+
+- **registry exhaustiveness** -- the program's table, the wire registry,
+  and the device registry name the same wire-capable families; a codec
+  added to one without the others fails here, not in production;
+- **topk** -- decoded reconstructions byte-equal (selection, kept
+  values, and zeros all deterministic on both lowerings);
+- **signsgd** -- the sign bitmap byte-equal; the mean-|x| scale equal to
+  reduction-order ulp (jnp.mean vs np.mean associate differently);
+- **qsgd** -- the fp32 scale byte-equal, the wire's sub-byte code
+  packing a bitwise inverse over the device's code alphabet, and decode
+  of identical (codes, scale) equal to association-order ulp. The
+  stochastic rounding itself is rng-stream-specific per lowering
+  (jax.random vs np.random) and deliberately NOT pinned -- unbiasedness,
+  not the noise draw, is the contract (see compression/wire.py).
+"""
+
+import numpy as np
+import pytest
+
+from fedml_tpu.compression.compressors import get_compressor
+from fedml_tpu.compression.wire import (_HOST_REGISTRY, host_compressor,
+                                        pack_codes, unpack_codes)
+from fedml_tpu.program.codec import (CodecSpec, WIRE_CODEC_NAMES,
+                                     wire_codecs)
+
+jax = pytest.importorskip("jax")
+
+
+def _fuzz_leaves(seed, n=8):
+    """Distinct-magnitude float32 leaves (no |x| ties: tie-breaking
+    between lax.top_k and argpartition is the one legitimate
+    divergence, and real gradients never tie exactly)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        size = int(rng.integers(5, 3000))
+        x = rng.standard_normal(size).astype(np.float32)
+        mags = np.unique(np.abs(x))
+        if len(mags) < size:  # regenerate the rare collision away
+            x += rng.standard_normal(size).astype(np.float32) * 1e-4
+        out.append(x)
+    return out
+
+
+class TestRegistryExhaustiveness:
+    def test_table_covers_host_registry_exactly(self):
+        # the program's table IS the drift-gate domain: every wire
+        # family appears, and no family hides outside it
+        families = {s.partition(":")[0] for s in wire_codecs()}
+        assert families == set(_HOST_REGISTRY)
+        assert families == set(WIRE_CODEC_NAMES)
+
+    @pytest.mark.parametrize("spec", wire_codecs())
+    def test_every_spec_constructs_on_both_lowerings(self, spec):
+        cs = CodecSpec(spec)
+        host, dev = cs.host(), cs.device()
+        assert host is not None and dev is not None
+        assert host.name == dev.name == cs.name
+
+    @pytest.mark.parametrize("spec", wire_codecs())
+    def test_ef_class_policy_is_a_class_property(self, spec):
+        # EF rides the codec family: biased contractions run feedback,
+        # the unbiased quantizer must not (the measured amplifier)
+        cs = CodecSpec(spec)
+        assert cs.host_ef() == (cs.name in ("topk", "signsgd"))
+
+    def test_randk_is_sim_only(self):
+        # the one device codec deliberately absent from the wire: it
+        # must stay constructible on device and rejected by the twin
+        assert get_compressor("randk:0.1") is not None
+        with pytest.raises(ValueError, match="randk"):
+            host_compressor("randk:0.1")
+        assert "randk" not in {s.partition(":")[0] for s in wire_codecs()}
+
+    def test_bare_qsgd_divergence_is_pinned(self):
+        # the ONE documented spec divergence: bare qsgd is ternary on
+        # the wire (sub-byte packing buys bytes) and int8 on device
+        # (storage is 1 byte/code regardless). Anything else drifting
+        # here is a bug, so pin both defaults.
+        assert host_compressor("qsgd").bits == 2
+        assert get_compressor("qsgd").bits == 8
+
+
+class TestTwinByteParity:
+    @pytest.mark.parametrize("ratio", [0.01, 0.25, 1.0])
+    def test_topk_decode_byte_equal(self, ratio):
+        dev = get_compressor(f"topk:{ratio}")
+        host = host_compressor(f"topk:{ratio}")
+        for i, x in enumerate(_fuzz_leaves(int(ratio * 100))):
+            de = np.asarray(dev.decode(dev.encode(x, None),
+                                       x.shape, x.dtype))
+            he = host.decode_leaf(host.encode_leaf(x, None))
+            np.testing.assert_array_equal(de, he,
+                                          err_msg=f"leaf {i} r={ratio}")
+            # and the kept coordinate SETS agree (stronger than the
+            # dense equality alone when values happen to be zero)
+            denc = dev.encode(x, None)
+            henc = host.encode_leaf(x, None)
+            assert (set(np.asarray(denc["indices"]).tolist())
+                    == set(np.asarray(henc["indices"]).tolist()))
+
+    def test_signsgd_sign_bitmap_byte_equal(self):
+        dev = get_compressor("signsgd")
+        host = host_compressor("signsgd")
+        for x in _fuzz_leaves(7):
+            denc, henc = dev.encode(x, None), host.encode_leaf(x, None)
+            np.testing.assert_array_equal(np.asarray(denc["sign"]),
+                                          henc["sign"])
+            # scale: same mean-|x| up to reduction-order ulp
+            np.testing.assert_array_max_ulp(
+                np.float32(denc["scale"]), np.float32(henc["scale"]),
+                maxulp=4)
+            dd = np.asarray(dev.decode(denc, x.shape, x.dtype))
+            hd = host.decode_leaf(henc)
+            np.testing.assert_array_max_ulp(dd, hd, maxulp=4)
+
+    @pytest.mark.parametrize("bits", [2, 4, 8])
+    def test_qsgd_scale_byte_equal_and_grid_shared(self, bits):
+        dev = get_compressor(f"qsgd:{bits}")
+        host = host_compressor(f"qsgd:{bits}")
+        assert dev.levels == host.levels  # the quantization alphabet
+        for t, x in enumerate(_fuzz_leaves(bits)):
+            denc = dev.encode(x, jax.random.PRNGKey(t))
+            assert np.float32(denc["scale"]) == np.float32(
+                np.max(np.abs(x)))
+            henc = host.encode_leaf(
+                x, np.random.default_rng((0x5EED, t)))
+            assert np.float32(henc["scale"]) == np.float32(denc["scale"])
+
+    @pytest.mark.parametrize("bits", [2, 4, 8])
+    def test_qsgd_wire_packing_inverts_device_codes(self, bits):
+        # the wire's sub-byte packing must be a bitwise inverse over
+        # exactly the codes the device emits -- THE surface where a
+        # one-sided alphabet change (levels, signedness, bit order)
+        # would corrupt every cross-lowering report
+        dev = get_compressor(f"qsgd:{bits}")
+        for t, x in enumerate(_fuzz_leaves(100 + bits)):
+            q = np.asarray(dev.encode(x, jax.random.PRNGKey(t))["q"])
+            rt = unpack_codes(pack_codes(q, bits), q.size, bits)
+            np.testing.assert_array_equal(q, rt)
+
+    @pytest.mark.parametrize("bits", [2, 4, 8])
+    def test_qsgd_decode_of_shared_codes(self, bits):
+        # identical (codes, scale) must reconstruct the same update on
+        # both lowerings, up to association-order ulp (q*scale/L vs
+        # q*(scale/L))
+        dev = get_compressor(f"qsgd:{bits}")
+        host = host_compressor(f"qsgd:{bits}")
+        for t, x in enumerate(_fuzz_leaves(200 + bits)):
+            denc = dev.encode(x, jax.random.PRNGKey(t))
+            q = np.asarray(denc["q"])
+            henc = {"qp": pack_codes(q, bits),
+                    "scale": np.float32(denc["scale"]), "bits": bits,
+                    "shape": list(x.shape), "dtype": "float32"}
+            dd = np.asarray(dev.decode(denc, x.shape, x.dtype))
+            hd = host.decode_leaf(henc)
+            np.testing.assert_array_max_ulp(dd, hd, maxulp=4)
