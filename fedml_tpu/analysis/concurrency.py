@@ -49,6 +49,17 @@ Rules:
   deliberately not in this rule's blocking set; bare ``recv``,
   ``sendall``, joins, sleeps, and the transport-level send entry points
   are never legal on a loop thread.
+- **FL136** -- FL129's write-path complement, the two loop-callback
+  hazards that block *nothing* yet still take the transport down: a
+  ``while`` loop that makes no calls and cannot make progress locally
+  (no name in its test is assigned in its body) spins the loop thread
+  at 100% polling cross-thread state; a buffer append/extend/``+=``
+  growth whose attribute no Compare or ``len()`` check anywhere in the
+  class bounds lets one slow peer absorb the process heap. The eventloop
+  transport's ``tx_bytes``/``high_watermark`` pair with a congestion
+  gate is the reference shape (``fedml_tpu/net/eventloop.py``); a growth
+  site whose attribute shares a name-prefix with any checked attribute
+  (``tx``/``tx_bytes``) counts as bounded.
 """
 
 from __future__ import annotations
@@ -527,6 +538,129 @@ class _EventLoopChecker:
                          "transport. Use non-blocking socket ops "
                          "(recv_into/send on a ready fd) or queue the "
                          "work to the dispatcher thread")
+        # FL136: the write-path complement -- hazards that never block
+        # yet still take the loop down
+        checked = _checked_attrs(self.cls)
+        for name in sorted(reach):
+            for loop in _busy_loops(self.methods[name]):
+                self.add(loop, "FL136",
+                         f"busy loop in event-loop callback path "
+                         f"`{self.cls.name}.{name}` -- the body makes no "
+                         "calls and no name in the test is assigned in "
+                         "the body, so the loop spins the loop thread at "
+                         "100% polling state only another thread can "
+                         "change. Wait on the selector (register the "
+                         "condition as an event) or queue the work to "
+                         "the dispatcher thread")
+            for attr, site in _growth_sites(self.methods[name]):
+                if any(c.startswith(attr) or attr.startswith(c)
+                       for c in checked):
+                    continue
+                self.add(site, "FL136",
+                         f"unbounded growth of `.{attr}` in event-loop "
+                         f"callback path `{self.cls.name}.{name}` -- "
+                         "nothing in the class compares its length or a "
+                         "byte counter against a bound, so one slow peer "
+                         "grows the buffer without limit. Pair the "
+                         "buffer with a watermark check and a congestion "
+                         "gate (the eventloop transport's tx_bytes/"
+                         "high_watermark shape)")
+
+
+def _scoped_walk(fn):
+    """Every node in ``fn``'s body, excluding nested function/class
+    scopes (they run on other threads)."""
+
+    def visit(node):
+        if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                             ast.AsyncFunctionDef, ast.ClassDef)):
+            return
+        yield node
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child)
+
+    for stmt in fn.body:
+        yield from visit(stmt)
+
+
+def _busy_loops(fn):
+    """FL136 shape 1: While loops that make no calls and cannot make
+    progress locally -- no name read in the test is assigned in the
+    body, so the loop is waiting on cross-thread state with pure
+    spinning (a flag poll, a `while True: pass`)."""
+    out = []
+    for node in _scoped_walk(fn):
+        if not isinstance(node, ast.While):
+            continue
+        # a call in the TEST is progress too: `while sock.recv_into(b):
+        # pass` is the loop's canonical drain shape, not a spin
+        body_nodes = [n for stmt in node.body for n in ast.walk(stmt)]
+        body_nodes += list(ast.walk(node.test))
+        if any(isinstance(n, (ast.Call, ast.Await, ast.Yield,
+                              ast.YieldFrom)) for n in body_nodes):
+            continue
+        test_names = {n.id for n in ast.walk(node.test)
+                      if isinstance(n, ast.Name)}
+        assigned = set()
+        for n in body_nodes:
+            if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                tgts = (n.targets if isinstance(n, ast.Assign)
+                        else [n.target])
+                for t in tgts:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Name):
+                            assigned.add(sub.id)
+        if not (test_names & assigned):
+            out.append(node)
+    return out
+
+
+def _growth_sites(fn):
+    """FL136 shape 2 candidates: (attr name, node) for buffer growth in
+    ``fn`` -- ``X.attr.append/extend/appendleft(...)`` and
+    ``X.attr += <non-constant>`` (constant ``+= 1`` counters are not
+    growth; data-sized increments are). Only depth-1 receivers
+    (``self.buf`` / ``conn.tx``) are this class's to bound: a nested
+    object's buffer (``self._window.deferred``) is its own class's
+    responsibility, and the cross-class pass follows those chains."""
+    out = []
+
+    def depth1(attr_node):
+        return isinstance(attr_node, ast.Attribute) \
+            and isinstance(attr_node.value, ast.Name)
+
+    for node in _scoped_walk(fn):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("append", "extend", "appendleft") \
+                and depth1(node.func.value):
+            out.append((node.func.value.attr, node))
+        elif isinstance(node, ast.AugAssign) \
+                and isinstance(node.op, ast.Add) \
+                and depth1(node.target) \
+                and not isinstance(node.value, ast.Constant):
+            out.append((node.target.attr, node))
+    return out
+
+
+def _checked_attrs(cls):
+    """Attribute names the class compares against a bound anywhere: the
+    attrs inside any Compare's operands, plus the receivers of ``len()``
+    calls. A growth site whose attr shares a name-prefix with one of
+    these is bounded (``tx`` grows, ``tx_bytes`` is compared)."""
+    out = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Compare):
+            for side in [node.left] + list(node.comparators):
+                for sub in ast.walk(side):
+                    if isinstance(sub, ast.Attribute):
+                        out.add(sub.attr)
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "len" and node.args:
+            for sub in ast.walk(node.args[0]):
+                if isinstance(sub, ast.Attribute):
+                    out.add(sub.attr)
+    return out
 
 
 def find_lock_cycles(edges):

@@ -62,13 +62,21 @@ Rule (two shapes, one code):
    handler chains statically -- dispatching observers under a held state
    lock is an FL126 finding, not a runtime-sanitizer catch.
 
+4. **Module-function scope.** Module-level function bodies are walked
+   too: each module's top-level ``def`` bodies live in a synthetic
+   ``<module>`` scope, bare-name calls (``aggregate_reports(...)``, the
+   retry layer) resolve through same-module definitions and one import
+   hop, and ctor-typed locals (``comm = TcpCommManager(...)``) type
+   non-``self`` receivers -- so ``comm.add_observer(server)`` in a
+   module-level driver lands the server class on the transport's
+   observer container, the last untyped observer hop.
+
 Soundness limits (documented, deliberate): locals returned by module
 functions (``get_tracer()``, ``get_flight_recorder()``) are not typed --
 chains through them are invisible here and remain the runtime
-sanitizer's to catch; module-level function bodies
-(``aggregate_reports``) are not entered; container elements flowing
-through non-``self`` receivers (``comm.add_observer(obs)`` on a bare
-local) or re-exported collections are untyped; ``.acquire()`` calls
+sanitizer's to catch; module-level *script* statements (code outside any
+``def``) seed constructor-argument flows but are not walked as a call
+scope; re-exported collections are untyped; ``.acquire()`` calls
 outside a ``with`` do not open a held region (the repo's only uses are
 bounded-timeout acquires, which cannot deadlock-by-order).
 """
@@ -85,6 +93,18 @@ from fedml_tpu.analysis.concurrency import (BLOCKING_ATTRS, BLOCKING_NAMES,
 #: Explore depth cap: real chains here are 3-4 frames; the cap only
 #: bounds pathological recursion through mistyped any-candidates.
 _MAX_DEPTH = 25
+
+#: Bare-name calls never worth a ("func", ...) op: resolving each
+#: builtin through the import maps is pure waste on every expression.
+_BUILTIN_NAMES = frozenset({
+    "len", "sorted", "float", "int", "str", "list", "dict", "set",
+    "tuple", "frozenset", "isinstance", "issubclass", "getattr",
+    "setattr", "hasattr", "print", "min", "max", "sum", "range",
+    "enumerate", "zip", "abs", "round", "id", "repr", "type", "bool",
+    "bytes", "bytearray", "iter", "next", "open", "super", "vars",
+    "format", "map", "filter", "any", "all", "divmod", "hash", "ord",
+    "chr", "callable", "memoryview", "slice", "reversed",
+})
 
 
 def _self_attr(node):
@@ -155,11 +175,13 @@ class _ClassInfo:
         self.ops = {}
         self._locals = {}
         self._elem_aliases = {}
+        self._ctor_local_map = {}
         self._collect_families()
         self._collect_containers()
         for name, fn in self.methods.items():
             self._locals = self._lock_aliases(fn)
             self._elem_aliases = self._container_aliases(fn)
+            self._ctor_local_map = self._ctor_locals(fn)
             out = []
             self._visit(fn.body, out, frozenset())
             self.ops[name] = out
@@ -387,10 +409,15 @@ class _ClassInfo:
                 out.append(_Op("call",
                                ("elem", self._elem_aliases[f.id], None),
                                held, node))
+            elif f.id not in _BUILTIN_NAMES:
+                # bare-name call: a module-level function (own module or
+                # one import hop) -- resolved later; unresolvable names
+                # (classes, dead imports) simply yield no targets
+                out.append(_Op("call", ("func", f.id, None), held, node))
             return
         if not isinstance(f, ast.Attribute):
             return
-        if f.attr in BLOCKING_ATTRS:
+        if f.attr in BLOCKING_ATTRS and not _str_receiver(f.value):
             out.append(_Op("block", f.attr, held, node))
         sattr = _self_attr(f)
         if sattr is not None:
@@ -412,6 +439,15 @@ class _ClassInfo:
                            ("elem", self._elem_aliases[f.value.id],
                             f.attr), held, node))
             return
+        if isinstance(f.value, ast.Name) \
+                and f.value.id in self._ctor_local_map:
+            # method on a ctor-typed LOCAL (`comm = TcpCommManager(...);
+            # comm.add_observer(server)`): the non-self receiver hop
+            for cname in sorted(self._ctor_local_map[f.value.id]):
+                data = ("localcls", cname, f.attr)
+                out.append(_Op("call", data, held, node))
+                self._record_call_args(data, node)
+            return
         fattr = _self_attr(f.value)
         if fattr is not None and fattr not in self.families:
             # self.field.m(...): resolved through the field's types
@@ -422,6 +458,10 @@ class _ClassInfo:
         """Resolvable method-call argument: the element-flow seeds."""
         if isinstance(value, ast.Name) and value.id == "self":
             return [("selfcls", None)]
+        if isinstance(value, ast.Name) \
+                and value.id in self._ctor_local_map:
+            return [("class", c)
+                    for c in sorted(self._ctor_local_map[value.id])]
         attr = _self_attr(value)
         if attr is not None and attr in self.methods:
             return [("method", attr)]
@@ -436,6 +476,13 @@ class _ClassInfo:
                   for kw in node.keywords if kw.arg}
         if any(argrefs) or any(kwrefs.values()):
             self.call_args.append((data, argrefs, kwrefs))
+
+
+def _str_receiver(node):
+    """A string-literal receiver (``",".join(...)``, f-string methods):
+    never a thread/process join, whatever the attribute name says."""
+    return isinstance(node, ast.JoinedStr) or (
+        isinstance(node, ast.Constant) and isinstance(node.value, str))
 
 
 def _base_name(node):
@@ -526,6 +573,15 @@ class CrossClassIndex:
         for node in tree.body:
             if isinstance(node, ast.ClassDef):
                 classes[node.name] = _ClassInfo(mod, path, node)
+        # module-level function bodies: a synthetic "<module>" scope so
+        # aggregate_reports-style free functions are walked like methods
+        # ("<" keeps the name unreachable from any real ast.Name)
+        mod_fns = [n for n in tree.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        if mod_fns:
+            fake = ast.ClassDef(name="<module>", bases=[], keywords=[],
+                                body=mod_fns, decorator_list=[])
+            classes["<module>"] = _ClassInfo(mod, path, fake)
         self.modules[mod] = {"imports": imports, "classes": classes,
                              "tree": tree}
         self._finalized = False
@@ -554,6 +610,28 @@ class CrossClassIndex:
                 cls = self.resolve_class(cand, src_name, seen)
                 if cls is not None:
                     return cls
+        return None
+
+    def resolve_function(self, module, name, seen=None):
+        """Module-level function resolution for ("func", name) calls:
+        the owning "<module>" scope in ``module`` itself, else one or
+        more ImportFrom hops. Returns the owning _ClassInfo or None."""
+        seen = set() if seen is None else seen
+        if (module, name) in seen:
+            return None
+        seen.add((module, name))
+        info = self.modules.get(module)
+        if info is None:
+            return None
+        modcls = info["classes"].get("<module>")
+        if modcls is not None and name in modcls.methods:
+            return modcls
+        if name in info["imports"]:
+            src_mod, src_name = info["imports"][name]
+            for cand in self._candidates(src_mod):
+                owner = self.resolve_function(cand, src_name, seen)
+                if owner is not None:
+                    return owner
         return None
 
     def find_method(self, cls, name, seen=None):
@@ -651,6 +729,9 @@ class CrossClassIndex:
                     if tcls is not None:
                         out.append((tcls, b))
             return out
+        if kind == "localcls":
+            tcls = self.resolve_class(cls.module, a)
+            return [(tcls, b)] if tcls is not None else []
         return []
 
     def _compute_elem_flows(self):
@@ -917,6 +998,19 @@ class _Checker:
             # and the handler-dict dispatch
             return self._refs_targets(
                 self.index.container_elem_types(cls, a), b)
+        if kind == "func":
+            # bare-name call: module-level function in this module or
+            # through one import hop (the "<module>" scope)
+            owner = self.index.resolve_function(cls.module, a)
+            return [(owner, a)] if owner is not None else []
+        if kind == "localcls":
+            # method on a ctor-typed local (`comm.add_observer(...)`)
+            tcls = self.index.resolve_class(cls.module, a)
+            if tcls is not None:
+                owner, fn = self.index.find_method(tcls, b)
+                if owner is not None:
+                    return [(owner, b)]
+            return []
         return []
 
     def _field_targets(self, cls, attr, method):
@@ -1094,6 +1188,10 @@ def _describe_target(data):
     if kind == "elem":
         return (f"`.{b}()` on an element of `self.{a}`" if b is not None
                 else f"an element of `self.{a}` (called directly)")
+    if kind == "func":
+        return f"`{a}()`"
+    if kind == "localcls":
+        return f"`.{b}()` on a local `{a}` instance"
     return f"`self.{a}.{b}()`"
 
 

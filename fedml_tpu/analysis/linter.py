@@ -217,6 +217,53 @@ RULES = {
         "times before it). Build a RoundProgram (CohortPolicy/"
         "AggregationPolicy are its vocabulary) and drive folds through "
         "program.host_view(); see docs/PROGRAM.md."),
+    "FL131": (
+        "float fold over unordered dict/set iteration on an aggregation path",
+        "a sum()/`+=` float accumulation whose iteration source is "
+        "unordered dict/set order, inside a function the aggregation "
+        "callgraph reaches: float addition does not commute, so the "
+        "fold's value depends on arrival order (the PR 9 "
+        "aggregate_reports bug). Iterate sorted(keys) -- the "
+        "fold_entries_fp64 contract."),
+    "FL132": (
+        "wall-clock read deciding control-law behavior",
+        "time.time()/monotonic()/perf_counter() flowing into an "
+        "if/while test, comparison, return, or self.* store inside a "
+        "steering controller or program leg: the control law's contract "
+        "is deterministic replay (quantized observations in, quantized "
+        "knobs out); a clock-decided branch makes two identical runs "
+        "steer differently. Measurement deltas feeding observe() "
+        "histograms stay legal."),
+    "FL133": (
+        "unseeded or constant-seeded randomness on a cohort/fault/trace path",
+        "a global random.*/np.random.* draw with no derived reseed, a "
+        "constant seed/default_rng()/PRNGKey literal: cohort draws, "
+        "fault injections, and trace shaping must derive from "
+        "SeedSequence spawns or the program's attempt_seed so a round "
+        "is replayable and distinct across attempts."),
+    "FL134": (
+        "float accumulation in a handler-thread-reachable method",
+        "a float `+=` fold on a path message-handler threads reach runs "
+        "in network arrival order by construction -- the schedule, not "
+        "the program, decides the value. Buffer the entries and fold "
+        "through program.fold_entries_fp64 / BufferedAggregator "
+        "(sorted-key fp64) instead."),
+    "FL135": (
+        "nondeterministic serialization on a manifest/status/wire path",
+        "json.dump/dumps without sort_keys=True, or an unsorted "
+        "os.listdir/glob enumeration feeding output: dict insertion "
+        "order and filesystem order are accidents, so two writers of "
+        "the same logical record emit different bytes and byte-equal "
+        "gates (wire goldens, status diffs, manifest pins) go flaky."),
+    "FL136": (
+        "busy loop or unbounded buffer growth in an event-loop callback",
+        "a while-loop with no calls at all (no sleep, no I/O, no "
+        "selector wait) spins the loop thread at 100% without yielding; "
+        "a per-connection buffer that only ever grows (append/extend/"
+        "`+=` with no watermark or len() check anywhere in the class) "
+        "lets one slow peer absorb the process heap. The eventloop "
+        "transport's high/low watermark pair "
+        "(fedml_tpu/net/eventloop.py) is the reference shape."),
 }
 
 #: SARIF rule metadata: which analysis pass owns each rule (rendered as
@@ -227,8 +274,11 @@ RULE_PASS = {
     "FL128": "fedcheck-protocol",
     "FL123": "fedcheck-concurrency", "FL124": "fedcheck-concurrency",
     "FL125": "fedcheck-concurrency", "FL126": "fedcheck-concurrency",
-    "FL129": "fedcheck-concurrency",
+    "FL129": "fedcheck-concurrency", "FL136": "fedcheck-concurrency",
     "FL130": "fedlint-program",
+    "FL131": "fedcheck-determinism", "FL132": "fedcheck-determinism",
+    "FL133": "fedcheck-determinism", "FL134": "fedcheck-determinism",
+    "FL135": "fedcheck-determinism",
 }
 
 
@@ -1409,11 +1459,19 @@ def _crossclass_findings(cindex, mod_info, select=None, ignore=None):
                              mod_info, select=select, ignore=ignore)
 
 
+def _determinism_findings(dindex, mod_info, select=None, ignore=None):
+    """Project-wide determinism pass (FL131-FL135)."""
+    from fedml_tpu.analysis.determinism import check_determinism
+    return _emitted_findings(lambda emit: check_determinism(dindex, emit),
+                             mod_info, select=select, ignore=ignore)
+
+
 def lint_source(src, path="<string>", select=None, ignore=None):
     """Lint one module's source (project-wide rules see only this one
     module). Returns non-suppressed findings."""
     from fedml_tpu.analysis.crossclass import CrossClassIndex
     from fedml_tpu.analysis.dataflow import ProjectIndex
+    from fedml_tpu.analysis.determinism import DeterminismIndex
     from fedml_tpu.analysis.protocol import ProtocolIndex
     try:
         tree = ast.parse(src, filename=path)
@@ -1426,6 +1484,8 @@ def lint_source(src, path="<string>", select=None, ignore=None):
     pindex.add_module(path, tree)
     cindex = CrossClassIndex()
     cindex.add_module(path, tree)
+    dindex = DeterminismIndex()
+    dindex.add_module(path, tree)
     mod_info = {ProtocolIndex.module_name(path): (path, src)}
     findings = _lint_module(path, src, tree, index, select=select,
                             ignore=ignore)
@@ -1433,6 +1493,8 @@ def lint_source(src, path="<string>", select=None, ignore=None):
                                    ignore=ignore)
     findings += _crossclass_findings(cindex, mod_info, select=select,
                                      ignore=ignore)
+    findings += _determinism_findings(dindex, mod_info, select=select,
+                                      ignore=ignore)
     findings.sort(key=lambda f: (f.line, f.col, f.code))
     return findings
 
@@ -1456,14 +1518,16 @@ def lint_paths(paths, select=None, ignore=None):
     builder returns and imports; protocol constants and FSM classes
     through import edges); pass 2 runs the per-module rules with the jit
     index in scope, then the project-wide protocol (FL120-FL122,
-    FL127/FL128) and cross-class concurrency (FL126) passes over the
-    whole fileset."""
+    FL127/FL128), cross-class concurrency (FL126), and determinism
+    (FL131-FL135) passes over the whole fileset."""
     from fedml_tpu.analysis.crossclass import CrossClassIndex
     from fedml_tpu.analysis.dataflow import ProjectIndex
+    from fedml_tpu.analysis.determinism import DeterminismIndex
     from fedml_tpu.analysis.protocol import ProtocolIndex
     index = ProjectIndex()
     pindex = ProtocolIndex()
     cindex = CrossClassIndex()
+    dindex = DeterminismIndex()
     modules, findings = [], []
     mod_info = {}
     for path in iter_python_files(paths):
@@ -1480,6 +1544,7 @@ def lint_paths(paths, select=None, ignore=None):
         index.add_module(rel, tree, _Aliases(tree))
         pindex.add_module(rel, tree)
         cindex.add_module(rel, tree)
+        dindex.add_module(rel, tree)
         mod_info[ProtocolIndex.module_name(rel)] = (rel, src)
         modules.append((rel, src, tree))
     for rel, src, tree in modules:
@@ -1489,6 +1554,8 @@ def lint_paths(paths, select=None, ignore=None):
                                        ignore=ignore))
     findings.extend(_crossclass_findings(cindex, mod_info, select=select,
                                          ignore=ignore))
+    findings.extend(_determinism_findings(dindex, mod_info, select=select,
+                                          ignore=ignore))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
     return findings
 

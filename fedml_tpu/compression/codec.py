@@ -229,7 +229,7 @@ def encode_tree_views(tree) -> list:
     :func:`encode_array_views`); a vectored-write transport sends the
     list as-is and skips frame assembly entirely."""
     arrays: list = []
-    header = json.dumps(_extract(tree, arrays)).encode()
+    header = json.dumps(_extract(tree, arrays), sort_keys=True).encode()
     views = [bytes((MAGIC, VERSION)) + _HDR_LEN.pack(len(header)) + header]
     for a in arrays:
         views.extend(encode_array_views(a))
@@ -296,7 +296,7 @@ def tree_wire_nbytes(tree) -> int:
             return v.item()
         return v
 
-    header = json.dumps(walk(tree)).encode()
+    header = json.dumps(walk(tree), sort_keys=True).encode()
     n = 2 + _HDR_LEN.size + len(header)
     for a in arrays:
         n += array_wire_nbytes(tuple(a.shape), np.dtype(a.dtype))

@@ -75,7 +75,7 @@ class Message:
         ``fedml_api/distributed/fedavg/utils.py:5-14``). The transports now
         default to :meth:`to_bytes` -- ~10x smaller for array payloads --
         and keep decoding this format for back-compat."""
-        return json.dumps(self.msg_params, default=_jsonify)
+        return json.dumps(self.msg_params, default=_jsonify, sort_keys=True)
 
     def to_bytes(self):
         """Binary wire codec (``fedml_tpu.compression.codec``): JSON control

@@ -53,7 +53,7 @@ MAL_BEHAVIOR_FEAT = [
 def _find_processed_csv(data_dir):
     if os.path.isfile(data_dir):
         return data_dir
-    for name in os.listdir(data_dir):
+    for name in sorted(os.listdir(data_dir)):
         if name.endswith(".csv") and "loan" in name.lower():
             return os.path.join(data_dir, name)
     raise FileNotFoundError(

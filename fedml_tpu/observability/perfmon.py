@@ -303,7 +303,8 @@ def append_ledger(record, path):
     if d:
         os.makedirs(d, exist_ok=True)
     with open(path, "a") as f:
-        f.write(json.dumps({"ledger_ts": time.time(), **record}) + "\n")
+        f.write(json.dumps({"ledger_ts": time.time(), **record},
+                           sort_keys=True) + "\n")
     return path
 
 

@@ -91,6 +91,32 @@ class RoundProgram:
     def is_async(self) -> bool:
         return self.aggregation.is_async
 
+    def manifest(self) -> dict:
+        """JSON-able description of this program, minus the opaque
+        ``client_update``: the three policy legs as plain dicts. Written
+        into ``status.json``/run manifests (always with
+        ``sort_keys=True`` -- the FL135-clean reference shape) so an
+        operator can read which round definition a fleet is executing,
+        and so :meth:`from_manifest` round-trips it."""
+        return {
+            "cohort": dataclasses.asdict(self.cohort),
+            "aggregation": dataclasses.asdict(self.aggregation),
+            "codec": {"spec": self.codec.spec,
+                      "enabled": self.codec.enabled},
+        }
+
+    @classmethod
+    def from_manifest(cls, data: dict) -> "RoundProgram":
+        """Rebuild a program (minus ``client_update``) from
+        :meth:`manifest` output. Unknown keys are rejected by the
+        dataclass constructors on purpose: a manifest that names a knob
+        this build doesn't know is a version skew worth surfacing."""
+        return cls(
+            cohort=CohortPolicy(**data.get("cohort", {})),
+            aggregation=AggregationPolicy(**data.get("aggregation", {})),
+            codec=CodecSpec(spec=data.get("codec", {}).get("spec",
+                                                           "none")))
+
     def replace(self, **changes) -> "RoundProgram":
         return dataclasses.replace(self, **changes)
 

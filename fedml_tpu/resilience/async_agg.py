@@ -495,6 +495,10 @@ class AsyncBufferedFedAvgServer(ServerManager):
             }
             if self.pace is not None:
                 fields["pace"] = self.pace.status_fields()
+            # the active round definition (steering replaces the
+            # aggregation leg mid-run): status.json names the program,
+            # not just its throughput
+            fields["program"] = self.program.manifest()
             dts, self._pending_flush_dts = self._pending_flush_dts, []
         for dt in dts:
             mon.observe_round(dt)  # flush-to-flush pace: the barrier-free

@@ -682,6 +682,10 @@ class ResilientFedAvgServer(ServerManager):
             }
             if self.pace is not None:
                 fields["pace"] = self.pace.status_fields()
+            # the active round definition (steering replaces it mid-run):
+            # an operator reading status.json sees WHICH program the
+            # fleet is executing, not just how fast
+            fields["program"] = self.program.manifest()
             dt, self._pending_round_dt = self._pending_round_dt, None
         if dt is not None:
             mon.observe_round(dt)

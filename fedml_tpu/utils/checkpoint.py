@@ -152,7 +152,7 @@ class Checkpointer:
         from fedml_tpu.utils.metrics import _jsonable
         d = vars(args) if hasattr(args, "__dict__") else dict(args)
         with open(os.path.join(self.directory, "parameters.json"), "w") as f:
-            json.dump(_jsonable(d), f, indent=2)
+            json.dump(_jsonable(d), f, indent=2, sort_keys=True)
 
     def _update_best(self, round_idx, metric):
         """``best_pred.txt`` tracking across runs (``fedseg/utils.py:189-204``)."""
@@ -166,7 +166,8 @@ class Checkpointer:
         if better:
             with open(path, "w") as f:
                 f.write(json.dumps({"metric": float(metric),
-                                    "round": int(round_idx)}))
+                                    "round": int(round_idx)},
+                                   sort_keys=True))
 
     def close(self):
         self._mgr.wait_until_finished()
@@ -259,7 +260,8 @@ def _unpack_aux(packed, template=_NO_TEMPLATE):
 def _encode_json(obj) -> np.ndarray:
     """JSON-able object -> uint8 array (orbax leaves must be arrays; RNG
     bit-generator states contain 128-bit ints that need a text codec)."""
-    return np.frombuffer(json.dumps(obj).encode(), dtype=np.uint8).copy()
+    return np.frombuffer(json.dumps(obj, sort_keys=True).encode(),
+                         dtype=np.uint8).copy()
 
 
 def _decode_json(arr):

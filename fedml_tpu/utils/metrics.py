@@ -50,7 +50,8 @@ class MetricsLogger:
             if config is not None:
                 with open(os.path.join(run_dir, "config.json"), "w") as f:
                     json.dump(_jsonable(vars(config) if hasattr(config, "__dict__")
-                                        else dict(config)), f, indent=2)
+                                        else dict(config)), f, indent=2,
+                              sort_keys=True)
         self._wandb = None
         if enable_wandb:
             try:
@@ -94,11 +95,12 @@ class MetricsLogger:
             registry.snapshot_into(record)
         logging.info("%s", record)
         if self._jsonl is not None:
-            self._jsonl.write(json.dumps({"_ts": time.time(), **record}) + "\n")
+            self._jsonl.write(json.dumps({"_ts": time.time(), **record},
+                                          sort_keys=True) + "\n")
             self._jsonl.flush()
             self._summary.update(record)
             with open(os.path.join(self.run_dir, "summary.json"), "w") as f:
-                json.dump(self._summary, f, indent=2)
+                json.dump(self._summary, f, indent=2, sort_keys=True)
         if self._wandb is not None:
             self._wandb.log(record)
 

@@ -51,8 +51,17 @@ rules = {r["id"]: r for r in sarif["runs"][0]["tool"]["driver"]["rules"]}
 for code in ("FL126", "FL127", "FL128"):
     tags = rules[code]["properties"]["tags"]
     assert tags and tags[0].startswith("fedcheck-"), (code, tags)
-print("fedlint gate: 0 findings (incl. FL126-FL128 at zero), baseline "
-      "empty, sarif rules carry fedcheck metadata")
+# the determinism pass (FL131-FL135) is gated at zero like every other
+# pass, and its SARIF rules must carry the fedcheck-determinism tag so
+# PR-annotation UIs group fold/cohort/control-law findings together
+for code in ("FL131", "FL132", "FL133", "FL134", "FL135"):
+    tags = rules[code]["properties"]["tags"]
+    assert tags == ["fedcheck-determinism"], (code, tags)
+assert rules["FL136"]["properties"]["tags"][0] == "fedcheck-concurrency", \
+    rules["FL136"]["properties"]["tags"]
+print("fedlint gate: 0 findings (incl. FL126-FL128 and the determinism "
+      "pass FL131-FL135 at zero), baseline empty, sarif rules carry "
+      "fedcheck metadata")
 EOF
 echo "-- fedlint --fix idempotence (clean tree => empty diff; same"
 echo "   wall-time budget -- the fixer's FL110 simulation is budgeted too) --"
@@ -173,6 +182,13 @@ status = json.load(open(obs.status_path))
 assert status["last_outcome"] in ("complete", "degraded"), status
 assert status["round"] == 3 and status["final"] is True, status
 assert status["outcome_counts"]["degraded"] >= 1, status
+# feddet (PR 17): status.json names the ACTIVE round program -- the
+# manifest minus client_update, written sort_keys (the FL135-clean
+# serialization reference), so an operator reads WHICH round definition
+# the fleet executed, not just how fast it went
+assert status["program"]["aggregation"]["mode"] == "sync", status
+assert status["program"]["cohort"]["quorum"] == 0.3, status
+assert status["program"]["cohort"]["deadline_s"] == 1.0, status
 assert obs.registry.get("fed_report_latency_seconds")[1] > 0
 assert obs.registry.get("fed_rounds_per_hour") > 0
 

@@ -125,6 +125,40 @@ class TestProgramContract:
         assert fedavg.attempt_seed is cohort.attempt_seed
         assert async_agg.BufferedAggregator is aggregation.BufferedAggregator
 
+    def test_manifest_roundtrip_pinned(self):
+        # status.json / run manifests serialize the ACTIVE program via
+        # manifest() -- always with sort_keys=True (the FL135-clean
+        # reference shape). The byte pin keeps the operator-facing
+        # format from drifting silently; from_manifest round-trips
+        # everything but the opaque client_update.
+        import json
+        p = RoundProgram(
+            cohort=CohortPolicy(deadline_s=2.0, overselect=0.5,
+                                quorum=0.4),
+            aggregation=AggregationPolicy(buffer_k=8,
+                                          staleness_decay=0.25),
+            codec="qsgd:4", client_update=object())
+        m = p.manifest()
+        assert "client_update" not in json.dumps(m)
+        assert json.dumps(m, sort_keys=True) == (
+            '{"aggregation": {"async_window": 4, "buffer_k": 8, '
+            '"flush_deadline_s": 0.0, "mode": "async", '
+            '"staleness_decay": 0.25}, '
+            '"codec": {"enabled": true, "spec": "qsgd:4"}, '
+            '"cohort": {"deadline_s": 2.0, "max_round_retries": 3, '
+            '"overselect": 0.5, "quorum": 0.4}}')
+        back = RoundProgram.from_manifest(
+            json.loads(json.dumps(m, sort_keys=True)))
+        assert back == p.replace(client_update=None)
+        # defaults round-trip too (the sync barrier program)
+        assert RoundProgram.from_manifest(
+            RoundProgram().manifest()) == RoundProgram()
+        # version skew surfaces instead of being swallowed
+        bad = RoundProgram().manifest()
+        bad["cohort"]["warp_factor"] = 9
+        with pytest.raises(TypeError):
+            RoundProgram.from_manifest(bad)
+
     def test_cohort_vocabulary_single_homed(self):
         # the distributed sampler under its historical name == the
         # program's; the sim sampler == the host view's -- one cohort
