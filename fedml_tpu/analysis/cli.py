@@ -175,11 +175,13 @@ def run_fix(paths, diff=False):
         except SyntaxError:
             continue  # the lint run reports FL100; nothing to fix here
         index.add_module(rel, tree, _Aliases(tree))
-        sources.append((path, rel, src))
+        sources.append((path, rel, src, tree))
 
     pending = 0
-    for path, rel, src in sources:
-        plan = plan_donation_fixes(rel, src, index=index)
+    for path, rel, src, tree in sources:
+        # hand the index-building parse through: each file is parsed
+        # exactly once per fix run (shared parse cache)
+        plan = plan_donation_fixes(rel, src, index=index, tree=tree)
         for line, name, reason in plan.skipped:
             print(f"{rel}:{line}: FL104 fix skipped for `{name}`: "
                   f"{reason}", file=sys.stderr)

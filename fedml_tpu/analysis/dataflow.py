@@ -811,7 +811,7 @@ def _decorator_src(src_lines, node):
     return "\n".join(parts)
 
 
-def plan_donation_fixes(path, src, index=None):
+def plan_donation_fixes(path, src, index=None, tree=None):
     """Plan ``donate_argnums`` insertions for every un-donated FL104
     site in one module. Returns a :class:`FixPlan` (possibly empty).
 
@@ -819,12 +819,16 @@ def plan_donation_fixes(path, src, index=None):
     parameter is donation-eligible, or when ``index`` is given and any
     resolvable call site of the symbol would trip FL110 under the
     proposed tuple -- the fix must never *introduce* a use-after-donate.
+    ``tree``: the module's already-parsed AST (the fix driver parses
+    each file once for the project index and hands the tree through --
+    the shared-parse-cache contract every pass honors).
     """
     from fedml_tpu.analysis.linter import (_AGG_NAME_RE, _Aliases,
                                            _collect_jit_sites,
                                            _jit_call_info,
                                            _parse_suppressions)
-    tree = ast.parse(src, filename=path)
+    if tree is None:
+        tree = ast.parse(src, filename=path)
     aliases = _Aliases(tree)
     per_line, per_file = _parse_suppressions(src)
     plan = FixPlan(path, src)

@@ -55,10 +55,12 @@ than a measurement idiom:
   (``sum(self._entry_clients.values())``) carry no float evidence and
   stay legal -- int addition commutes exactly, floats do not.
 - FL132: a ``time.time()``/``monotonic()``/``perf_counter()`` read whose
-  value (directly, or through one local binding) reaches a *decision
-  point*: an ``if``/``while`` test, a comparison, a ``return``, or a
-  ``self.*`` store. Measurement-only reads -- deltas passed to
-  ``observe(...)``-style calls -- never reach one and stay legal.
+  value (directly, through a chain of local bindings -- fixpoint taint
+  -- or via a clock-tainted ``self.<attr>`` stored by a sibling method,
+  the *attribute hop*) reaches a *decision point*: an ``if``/``while``
+  test, a comparison, a ``return``, or a ``self.*`` store.
+  Measurement-only reads -- deltas passed to ``observe(...)``-style
+  calls -- never reach one and stay legal.
 - FL133: a global-stream draw (``np.random.choice``, ``random.shuffle``,
   ...) with no earlier reseed in the same function (the legal shape is
   the historical derived-reseed idiom,
@@ -72,20 +74,27 @@ than a measurement idiom:
   thread-reachable method: handlers run in arrival order by
   construction, so the fold order is the network's, not the program's.
 - FL135: ``json.dump``/``json.dumps`` without ``sort_keys=True`` on a
-  manifest/status/wire-adjacent path, or an ``os.listdir``/``glob``
-  enumeration whose result is not normalized with ``sorted(``/
-  ``.sort()``.
+  manifest/status/wire-adjacent path, or -- cross-function -- an
+  unsorted dump in an *unscoped* module whose payload traces (directly
+  or through one local) to a call of a *manifest producer*: a
+  module-level function in a scoped module that returns a dict it
+  built. Also an ``os.listdir``/``glob`` enumeration whose result is
+  not normalized with ``sorted(``/``.sort()``.
 
 **Soundness limits (documented, deliberate).** Float folds with no
 syntactic ``float(`` evidence (a dict of floats summed raw) are
-invisible -- the pass has no type inference. FL132's one-level local
-taint misses a clock value laundered through two locals or an attribute
-round-trip. FL133 treats any non-constant ``seed(...)`` argument as
-derived; a seed read from the wall clock would pass (and be FL132's
-business in scope). FL134's reachability is per-class plus same-project
-module functions; callables smuggled through untyped containers are the
-cross-class pass's (FL126) domain. FL135 does not track dict
-construction order across functions -- only the serialization call site.
+invisible -- the pass has no type inference. FL132's taint is
+intraprocedural plus the per-class attribute hop: a clock value
+laundered through a container element, a tuple unpack, or a method
+*return value* still escapes it. FL133 treats any non-constant
+``seed(...)`` argument as derived; a seed read from the wall clock
+would pass (and is FL132's business in scope). FL134's reachability is
+per-class plus same-project module functions; callables smuggled
+through untyped containers are the cross-class pass's (FL126) domain.
+FL135's cross-function tracking follows one bare-name call hop to a
+scoped producer (``DeterminismIndex.resolve_func``); a manifest
+re-shaped through intermediate helpers or returned from a method is
+only caught at scoped serialization sites.
 """
 
 from __future__ import annotations
@@ -449,33 +458,117 @@ def _clock_calls(fn, time_mods, clock_funcs):
     return out
 
 
-def _check_fl132(fi, time_mods, clock_funcs, add):
-    """Wall-clock reads flowing into a control-law decision value."""
-    fn = fi.node
-    clocks = _clock_calls(fn, time_mods, clock_funcs)
-    if not clocks:
-        return
-    clock_ids = {id(c) for c in clocks}
-
-    def contains_clock(expr):
-        return any(id(n) in clock_ids for n in ast.walk(expr))
-
-    # one-level local taint: locals assigned from a clock expression
+def _local_clock_taint(fn, time_mods, clock_funcs, attr_taint):
+    """Fixpoint local taint for FL132: a local is tainted when assigned
+    (or ``+=``-folded) from an expression holding a clock read, an
+    already-tainted local, or a clock-tainted ``self.<attr>`` load.
+    Returns ``(clock_call_ids, tainted_local_names)``."""
+    clock_ids = {id(c) for c in _clock_calls(fn, time_mods, clock_funcs)}
     tainted = set()
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Assign) and contains_clock(node.value):
-            for tgt in node.targets:
-                if isinstance(tgt, ast.Name):
-                    tainted.add(tgt.id)
 
-    def is_decision_value(expr):
-        """The expression reaches a decision point if it holds a clock
-        read or a tainted local."""
+    def expr_tainted(expr):
         for n in ast.walk(expr):
             if id(n) in clock_ids:
                 return True
             if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
                     and n.id in tainted:
+                return True
+            if isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Load) \
+                    and _self_attr(n) in attr_taint:
+                return True
+        return False
+
+    changed = True
+    while changed:       # fixpoint: taint through local->local chains
+        changed = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and expr_tainted(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id not in tainted:
+                        tainted.add(tgt.id)
+                        changed = True
+            elif isinstance(node, ast.AugAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.target.id not in tainted \
+                    and expr_tainted(node.value):
+                tainted.add(node.target.id)
+                changed = True
+    return clock_ids, tainted
+
+
+def _class_clock_attrs(rec, time_mods, clock_funcs):
+    """Per-class clock-tainted ``self.<attr>`` sets for the FL132
+    attribute hop: an attribute is tainted when any method of the class
+    stores a clock-derived value into it. Fixpoint over the class so
+    attr-to-attr laundering (``self._b = self._a``) converges too."""
+    by_class = {}
+    for (cls, _name), fi in rec["funcs"].items():
+        if cls is not None:
+            by_class.setdefault(cls, []).append(fi)
+    out = {}
+    for cls, methods in by_class.items():
+        attrs = set()
+        changed = True
+        while changed:
+            changed = False
+            for fi in methods:
+                clock_ids, tainted = _local_clock_taint(
+                    fi.node, time_mods, clock_funcs, attrs)
+
+                def value_tainted(expr):
+                    for n in ast.walk(expr):
+                        if id(n) in clock_ids:
+                            return True
+                        if isinstance(n, ast.Name) \
+                                and isinstance(n.ctx, ast.Load) \
+                                and n.id in tainted:
+                            return True
+                        if isinstance(n, ast.Attribute) \
+                                and isinstance(n.ctx, ast.Load) \
+                                and _self_attr(n) in attrs:
+                            return True
+                    return False
+
+                for node in ast.walk(fi.node):
+                    if not isinstance(node, (ast.Assign, ast.AugAssign)):
+                        continue
+                    if not value_tainted(node.value):
+                        continue
+                    targets = (node.targets
+                               if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for tgt in targets:
+                        a = _self_attr(tgt)
+                        if a is not None and a not in attrs:
+                            attrs.add(a)
+                            changed = True
+        if attrs:
+            out[cls] = attrs
+    return out
+
+
+def _check_fl132(fi, time_mods, clock_funcs, add, attr_taint=frozenset()):
+    """Wall-clock reads flowing into a control-law decision value --
+    directly, through a chain of local bindings (fixpoint taint), or via
+    a clock-tainted class attribute stored by a sibling method
+    (``attr_taint``, the attribute hop)."""
+    fn = fi.node
+    clock_ids, tainted = _local_clock_taint(fn, time_mods, clock_funcs,
+                                            attr_taint)
+    if not clock_ids and not attr_taint:
+        return
+
+    def is_decision_value(expr):
+        """The expression reaches a decision point if it holds a clock
+        read, a tainted local, or a clock-tainted attribute load."""
+        for n in ast.walk(expr):
+            if id(n) in clock_ids:
+                return True
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and n.id in tainted:
+                return True
+            if isinstance(n, ast.Attribute) and isinstance(n.ctx, ast.Load) \
+                    and _self_attr(n) in attr_taint:
                 return True
         return False
 
@@ -611,26 +704,116 @@ def _check_fl134(fi, add):
                 "BufferedAggregator (sorted-key fp64) instead")
 
 
+def _unsorted_json_call(node):
+    """``json.dump``/``json.dumps`` without an effective
+    ``sort_keys=True`` -> the attr name (``dump``/``dumps``), else
+    None."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if not (isinstance(f, ast.Attribute)
+            and f.attr in ("dump", "dumps")
+            and isinstance(f.value, ast.Name) and f.value.id == "json"):
+        return None
+    sk = next((kw for kw in node.keywords if kw.arg == "sort_keys"),
+              None)
+    if sk is not None and not (isinstance(sk.value, ast.Constant)
+                               and sk.value.value is False):
+        return None
+    return f.attr
+
+
 def _check_fl135_json(fi_or_tree, module_funcs, add):
     """json.dump/dumps without sort_keys=True (scope-gated by path)."""
     for node in ast.walk(fi_or_tree):
-        if not isinstance(node, ast.Call):
-            continue
-        f = node.func
-        if not (isinstance(f, ast.Attribute)
-                and f.attr in ("dump", "dumps")
-                and isinstance(f.value, ast.Name) and f.value.id == "json"):
-            continue
-        sk = next((kw for kw in node.keywords if kw.arg == "sort_keys"),
-                  None)
-        if sk is not None and not (isinstance(sk.value, ast.Constant)
-                                   and sk.value.value is False):
+        attr = _unsorted_json_call(node)
+        if attr is None:
             continue
         add(node, "FL135",
-            f"`json.{f.attr}` without `sort_keys=True` on a manifest/"
+            f"`json.{attr}` without `sort_keys=True` on a manifest/"
             "status/wire-adjacent path -- dict insertion order is a "
             "program accident, not a contract; two writers of the same "
             "logical record must produce identical bytes")
+
+
+def _is_dict_expr(expr):
+    return isinstance(expr, ast.Dict) or (
+        isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name)
+        and expr.func.id == "dict")
+
+
+def _fl135_is_producer(fi):
+    """A manifest producer: a module-level function that returns a dict
+    it built (a dict display / ``dict(...)`` call, directly or through a
+    local)."""
+    dict_locals = set()
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.Assign) and _is_dict_expr(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    dict_locals.add(tgt.id)
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.Return) and node.value is not None:
+            if _is_dict_expr(node.value):
+                return True
+            if isinstance(node.value, ast.Name) \
+                    and node.value.id in dict_locals:
+                return True
+    return False
+
+
+def _fl135_producers(index):
+    """(module, funcs-key) set of manifest producers defined in
+    FL135-scoped modules -- the cross-function tracking roots."""
+    producers = set()
+    for mod, rec in index.modules.items():
+        if not _match(rec["path"], _FL135_JSON_PATHS):
+            continue
+        for key, fi in rec["funcs"].items():
+            if key[0] is None and _fl135_is_producer(fi):
+                producers.add((mod, key))
+    return producers
+
+
+def _check_fl135_cross(fi, mod, index, producers, add):
+    """Cross-function dict-order tracking: in an *unscoped* module, an
+    unsorted ``json.dump(s)`` whose payload traces (directly or through
+    one local binding) to a call of a manifest producer defined in a
+    scoped module. The record is a manifest no matter which module
+    serializes it."""
+    fn = fi.node
+
+    def producer_call(expr):
+        if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name):
+            tgt = index.resolve_func(mod, expr.func.id)
+            if tgt is not None and tgt in producers:
+                return expr.func.id
+        return None
+
+    prod_locals = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            name = producer_call(node.value)
+            if name is not None:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        prod_locals[tgt.id] = name
+    for node in ast.walk(fn):
+        attr = _unsorted_json_call(node)
+        if attr is None or not node.args:
+            continue
+        arg = node.args[0]
+        src = producer_call(arg)
+        if src is None and isinstance(arg, ast.Name):
+            src = prod_locals.get(arg.id)
+        if src is None:
+            continue
+        add(node, "FL135",
+            f"`json.{attr}` without `sort_keys=True` serializes the "
+            f"manifest built by `{src}` (a scoped manifest producer) -- "
+            "the record stays a manifest wherever it is written; two "
+            "writers of the same logical record must produce identical "
+            "bytes")
 
 
 def _check_fl135_listings(tree, add):
@@ -684,6 +867,7 @@ def check_determinism(index, emit):
     node, code, message)`` receives each finding."""
     agg_reach = index.aggregation_reach()
     handler_reach = index.handler_reach()
+    producers = _fl135_producers(index)
     for mod, rec in sorted(index.modules.items()):
         path = rec["path"]
         tree = rec["tree"]
@@ -706,19 +890,24 @@ def check_determinism(index, emit):
         fl132_scope = _match(path, _FL132_PATHS)
         fl133_scope = _match(path, _FL133_PATHS)
         fl135_scope = _match(path, _FL135_JSON_PATHS)
+        attr_taint = (_class_clock_attrs(rec, time_mods, clock_funcs)
+                      if fl132_scope else {})
 
         for key, fi in sorted(rec["funcs"].items(),
                               key=lambda kv: kv[1].node.lineno):
             if (mod, key) in agg_reach:
                 _check_fl131(fi, add)
             if fl132_scope:
-                _check_fl132(fi, time_mods, clock_funcs, add)
+                _check_fl132(fi, time_mods, clock_funcs, add,
+                             attr_taint.get(fi.cls, frozenset()))
             if fl133_scope:
                 _check_fl133(fi, rec, add)
             if (mod, key) in handler_reach:
                 _check_fl134(fi, add)
             if fl135_scope:
                 _check_fl135_json(fi.node, rec["funcs"], add)
+            else:
+                _check_fl135_cross(fi, mod, index, producers, add)
         _check_fl135_listings(tree, add)
 
 

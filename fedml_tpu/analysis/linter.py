@@ -264,6 +264,38 @@ RULES = {
         "lets one slow peer absorb the process heap. The eventloop "
         "transport's high/low watermark pair "
         "(fedml_tpu/net/eventloop.py) is the reference shape."),
+    "FL140": (
+        "protocol deadlock under the bounded fault model",
+        "explicit-state exploration of the composed server x clients "
+        "transition system reached an undecided round state with no "
+        "enabled transition: no in-flight frame, no fault budget and no "
+        "deadline can move the composition. The counterexample trace "
+        "(in the message) is the message sequence that wedges the "
+        "round; give the server deadline machinery or make the "
+        "peer-lost path actually shed the dead rank."),
+    "FL141": (
+        "round-decision liveness violated on the fault-free path",
+        "the whole-protocol generalization of FL127: with every frame "
+        "delivered and no faults injected, the composed round must "
+        "reach complete/degraded/abandoned by pure message exchange. A "
+        "fair path that drains the channel with the round still open "
+        "means a report is built but never folded -- the trace names "
+        "the hung round and the delivery the server ignored."),
+    "FL142": (
+        "state-sensitive unhandled send (temporal FL120)",
+        "a sent frame can *arrive*, while the round is undecided, at a "
+        "live peer whose registered handler is inert on every path "
+        "(logs only: no reply, no controller advance, no termination). "
+        "Type-level pairing (FL120) looks clean, but in the reachable "
+        "composed state the delivery is consumed without progress and "
+        "the round keeps waiting."),
+    "FL143": (
+        "rejoin can strand a rank outside every future cohort",
+        "after a shed, a PEER_JOIN delivered to the server must re-admit "
+        "the rank: exploration found a decided round with a rejoined, "
+        "alive rank still outside the cohort -- capacity that came back "
+        "stays dead for the run. Register a PEER_JOIN handler that "
+        "re-adds the rank and re-syncs it with the current model."),
 }
 
 #: SARIF rule metadata: which analysis pass owns each rule (rendered as
@@ -279,7 +311,30 @@ RULE_PASS = {
     "FL131": "fedcheck-determinism", "FL132": "fedcheck-determinism",
     "FL133": "fedcheck-determinism", "FL134": "fedcheck-determinism",
     "FL135": "fedcheck-determinism",
+    "FL140": "fedcheck-model", "FL141": "fedcheck-model",
+    "FL142": "fedcheck-model", "FL143": "fedcheck-model",
 }
+
+#: codes owned by each project-wide pass: a --select/--ignore set that
+#: cannot produce a pass's codes skips that pass entirely (run one pass
+#: in isolation without paying for the others)
+PASS_CODES = {
+    "protocol": frozenset(
+        ("FL120", "FL121", "FL122", "FL127", "FL128")),
+    "crossclass": frozenset(("FL126",)),
+    "determinism": frozenset(
+        ("FL131", "FL132", "FL133", "FL134", "FL135")),
+    "modelcheck": frozenset(("FL140", "FL141", "FL142", "FL143")),
+}
+
+
+def _pass_enabled(pass_name, select, ignore):
+    codes = PASS_CODES[pass_name]
+    if select is not None and not (codes & set(select)):
+        return False
+    if ignore is not None and codes <= set(ignore):
+        return False
+    return True
 
 
 def rule_tags(code):
@@ -1466,6 +1521,14 @@ def _determinism_findings(dindex, mod_info, select=None, ignore=None):
                              mod_info, select=select, ignore=ignore)
 
 
+def _modelcheck_findings(pindex, mod_info, select=None, ignore=None):
+    """Project-wide bounded model checking pass (FL140-FL143): consumes
+    the same ProtocolIndex the protocol pass built -- no re-parse."""
+    from fedml_tpu.analysis.modelcheck import check_model
+    return _emitted_findings(lambda emit: check_model(pindex, emit),
+                             mod_info, select=select, ignore=ignore)
+
+
 def lint_source(src, path="<string>", select=None, ignore=None):
     """Lint one module's source (project-wide rules see only this one
     module). Returns non-suppressed findings."""
@@ -1489,12 +1552,18 @@ def lint_source(src, path="<string>", select=None, ignore=None):
     mod_info = {ProtocolIndex.module_name(path): (path, src)}
     findings = _lint_module(path, src, tree, index, select=select,
                             ignore=ignore)
-    findings += _protocol_findings(pindex, mod_info, select=select,
-                                   ignore=ignore)
-    findings += _crossclass_findings(cindex, mod_info, select=select,
-                                     ignore=ignore)
-    findings += _determinism_findings(dindex, mod_info, select=select,
-                                      ignore=ignore)
+    if _pass_enabled("protocol", select, ignore):
+        findings += _protocol_findings(pindex, mod_info, select=select,
+                                       ignore=ignore)
+    if _pass_enabled("crossclass", select, ignore):
+        findings += _crossclass_findings(cindex, mod_info, select=select,
+                                         ignore=ignore)
+    if _pass_enabled("determinism", select, ignore):
+        findings += _determinism_findings(dindex, mod_info, select=select,
+                                          ignore=ignore)
+    if _pass_enabled("modelcheck", select, ignore):
+        findings += _modelcheck_findings(pindex, mod_info, select=select,
+                                         ignore=ignore)
     findings.sort(key=lambda f: (f.line, f.col, f.code))
     return findings
 
@@ -1550,12 +1619,18 @@ def lint_paths(paths, select=None, ignore=None):
     for rel, src, tree in modules:
         findings.extend(_lint_module(rel, src, tree, index, select=select,
                                      ignore=ignore))
-    findings.extend(_protocol_findings(pindex, mod_info, select=select,
-                                       ignore=ignore))
-    findings.extend(_crossclass_findings(cindex, mod_info, select=select,
-                                         ignore=ignore))
-    findings.extend(_determinism_findings(dindex, mod_info, select=select,
-                                          ignore=ignore))
+    if _pass_enabled("protocol", select, ignore):
+        findings.extend(_protocol_findings(pindex, mod_info, select=select,
+                                           ignore=ignore))
+    if _pass_enabled("crossclass", select, ignore):
+        findings.extend(_crossclass_findings(cindex, mod_info,
+                                             select=select, ignore=ignore))
+    if _pass_enabled("determinism", select, ignore):
+        findings.extend(_determinism_findings(dindex, mod_info,
+                                              select=select, ignore=ignore))
+    if _pass_enabled("modelcheck", select, ignore):
+        findings.extend(_modelcheck_findings(pindex, mod_info,
+                                             select=select, ignore=ignore))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
     return findings
 

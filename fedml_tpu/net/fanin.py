@@ -41,7 +41,8 @@ from typing import Optional
 
 import numpy as np
 
-from fedml_tpu.core.comm.base import MSG_TYPE_PEER_LOST
+from fedml_tpu.core.comm.base import (MSG_TYPE_PEER_JOIN,
+                                      MSG_TYPE_PEER_LOST)
 from fedml_tpu.core.managers import ClientManager, ServerManager
 from fedml_tpu.core.message import Message
 from fedml_tpu.observability.tracing import get_tracer
@@ -106,6 +107,8 @@ class _EdgeDownlink(ServerManager):
                                               self._on_report)
         self.register_message_receive_handler(MSG_TYPE_PEER_LOST,
                                               self._on_peer_lost)
+        self.register_message_receive_handler(MSG_TYPE_PEER_JOIN,
+                                              self._on_peer_join)
 
     def _on_report(self, msg):
         logging.debug("edge %d: leaf %s report (round %s)",
@@ -117,6 +120,11 @@ class _EdgeDownlink(ServerManager):
         logging.warning("edge %d: leaf rank %s lost", self.edge.edge_rank,
                         msg.get_sender_id())
         self.edge.on_leaf_lost(int(msg.get_sender_id()))
+
+    def _on_peer_join(self, msg):
+        logging.debug("edge %d: leaf %s rejoined", self.edge.edge_rank,
+                      msg.get_sender_id())
+        self.edge.on_leaf_join(int(msg.get_sender_id()))
 
 
 class EdgeAggregator:
@@ -147,6 +155,7 @@ class EdgeAggregator:
         self.alive = set(range(1, downlink_size))
         self.rounds_forwarded = 0
         self.rounds_abandoned = 0
+        self.leaves_rejoined = 0
         # edge round bookkeeping (version/attempt of the open round) is
         # only touched inside the controller callbacks + open_round, all
         # of which run on this edge's two dispatcher threads; the
@@ -198,6 +207,23 @@ class EdgeAggregator:
         with self._lock:
             self.alive.discard(int(rank))
         self._controller.peer_lost(rank)
+
+    def on_leaf_join(self, rank):
+        """Rejoin at the edge tier: a shed leaf's fresh HELLO re-admits
+        it to this edge's alive set, so the next ``open_round`` fans out
+        to it again (same contract as the coordinator tier's
+        ``_on_peer_join``: the in-flight edge round is untouched --
+        fedmc FL143 pins that a rejoined leaf cannot stay stranded
+        outside every future cohort)."""
+        with self._lock:
+            if int(rank) in self.alive:
+                logging.info("edge %d: duplicate leaf-join for rank %s "
+                             "(already alive)", self.edge_rank, rank)
+                return
+            self.alive.add(int(rank))
+            self.leaves_rejoined += 1
+        logging.warning("edge %d: leaf rank %s rejoined -- eligible from "
+                        "the next edge round", self.edge_rank, rank)
 
     def _on_edge_complete(self, reports, outcome):
         params, total = self._host.fold_reports(reports)

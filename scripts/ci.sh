@@ -59,9 +59,38 @@ for code in ("FL131", "FL132", "FL133", "FL134", "FL135"):
     assert tags == ["fedcheck-determinism"], (code, tags)
 assert rules["FL136"]["properties"]["tags"][0] == "fedcheck-concurrency", \
     rules["FL136"]["properties"]["tags"]
-print("fedlint gate: 0 findings (incl. FL126-FL128 and the determinism "
-      "pass FL131-FL135 at zero), baseline empty, sarif rules carry "
-      "fedcheck metadata")
+# the model-checking pass (FL140-FL143) is gated at zero on the tree --
+# the bounded exploration of every discovered server x clients (and
+# two-tier) composition finds no deadlock, hung fair path, inert
+# delivery, or stranded rejoin -- and its rules carry the
+# fedcheck-model tag
+for code in ("FL140", "FL141", "FL142", "FL143"):
+    tags = rules[code]["properties"]["tags"]
+    assert tags == ["fedcheck-model"], (code, tags)
+print("fedlint gate: 0 findings (incl. FL126-FL128, the determinism "
+      "pass FL131-FL135, and the fedmc model-checking pass FL140-FL143 "
+      "at zero), baseline empty, sarif rules carry fedcheck metadata")
+EOF
+echo "-- fedmc mutation fixture (deleting the MSG_C2S_REPORT"
+echo "   registration must yield exactly one FL141 naming the hung"
+echo "   round; the unmutated module must verify clean -- gated both"
+echo "   ways, same wall-time budget family as the lint gate) --"
+python - <<'EOF'
+from fedml_tpu.analysis.linter import lint_source
+rel = "fedml_tpu/resilience/integration.py"
+src = open(rel, encoding="utf-8").read()
+needle = ("        self.register_message_receive_handler(MSG_C2S_REPORT,\n"
+          "                                              self._on_report)\n")
+assert needle in src, "integration.py registration shape changed"
+assert lint_source(src, path=rel, select={"FL141"}) == [], \
+    "unmutated integration.py must verify clean"
+found = lint_source(src.replace(needle, ""), path=rel, select={"FL141"})
+assert [f.code for f in found] == ["FL141"], found
+assert "round 0" in found[0].message and "res_report" in found[0].message, \
+    found[0].message
+print("fedmc mutation fixture: FL141 fires exactly once on the deleted "
+      "registration (trace names the hung round), clean tree verifies "
+      "clean")
 EOF
 echo "-- fedlint --fix idempotence (clean tree => empty diff; same"
 echo "   wall-time budget -- the fixer's FL110 simulation is budgeted too) --"

@@ -811,8 +811,57 @@ class TestCli:
         # checked-in baseline -- new antipatterns fail here first. Scope
         # matches ci.sh: the package plus the bench/driver scripts.
         monkeypatch.chdir(REPO_ROOT)
+        # --max-seconds 60 is the ci.sh wall-time pin: it must keep
+        # holding with the model-checking pass enabled
         assert fedlint_main(["fedml_tpu", "bench.py", "__graft_entry__.py",
-                             "scripts"]) == 0
+                             "scripts", "--max-seconds", "60"]) == 0
+        capsys.readouterr()
+
+    def test_select_runs_one_pass_in_isolation(self, monkeypatch):
+        # pass-level gating: a --select set disjoint from a pass's codes
+        # must skip that pass entirely, not just filter its findings
+        import fedml_tpu.analysis.modelcheck as mc
+        import fedml_tpu.analysis.determinism as det
+
+        def boom(*_a, **_k):
+            raise AssertionError("pass ran despite disjoint --select")
+        monkeypatch.setattr(mc, "check_model", boom)
+        monkeypatch.setattr(det, "check_determinism", boom)
+        src = "import time\n"
+        assert lint_source(src, path=LIB_PATH, select={"FL120"}) == []
+        # and the ignore side: dropping every code of a pass skips it
+        assert lint_source(
+            src, path=LIB_PATH,
+            ignore={"FL131", "FL132", "FL133", "FL134", "FL135",
+                    "FL140", "FL141", "FL142", "FL143"}) == []
+        with pytest.raises(AssertionError):
+            lint_source(src, path=LIB_PATH, select={"FL141"})
+
+    def test_fix_path_parses_each_file_once(self, tmp_path, monkeypatch,
+                                            capsys):
+        # the fix driver parses once for the project index and hands the
+        # tree to plan_donation_fixes: a second parse of the same source
+        # would be the old double-parse regressing
+        import ast as ast_mod
+        mod = tmp_path / "agg.py"
+        mod.write_text(
+            "import jax\n"
+            "@jax.jit\n"
+            "def aggregate(params, grads):\n"
+            "    return jax.tree_util.tree_map(lambda p, g: p + g,\n"
+            "                                  params, grads)\n")
+        real_parse = ast_mod.parse
+        calls = []
+
+        def counting_parse(*a, **k):
+            calls.append(a[0] if a else k.get("source"))
+            return real_parse(*a, **k)
+        monkeypatch.setattr(ast_mod, "parse", counting_parse)
+        from fedml_tpu.analysis.cli import run_fix
+        assert run_fix([str(tmp_path)], diff=True) in (0, 1)
+        monkeypatch.setattr(ast_mod, "parse", real_parse)
+        assert len(calls) == 1, \
+            "fix path parsed a file more than once per run"
         capsys.readouterr()
 
     def test_default_baseline_is_package_anchored(self):
@@ -879,9 +928,12 @@ class TestProtocolRules:
             "                                              self._on_report)\n",
             "")
         found = lint_source(src, path=self.FSM_PATH)
-        assert [f.code for f in found] == ["FL120"]
-        assert "report" in found[0].message
-        assert "`Cli`" in found[0].message
+        # the model checker co-fires: with nobody folding the report the
+        # fair path hangs (FL141) and the faulted run wedges (FL140)
+        assert sorted(f.code for f in found) == ["FL120", "FL140", "FL141"]
+        f120 = [f for f in found if f.code == "FL120"][0]
+        assert "report" in f120.message
+        assert "`Cli`" in f120.message
 
     def test_fl121_fsm_without_peer_lost_handler(self):
         # strip only the SERVER's peer-lost registration (first occurrence)
@@ -958,7 +1010,10 @@ class TestProtocolRules:
                 "register_message_receive_handler(MSG_PONG",
                 "register_message_receive_handler('pong2'"))
         found = lint_paths([str(tmp_path)])
-        assert sorted(f.code for f in found) == ["FL120", "FL122"]
+        # FL140/FL141 ride along: the unresolved reply also hangs the
+        # composed round (temporal view of the same rename)
+        assert sorted(f.code for f in found) == ["FL120", "FL122",
+                                                 "FL140", "FL141"]
 
     def test_inherited_peer_lost_handler_credits_subclass(self):
         src = self.PAIRED + (
@@ -986,8 +1041,11 @@ class TestProtocolRules:
         assert [f.code for f in clean] == []
         found = lint_source(src.replace(needle, ""),
                             path="fedml_tpu/resilience/integration.py")
-        assert [f.code for f in found] == ["FL120"]
-        assert "res_report" in found[0].message
+        # rule view (FL120) plus the model checker's temporal twin: the
+        # fair exploration hangs round 0 on the unfolded report
+        assert sorted(f.code for f in found) == ["FL120", "FL141"]
+        f120 = [f for f in found if f.code == "FL120"][0]
+        assert "res_report" in f120.message
 
 
 class TestConcurrencyRules:
@@ -2099,8 +2157,11 @@ class TestFsmSequencing:
         f127 = [f for f in found if f.code == "FL127"][0]
         assert "`ResilientFedAvgServer._on_report`" in f127.message
         # the orphaned payload keys surface as FL128 companions: the
-        # deleted reads leave num_samples/attempt/params set-never-read
-        assert {f.code for f in found} == {"FL127", "FL128"}
+        # deleted reads leave num_samples/attempt/params set-never-read;
+        # the model checker adds the temporal view of the same gutting
+        # (inert delivery FL142, hung fair round FL141)
+        assert {f.code for f in found} == {"FL127", "FL128", "FL141",
+                                           "FL142"}
 
 
 class TestPayloadSchema:
@@ -3429,6 +3490,98 @@ class TestDeterminism:
         assert [f.code for f in lint_source(src, path=LIB_PATH)
                 if f.code == "FL135"] == []
 
+    # -- FL132 attribute hop + fixpoint local taint -----------------------
+    def test_fl132_attribute_hop_flagged(self):
+        # the clock is stored by one method and DECIDES in a sibling:
+        # the per-class attribute hop catches both ends (the store is a
+        # decision shape itself, the load is the hop)
+        src = (
+            "import time\n"
+            "class PaceLaw:\n"
+            "    def arm(self):\n"
+            "        self._last = time.time()\n"
+            "    def decide(self, obs):\n"
+            "        if obs.now - self._last > 30.0:\n"
+            "            return self._backoff()\n"
+            "        return None\n")
+        found = [f for f in lint_source(src, path=self.STEER)
+                 if f.code == "FL132"]
+        assert sorted(f.line for f in found) == [4, 6]
+
+    def test_fl132_local_chain_fixpoint_flagged(self):
+        # two local bindings deep: the one-level taint of the original
+        # rule missed this laundering; the fixpoint closes it
+        src = (
+            "import time\n"
+            "class PaceLaw:\n"
+            "    def decide(self, obs):\n"
+            "        t = time.time()\n"
+            "        elapsed = t - obs.started\n"
+            "        if elapsed > 30.0:\n"
+            "            return self._backoff()\n"
+            "        return None\n")
+        found = [f for f in lint_source(src, path=self.STEER)
+                 if f.code == "FL132"]
+        assert [f.line for f in found] == [6]
+
+    def test_fl132_untainted_attribute_decision_clean(self):
+        # a non-clock attribute deciding next to measurement-only clock
+        # reads: the hop must not taint by mere co-residence
+        src = (
+            "import time\n"
+            "class PaceLaw:\n"
+            "    def arm(self, budget):\n"
+            "        self._budget = float(budget)\n"
+            "    def decide(self, obs):\n"
+            "        t0 = time.time()\n"
+            "        out = self._law(obs)\n"
+            "        self.mon.observe(time.time() - t0)\n"
+            "        if self._budget > 1.0:\n"
+            "            return out\n"
+            "        return None\n")
+        assert [f.code for f in lint_source(src, path=self.STEER)
+                if f.code == "FL132"] == []
+
+    # -- FL135 cross-function manifest tracking ---------------------------
+    def _cross_modules(self, tmp_path, dump_line):
+        (tmp_path / "status_manifest.py").write_text(
+            "def make_manifest(rounds):\n"
+            "    return {'schema': 1, 'rounds': rounds}\n")
+        (tmp_path / "writer.py").write_text(
+            "import json\n"
+            "from status_manifest import make_manifest\n"
+            "def write(path, rounds):\n"
+            "    manifest = make_manifest(rounds)\n"
+            "    with open(path, 'w') as f:\n"
+            f"        {dump_line}\n")
+        return [f for f in lint_paths([str(tmp_path)])
+                if f.code == "FL135"]
+
+    def test_fl135_cross_module_producer_payload_flagged(self, tmp_path):
+        # the dump site sits in an UNSCOPED module, but its payload is
+        # the dict built by a scoped manifest producer: the record stays
+        # a manifest wherever it is written
+        found = self._cross_modules(tmp_path,
+                                    "json.dump(manifest, f, indent=2)")
+        assert len(found) == 1
+        assert "make_manifest" in found[0].message
+        assert "sort_keys" in found[0].message
+
+    def test_fl135_cross_module_sorted_payload_clean(self, tmp_path):
+        found = self._cross_modules(
+            tmp_path, "json.dump(manifest, f, sort_keys=True)")
+        assert found == []
+
+    def test_fl135_cross_module_non_producer_payload_clean(self, tmp_path):
+        # unscoped module dumping its own local dict: out of scope, and
+        # the cross tracker must not over-reach past producer payloads
+        (tmp_path / "notes.py").write_text(
+            "import json\n"
+            "def debug(obj):\n"
+            "    return json.dumps({'obj': repr(obj)})\n")
+        assert [f for f in lint_paths([str(tmp_path)])
+                if f.code == "FL135"] == []
+
     # -- mutation-acceptance fixtures: each reverted historical fix (or
     # -- planted hazard) yields exactly one finding of exactly its rule
     def _real(self, rel):
@@ -3740,3 +3893,219 @@ class TestNonSelfReceiverFlow:
         transport = idx.modules[mod]["classes"]["Transport"]
         elems = idx.container_elem_types(transport, "_observers")
         assert ("cls", (mod, "Fsm")) in elems
+
+
+class TestModelCheck:
+    """FL140-FL143: the fedmc bounded model checking pass.
+
+    Fixtures compose a minimal server x 2 clients protocol; each rule's
+    positive mutation is judged in isolation via ``select`` (the
+    temporal rules deliberately co-fire with their rule-based twins on
+    shared seeds)."""
+
+    FSM_PATH = "fedml_tpu/core/fsm_fake.py"
+
+    BASE = (
+        "import logging\n"
+        "from fedml_tpu.core.managers import ClientManager, ServerManager\n"
+        "from fedml_tpu.core.comm.base import MSG_TYPE_PEER_LOST\n"
+        "from fedml_tpu.core.message import Message\n"
+        "MSG_SYNC = 'sync'\n"
+        "MSG_REPORT = 'report'\n"
+        "class Srv(ServerManager):\n"
+        "    def register_message_receive_handlers(self):\n"
+        "        self.register_message_receive_handler(MSG_REPORT,\n"
+        "                                              self._on_report)\n"
+        "        self.register_message_receive_handler(MSG_TYPE_PEER_LOST,\n"
+        "                                              self._on_lost)\n"
+        "    def open_round(self):\n"
+        "        self.send_message(Message(MSG_SYNC, 0, 1))\n"
+        "    def _on_report(self, msg):\n"
+        "        logging.debug('report from %s', msg.get_sender_id())\n"
+        "        self.folded.add(msg.get_sender_id())\n"
+        "    def _on_lost(self, msg):\n"
+        "        logging.warning('rank %s lost', msg.get_sender_id())\n"
+        "        self.cohort.discard(msg.get_sender_id())\n"
+        "class Cli(ClientManager):\n"
+        "    def register_message_receive_handlers(self):\n"
+        "        self.register_message_receive_handler(MSG_SYNC,\n"
+        "                                              self._on_sync)\n"
+        "        self.register_message_receive_handler(MSG_TYPE_PEER_LOST,\n"
+        "                                              self._on_cli_lost)\n"
+        "    def _on_sync(self, msg):\n"
+        "        self.send_message(Message(MSG_REPORT, 1, 0))\n"
+        "    def _on_cli_lost(self, msg):\n"
+        "        self.finish()\n")
+
+    def _select(self, src, code):
+        return lint_source(src, path=self.FSM_PATH, select={code})
+
+    def test_base_protocol_verifies_clean(self):
+        # liveness + safety both hold on the healthy composition
+        assert codes(self.BASE, path=self.FSM_PATH) == []
+
+    # FL140 ---------------------------------------------------------------
+    def test_fl140_inert_peer_lost_handler_wedges_round(self):
+        # the peer-lost policy is log-and-ignore and there is no deadline
+        # machinery: killing one client leaves the round waiting on a
+        # report that can never come -- a reachable deadlock
+        src = self.BASE.replace(
+            "        logging.warning('rank %s lost', msg.get_sender_id())\n"
+            "        self.cohort.discard(msg.get_sender_id())\n",
+            "        logging.warning('rank %s lost', msg.get_sender_id())\n")
+        found = self._select(src, "FL140")
+        assert [f.code for f in found] == ["FL140"]
+        assert "kill" in found[0].message
+        assert "no enabled transition" in found[0].message
+        # the fair path still decides: no FL141 on the same seed
+        assert self._select(src, "FL141") == []
+
+    def test_fl140_shedding_peer_lost_handler_clean(self):
+        assert self._select(self.BASE, "FL140") == []
+
+    # FL141 ---------------------------------------------------------------
+    def test_fl141_unfolded_report_hangs_fair_path(self):
+        # the server's report handler goes log-only: every frame is
+        # delivered, nothing advances -- round 0 never decides
+        src = self.BASE.replace(
+            "        logging.debug('report from %s', msg.get_sender_id())\n"
+            "        self.folded.add(msg.get_sender_id())\n",
+            "        logging.debug('report from %s', msg.get_sender_id())\n")
+        found = self._select(src, "FL141")
+        assert [f.code for f in found] == ["FL141"]
+        assert "round 0" in found[0].message
+        assert "fault-free" in found[0].message
+
+    def test_fl141_replying_protocol_clean(self):
+        assert self._select(self.BASE, "FL141") == []
+
+    # FL142 ---------------------------------------------------------------
+    def test_fl142_inert_drive_handler_flagged(self):
+        # type-level pairing is clean (the class does send MSG_REPORT,
+        # from late_report) but the REGISTERED sync handler is inert:
+        # the delivery is consumed in-state without progress
+        src = self.BASE.replace(
+            "    def _on_sync(self, msg):\n"
+            "        self.send_message(Message(MSG_REPORT, 1, 0))\n",
+            "    def _on_sync(self, msg):\n"
+            "        logging.debug('sync seen (round %s)',\n"
+            "                      msg.get('round'))\n"
+            "    def late_report(self):\n"
+            "        self.send_message(Message(MSG_REPORT, 1, 0))\n")
+        found = self._select(src, "FL142")
+        assert len(found) == 1
+        assert "`Cli._on_sync`" in found[0].message
+        assert "'sync'" in found[0].message or "sync" in found[0].message
+
+    def test_fl142_delegating_handler_clean(self):
+        # delegation through own state (self.trainer.step) is progress
+        src = self.BASE.replace(
+            "    def _on_sync(self, msg):\n"
+            "        self.send_message(Message(MSG_REPORT, 1, 0))\n",
+            "    def _on_sync(self, msg):\n"
+            "        self.trainer.step(msg.get('params'))\n"
+            "        self.send_message(Message(MSG_REPORT, 1, 0))\n")
+        assert src != self.BASE
+        assert self._select(src, "FL142") == []
+
+    # FL143 ---------------------------------------------------------------
+    JOIN_IMPORT = ("from fedml_tpu.core.comm.base import "
+                   "MSG_TYPE_PEER_LOST\n")
+    JOIN_BOTH = ("from fedml_tpu.core.comm.base import (MSG_TYPE_PEER_JOIN,\n"
+                 "                                      MSG_TYPE_PEER_LOST)\n")
+
+    def test_fl143_missing_join_handler_strands_rejoiner(self):
+        # the module speaks the rejoin vocabulary but the server never
+        # registers PEER_JOIN: a shed rank that dials back in stays
+        # outside every future cohort
+        src = self.BASE.replace(self.JOIN_IMPORT, self.JOIN_BOTH)
+        found = self._select(src, "FL143")
+        assert [f.code for f in found] == ["FL143"]
+        assert "PEER_JOIN" in found[0].message
+        assert "stranded" in found[0].message
+
+    def test_fl143_readmitting_join_handler_clean(self):
+        src = self.BASE.replace(self.JOIN_IMPORT, self.JOIN_BOTH).replace(
+            "        self.register_message_receive_handler(MSG_TYPE_PEER_LOST,\n"
+            "                                              self._on_lost)\n"
+            "    def open_round(self):\n",
+            "        self.register_message_receive_handler(MSG_TYPE_PEER_LOST,\n"
+            "                                              self._on_lost)\n"
+            "        self.register_message_receive_handler(MSG_TYPE_PEER_JOIN,\n"
+            "                                              self._on_join)\n"
+            "    def _on_join(self, msg):\n"
+            "        logging.warning('rank %s rejoined', msg.get_sender_id())\n"
+            "        self.cohort.add(msg.get_sender_id())\n"
+            "    def open_round(self):\n")
+        assert self._select(src, "FL143") == []
+
+    # -- the ISSUE's temporal acceptance fixture --------------------------
+    def test_acceptance_fl141_deleted_report_registration_names_round(self):
+        # the temporal twin of the FL120 revert fixture: deleting the
+        # MSG_C2S_REPORT registration must yield exactly one FL141 whose
+        # trace names the hung round and the delivery nobody folds
+        rel = "fedml_tpu/resilience/integration.py"
+        with open(os.path.join(REPO_ROOT, rel), encoding="utf-8") as fh:
+            src = fh.read()
+        needle = ("        self.register_message_receive_handler("
+                  "MSG_C2S_REPORT,\n"
+                  "                                              "
+                  "self._on_report)\n")
+        assert needle in src, "integration.py registration shape changed"
+        assert lint_source(src, path=rel, select={"FL141"}) == []
+        found = lint_source(src.replace(needle, ""), path=rel,
+                            select={"FL141"})
+        assert [f.code for f in found] == ["FL141"]
+        assert "round 0" in found[0].message
+        assert "res_report" in found[0].message
+
+    # -- two-tier fan-in composition (net/fanin.py) -----------------------
+    def _two_tier_index(self):
+        import ast as ast_mod
+        from fedml_tpu.analysis.protocol import ProtocolIndex
+        index = ProtocolIndex()
+        for rel in ("fedml_tpu/net/fanin.py",
+                    "fedml_tpu/resilience/async_agg.py",
+                    "fedml_tpu/resilience/integration.py",
+                    "fedml_tpu/resilience/policy.py"):
+            with open(os.path.join(REPO_ROOT, rel),
+                      encoding="utf-8") as fh:
+                index.add_module(rel, ast_mod.parse(fh.read()))
+        return index
+
+    def test_two_tier_healthy_topology_verifies_clean(self):
+        from fedml_tpu.analysis.modelcheck import verify_two_tier
+        out = verify_two_tier(self._two_tier_index(),
+                              coordinator="AsyncBufferedFedAvgServer")
+        assert out["decided"]
+        assert [c.code for c in out["findings"]] == []
+        assert out["relay"] == "_EdgeDownlink"
+
+    def test_two_tier_below_quorum_edge_fl141_clean(self):
+        # pre-seed edge 0's whole leaf star dead: the edge round resolves
+        # abandoned and forwards NOTHING -- the coordinator's flush
+        # deadline / staleness machinery must absorb the hole (the
+        # behavior the multi-tier arc relies on)
+        from fedml_tpu.analysis.modelcheck import verify_two_tier
+        out = verify_two_tier(self._two_tier_index(),
+                              coordinator="AsyncBufferedFedAvgServer",
+                              lost_leaves=(100, 101))
+        assert out["decided"]
+        assert [c.code for c in out["findings"]
+                if c.code == "FL141"] == []
+        assert [c.code for c in out["findings"]] == []
+
+    def test_real_topologies_verify_clean(self):
+        # composed sync + async-buffered + two-tier fan-in: the whole
+        # resilience/net control plane under the model checker alone
+        found = lint_paths(
+            [os.path.join(REPO_ROOT, "fedml_tpu/resilience"),
+             os.path.join(REPO_ROOT, "fedml_tpu/net")],
+            select={"FL140", "FL141", "FL142", "FL143"})
+        assert [f.code for f in found] == []
+
+    def test_rules_catalog_and_sarif_tags(self):
+        from fedml_tpu.analysis.linter import RULES, rule_tags
+        for code in ("FL140", "FL141", "FL142", "FL143"):
+            assert code in RULES
+            assert rule_tags(code) == ["fedcheck-model"]
