@@ -959,6 +959,113 @@ def run_soak_bench(args):
     return 0
 
 
+def run_tree_soak_bench(args):
+    """``--tree_soak [N]``: the process-tree federation bench
+    (fedml_tpu.topology). N leaves shard across a REAL tree of edge
+    processes (``--tree_fanout``), each bottom edge driving its own
+    soak swarm; the coordinator folds the edges' (compressed) upstream
+    reports. One JSON record: leaf reports/sec through the whole tree,
+    supervision counters (a clean run kills nothing and leaves no
+    zombies), and the per-tier status.json audit -- every tier must
+    parse and agree on the RoundProgram's invariant core
+    (topology.tree.manifest_core), which is the CI gate's evidence
+    that per-tier steering evolved knobs without forking the program.
+    run_tree itself appends the headline tree-soak row plus one
+    reports/sec row per edge tier member to --ledger."""
+    import tempfile
+
+    from fedml_tpu.topology import TreeSpec, manifest_core, run_tree
+
+    fanout = tuple(int(f) for f in str(args.tree_fanout).split(","))
+    n = int(args.tree_soak)
+    n_bottom = 1
+    for f in fanout:
+        n_bottom *= f
+    leaves_per_edge = max(1, n // n_bottom)
+    d = tempfile.mkdtemp(prefix="bench_tree_")
+    trace_file = None
+    if args.soak_trace:
+        from fedml_tpu.resilience.faults import DiurnalTrace
+        if args.soak_trace == "diurnal":
+            trace_file = DiurnalTrace.example(dropout=0.0).to_file(
+                os.path.join(d, "tree_trace.json"))
+        else:
+            trace_file = args.soak_trace
+    steering = bool(args.tree_steering)
+    spec = TreeSpec(
+        fanout=fanout, leaves_per_edge=leaves_per_edge,
+        total_updates=int(args.soak_updates),
+        transport=args.tree_transport, compressor=args.compressor,
+        trace=trace_file, jitter_s=float(args.soak_jitter),
+        steering=steering,
+        # the knobs behind the committed steered-diurnal number: a real
+        # edge deadline so outage-dark leaves cannot wedge a round (the
+        # abandon-retry path re-runs it backed off), a flush deadline
+        # shorter than the outage so the coordinator's DEGRADED path is
+        # exercised, and a tier envelope the controllers steer inside
+        edge_deadline_s=8.0, flush_deadline_s=10.0,
+        tier_bounds={"deadline_s": [0.25, 120.0]} if steering else {})
+    init_params = {"w": np.zeros(int(args.soak_params), np.float32)}
+    t0 = time.time()
+    try:
+        res = run_tree(spec, d, init_params=init_params,
+                       join_timeout=max(300.0, n / 5.0),
+                       ledger_path=args.ledger or None)
+    except TimeoutError as e:
+        print(json.dumps({"metric": "tree-soak", "error": str(e)}),
+              flush=True)
+        return 1
+    wall_s = time.time() - t0
+    server = res["server"]
+    if server.failed is not None:
+        print(json.dumps({"metric": "tree-soak",
+                          "error": server.failed}), flush=True)
+        return 1
+    # the per-tier audit: one status.json per process in the tree, all
+    # final, all carrying the SAME program core (steered knobs aside)
+    expected_statuses = 1 + sum(
+        int(np.prod(fanout[:t + 1])) for t in range(len(fanout)))
+    cores = []
+    for name, st in sorted(res["statuses"].items()):
+        assert st.get("final") is True, (name, st.get("final"))
+        cores.append(manifest_core(st["program"]))
+    assert len(cores) == expected_statuses, (len(cores),
+                                             expected_statuses)
+    assert all(c == cores[0] for c in cores), "program cores diverged"
+    total_reports = sum(s.get("reports", 0)
+                        for ss in res["swarm_summaries"].values()
+                        for s in ss)
+    jitter_model = "diurnal-trace" if trace_file else "uniform"
+    comp_tag = f", {args.compressor} upstream" if args.compressor else ""
+    out = {
+        "metric": f"tree-soak leaf reports/sec through bench "
+                  f"({spec.n_leaves} leaves, fanout "
+                  f"{'x'.join(map(str, fanout))}, {spec.transport}, "
+                  f"{jitter_model}, "
+                  f"{'steered' if steering else 'fixed'}{comp_tag})",
+        "value": round(total_reports / max(wall_s, 1e-9), 1),
+        "unit": "reports/sec",
+        "leaves": spec.n_leaves,
+        "fanout": list(fanout),
+        "transport": spec.transport,
+        "compressor": args.compressor,
+        "jitter_model": jitter_model,
+        "steering": steering,
+        "updates": server.agg.version,
+        "reports": total_reports,
+        "statuses": len(cores),
+        "program_cores_match": True,
+        "respawned": res["respawned"],
+        "killed": res["killed"],
+        "zombies": res["zombies"],
+        "clients_dropped": server.counters["clients_dropped"],
+        "clients_rejoined": server.counters["clients_rejoined"],
+        "wall_s": round(wall_s, 3),
+    }
+    print(json.dumps(out), flush=True)
+    return 0 if res["zombies"] == 0 else 1
+
+
 def _sweep_params(model_name):
     """Model-shaped ``params`` pytree on CPU (shapes are what matter)."""
     import jax
@@ -1169,6 +1276,31 @@ def main():
                         "are identical at any setting -- only decode "
                         "throughput moves (decode_s_per_report on the "
                         "record)")
+    p.add_argument("--tree_soak", nargs="?", const=1000, type=int,
+                   default=None, metavar="N",
+                   help="process-tree soak bench (fedml_tpu.topology): "
+                        "N (default 1,000) leaves sharded across a "
+                        "REAL tree of edge processes (--tree_fanout), "
+                        "the coordinator folding the edges' upstream "
+                        "reports in this process; emits a JSON record "
+                        "with tree-wide leaf reports/sec + supervision "
+                        "counters and audits every tier's status.json "
+                        "(parseable, matching program core) -- the "
+                        "fedtree headline gate (docs/NETWORKING.md). "
+                        "Reuses --soak_updates/--soak_jitter/"
+                        "--soak_trace/--soak_params/--compressor")
+    p.add_argument("--tree_fanout", type=str, default="2",
+                   help="tree soak: comma-separated edge fan-out per "
+                        "tier, root-first ('2' = 2 edges; '2,2' = "
+                        "edges-of-edges, 4 bottom edges)")
+    p.add_argument("--tree_transport", default="eventloop",
+                   choices=("tcp", "eventloop"),
+                   help="tree soak: transport for every star in the "
+                        "tree")
+    p.add_argument("--tree_steering", action="store_true",
+                   help="tree soak: arm one PaceController per tier "
+                        "(coordinator + every edge), edge bounds "
+                        "clamped inside the coordinator's envelope")
     p.add_argument("--steering", action="store_true",
                    help="fedpace headline bench (resilience/steering.py):"
                         " on one seeded diurnal trace, run a small sweep "
@@ -1283,6 +1415,13 @@ def main():
         import jax
         jax.config.update("jax_platforms", "cpu")
         sys.exit(run_soak_bench(args))
+
+    if args.tree_soak:
+        # process-tree bench: the coordinator fold is the only jax
+        # touch; every other tier is its own subprocess on CPU
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        sys.exit(run_tree_soak_bench(args))
 
     if args.massive_cohort:
         # the workload is the cohort axis, not the model: runs on any

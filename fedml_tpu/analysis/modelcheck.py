@@ -3,10 +3,11 @@
 The rule-based protocol passes (FL120 sent-but-unhandled, FL127
 silent-hang handlers) judge one handler at a time.  This pass compiles
 the FSM classes ``protocol.py`` already extracts into abstract
-transition systems, composes server x N clients (and the two-tier
-EdgeAggregator topology) over a lossy, reordering channel with a
-bounded fault budget, and explores the composed state space with an
-explicit-state BFS -- so *temporal* failures (a round that can never
+transition systems, composes server x N clients (and the two- and
+three-tier EdgeAggregator topologies -- the relay stacked under
+itself is the edges-of-edges process tree) over a lossy, reordering
+channel with a bounded fault budget, and explores the composed state
+space with an explicit-state BFS -- so *temporal* failures (a round that can never
 reach a decision under a particular drop+rejoin interleaving, a
 message arriving in a state with no progress path) surface before the
 fan-in tree becomes processes.
@@ -80,6 +81,7 @@ _DEADLINE_FRAGMENTS = ("deadline", "timer", "timeout")
 # checking promises nothing beyond its budget) past these
 MAX_STATES_PAIR = 20000
 MAX_STATES_TIER = 40000
+MAX_STATES_TREE = 250000
 MAX_DEPTH = 80
 MAX_CHANNEL = 7
 MAX_COMPOSITIONS = 16
@@ -995,6 +997,299 @@ def discover_two_tier(specs):
     return out[:MAX_COMPOSITIONS]
 
 
+class ThreeTierModel:
+    """coordinator x E tier-1 relays x S tier-2 relays each x per-edge
+    leaves: the relay module stacked UNDER ITSELF (topology/'s
+    edges-of-edges process tree).  The same (coord, relay, leaf, down,
+    up) tuple :func:`discover_two_tier` yields composes one tier
+    deeper because the relay's uplink handles ``down`` and its
+    downlink handles ``up`` -- a tier-2 relay's upstream report is
+    indistinguishable, on the wire, from a leaf's.
+
+    Id planes: tier-1 edges ``0..E-1``; tier-2 edge ``s`` under tier-1
+    edge ``e`` is ``100*(e+1)+s``; leaf ``j`` under tier-2 edge ``t``
+    is ``100*t+j`` (>= 10000).  State: (cphase, coord_reports,
+    alive_edges, tier1, tier2, leaves, channel, budget, faulted) with
+    tier1/tier2 = ((ephase, folded-child set), ...).
+
+    Default fault budget is drops-only: one drop arms every tier's
+    deadline machinery, which is the hazard DISTINCTIVE to the deeper
+    tree (the abandon cascade -- an empty tier forwards nothing and
+    each parent must absorb the hole); leaf kills are the two-tier
+    model's job and triple the state space past any useful bound.
+    """
+
+    def __init__(self, coord, relay, leaf, down, up, edges=2,
+                 sub_edges=2, leaves_per_edge=1, budget=None,
+                 fair=False, lost_leaves=()):
+        self.coord = coord
+        self.relay = relay
+        self.leaf = leaf
+        self.down = down
+        self.up = up
+        self.E = edges
+        self.S = sub_edges
+        self.L = leaves_per_edge
+        self.budget = budget or FaultBudget(drops=1, dups=0, kills=0,
+                                            joins=0)
+        self.fair = fair
+        self.lost = frozenset(lost_leaves)
+        # sync/report fan-out headroom, same discipline as TwoTierModel
+        self._chan_cap = MAX_CHANNEL + edges * (1 + sub_edges
+                                                * (1 + leaves_per_edge))
+
+    def t2_id(self, e, s):
+        return 100 * (e + 1) + s
+
+    def leaf_id(self, e, s, j):
+        return 100 * self.t2_id(e, s) + j
+
+    def _t2_idx(self, tid):
+        return ((tid // 100) - 1) * self.S + tid % 100
+
+    def _lidx(self, lid):
+        return self._t2_idx(lid // 100) * self.L + lid % 100
+
+    def _t2_live(self, tidx, leaves):
+        base = tidx * self.L
+        e, s = divmod(tidx, self.S)
+        return frozenset(self.leaf_id(e, s, j) for j in range(self.L)
+                         if leaves[base + j] != DEAD)
+
+    def initial(self):
+        leaves = tuple(
+            DEAD if self.leaf_id(e, s, j) in self.lost else IDLE
+            for e in range(self.E) for s in range(self.S)
+            for j in range(self.L))
+        t1 = tuple((E_OPEN, frozenset()) for _ in range(self.E))
+        t2 = tuple((E_OPEN, frozenset())
+                   for _ in range(self.E * self.S))
+        chan = [(self.down, SERVER, e) for e in range(self.E)]
+        for lid in sorted(self.lost):
+            chan.append((PEER_LOST_VALUE, lid, lid // 100))
+        return (OPEN, frozenset(), frozenset(range(self.E)), t1, t2,
+                leaves, tuple(sorted(chan)), self.budget.tup(),
+                bool(self.lost))
+
+    def successors(self, st, events):
+        (cph, creps, aedges, t1, t2, leaves, chan, bud, faulted) = st
+        if cph != OPEN:
+            return
+        drops, dups, kills, joins = bud
+        seen = set()
+        for i, msg in enumerate(chan):
+            if msg in seen:
+                continue
+            seen.add(msg)
+            rest = chan[:i] + chan[i + 1:]
+            mtype, src, dst = msg
+            yield from self._deliver(mtype, src, dst, rest, st, events)
+            if not self.fair and drops:
+                yield ("drop %s" % mtype,
+                       (cph, creps, aedges, t1, t2, leaves, rest,
+                        (drops - 1, dups, kills, joins), True))
+        if not self.fair and kills:
+            for tidx in range(self.E * self.S):
+                for j in range(self.L):
+                    if leaves[tidx * self.L + j] == DEAD:
+                        continue
+                    e, s = divmod(tidx, self.S)
+                    lid = self.leaf_id(e, s, j)
+                    nl = _tset(leaves, tidx * self.L + j, DEAD)
+                    nchan = tuple(sorted(
+                        chan + ((PEER_LOST_VALUE, lid, lid // 100),)))
+                    yield ("kill leaf%d" % lid,
+                           (cph, creps, aedges, t1, t2, nl, nchan,
+                            (drops, dups, kills - 1, joins), True))
+                    break  # one representative per tier-2 edge
+        if faulted:
+            # per-tier deadlines, bottom-up identity: an edge with
+            # folded children resolves degraded and reports upstream;
+            # an empty one abandons and forwards NOTHING (the local
+            # retry is invisible one tier up -- the parent's own
+            # deadline machinery must absorb the hole either way)
+            for tidx in range(self.E * self.S):
+                eph, ereps = t2[tidx]
+                if eph != E_OPEN:
+                    continue
+                e, s = divmod(tidx, self.S)
+                if ereps:
+                    nt2 = _tset(t2, tidx, (E_REPORTED, ereps))
+                    nchan = tuple(sorted(
+                        chan + ((self.up, self.t2_id(e, s), e),)))
+                    yield ("deadline tier2-edge%d: degraded, reports "
+                           "upstream" % self.t2_id(e, s),
+                           (cph, creps, aedges, t1, nt2, leaves, nchan,
+                            bud, faulted))
+                else:
+                    nt2 = _tset(t2, tidx, (E_ABANDONED, ereps))
+                    yield ("deadline tier2-edge%d: abandoned, forwards "
+                           "nothing" % self.t2_id(e, s),
+                           (cph, creps, aedges, t1, nt2, leaves, chan,
+                            bud, faulted))
+            for e in range(self.E):
+                eph, ereps = t1[e]
+                if eph != E_OPEN:
+                    continue
+                if ereps:
+                    nt1 = _tset(t1, e, (E_REPORTED, ereps))
+                    nchan = tuple(sorted(chan + ((self.up, e, SERVER),)))
+                    yield ("deadline tier1-edge%d: degraded, reports "
+                           "upstream" % e,
+                           (cph, creps, aedges, nt1, t2, leaves, nchan,
+                            bud, faulted))
+                else:
+                    nt1 = _tset(t1, e, (E_ABANDONED, ereps))
+                    yield ("deadline tier1-edge%d: abandoned, forwards "
+                           "nothing" % e,
+                           (cph, creps, aedges, nt1, t2, leaves, chan,
+                            bud, faulted))
+            if self.coord.has_deadline:
+                outcome = "degraded" if creps else "abandoned"
+                yield ("deadline coordinator: round 0 resolved %s "
+                       "(staleness machinery absorbs the missing edge "
+                       "report)" % outcome,
+                       (DONE if creps else FAILED, creps, aedges, t1,
+                        t2, leaves, chan, bud, faulted))
+
+    def _deliver(self, mtype, src, dst, rest, st, events):
+        (cph, creps, aedges, t1, t2, leaves, _chan, bud, faulted) = st
+        base = (cph, creps, aedges, t1, t2, leaves, rest, bud, faulted)
+        if dst == SERVER:  # coordinator plane
+            label = "deliver %s tier1-edge%s->coordinator" % (mtype, src)
+            if mtype == PEER_LOST_VALUE:
+                yield (label, base)
+                return
+            spec = self.coord.handlers.get(mtype)
+            if spec is None or spec.inert:
+                if spec is not None and spec.inert:
+                    events.add(("FL142", self.coord, mtype, spec, label))
+                yield (label + " (not folded)", base)
+                return
+            ncreps = creps | {src}
+            ncph = DONE if ncreps >= aedges else cph
+            yield (label,
+                   (ncph, ncreps, aedges, t1, t2, leaves, rest, bud,
+                    faulted))
+            return
+        if dst < 100:  # tier-1 edge plane
+            e = dst
+            eph, ereps = t1[e]
+            label = "deliver %s %s->tier1-edge%d" % (
+                mtype, _who(src) if src == SERVER
+                else "tier2-edge%d" % src, e)
+            if mtype == self.down and eph == E_OPEN:
+                out = list(rest)
+                for s in range(self.S):  # open, sync the sub-edges
+                    out.append((self.down, e, self.t2_id(e, s)))
+                out = tuple(sorted(out))
+                yield (label + " (edge opens, syncs sub-edges)",
+                       (cph, creps, aedges, t1, t2, leaves,
+                        out if len(out) <= self._chan_cap else rest,
+                        bud, faulted))
+                return
+            if mtype == self.up and eph == E_OPEN:
+                spec = self.relay.handlers.get(mtype)
+                if spec is not None and spec.inert:
+                    events.add(("FL142", self.relay, mtype, spec, label))
+                    yield (label + " (handler inert)", base)
+                    return
+                ereps2 = ereps | {src}
+                # sub-edges never die in this model: quorum = all of them
+                if len(ereps2) >= self.S:
+                    nt1 = _tset(t1, e, (E_REPORTED, ereps2))
+                    nchan = tuple(sorted(rest + ((self.up, e, SERVER),)))
+                    yield (label + " (quorum: edge reports upstream)",
+                           (cph, creps, aedges, nt1, t2, leaves, nchan,
+                            bud, faulted))
+                else:
+                    nt1 = _tset(t1, e, (eph, ereps2))
+                    yield (label,
+                           (cph, creps, aedges, nt1, t2, leaves, rest,
+                            bud, faulted))
+                return
+            yield (label + " (consumed)", base)
+            return
+        if dst < 10000:  # tier-2 edge plane
+            tid = dst
+            tidx = self._t2_idx(tid)
+            eph, ereps = t2[tidx]
+            e = (tid // 100) - 1
+            label = "deliver %s %s->tier2-edge%d" % (
+                mtype, "tier1-edge%d" % src if src < 100
+                else "leaf%d" % src, tid)
+            if mtype == self.down and eph == E_OPEN:
+                out = list(rest)
+                for j in range(self.L):
+                    out.append((self.down, tid, 100 * tid + j))
+                out = tuple(sorted(out))
+                yield (label + " (edge opens, syncs leaves)",
+                       (cph, creps, aedges, t1, t2, leaves,
+                        out if len(out) <= self._chan_cap else rest,
+                        bud, faulted))
+                return
+            if mtype == PEER_LOST_VALUE and eph == E_OPEN:
+                live = self._t2_live(tidx, leaves) - {src}
+                ereps2 = ereps - {src}
+                if live and ereps2 >= live:
+                    nt2 = _tset(t2, tidx, (E_REPORTED, ereps2))
+                    nchan = tuple(sorted(rest + ((self.up, tid, e),)))
+                    yield (label + " (edge sheds, resolves, reports)",
+                           (cph, creps, aedges, t1, nt2, leaves, nchan,
+                            bud, faulted))
+                else:
+                    nt2 = _tset(t2, tidx, (eph, ereps2))
+                    yield (label + " (edge sheds leaf)",
+                           (cph, creps, aedges, t1, nt2, leaves, rest,
+                            bud, faulted))
+                return
+            if mtype == self.up and eph == E_OPEN:
+                spec = self.relay.handlers.get(mtype)
+                if spec is not None and spec.inert:
+                    events.add(("FL142", self.relay, mtype, spec, label))
+                    yield (label + " (handler inert)", base)
+                    return
+                ereps2 = ereps | {src}
+                live = self._t2_live(tidx, leaves)
+                if live and ereps2 >= live:
+                    nt2 = _tset(t2, tidx, (E_REPORTED, ereps2))
+                    nchan = tuple(sorted(rest + ((self.up, tid, e),)))
+                    yield (label + " (quorum: edge reports upstream)",
+                           (cph, creps, aedges, t1, nt2, leaves, nchan,
+                            bud, faulted))
+                else:
+                    nt2 = _tset(t2, tidx, (eph, ereps2))
+                    yield (label,
+                           (cph, creps, aedges, t1, nt2, leaves, rest,
+                            bud, faulted))
+                return
+            yield (label + " (consumed)", base)
+            return
+        # leaf plane
+        lid = dst
+        li = self._lidx(lid)
+        label = "deliver %s tier2-edge%d->leaf%d" % (mtype, src, lid)
+        if leaves[li] == DEAD:
+            yield (label + " (leaf dead)", base)
+            return
+        if mtype == self.down:
+            spec = self.leaf.handlers.get(mtype)
+            nl = _tset(leaves, li, CDONE)
+            if spec is not None and spec.inert:
+                events.add(("FL142", self.leaf, mtype, spec, label))
+                yield (label + " (handler inert: no report)",
+                       (cph, creps, aedges, t1, t2, nl, rest, bud,
+                        faulted))
+                return
+            nchan = tuple(sorted(rest + ((self.up, lid, src),)))
+            yield (label + " (leaf trains, reports)",
+                   (cph, creps, aedges, t1, t2, nl,
+                    nchan if len(nchan) <= self._chan_cap else rest,
+                    bud, faulted))
+            return
+        yield (label + " (consumed)", base)
+
+
 # -- the lint pass ---------------------------------------------------------
 
 def verify_pair(server, client, drive, replies, emit=None,
@@ -1038,14 +1333,26 @@ def check_model(index, emit):
     """
     specs = compile_specs(index)
     pairs = discover_pairs(specs)
-    fl142_seen, fl143_seen = set(), set()
+    fl142_seen, fl143_seen, cex_seen = set(), set(), set()
+
+    def emit_cex(cex, topo):
+        # one finding per defect site: the same missing fold path hangs
+        # every composition that drives the server, so dedup liveness
+        # counterexamples on (code, module, role) -- the first
+        # (shortest-trace) composition reports it
+        key = (cex.code, cex.spec.module, cex.spec.name)
+        if key in cex_seen:
+            return
+        cex_seen.add(key)
+        _emit_counterexample(emit, cex, topo)
+
     for srv, cli, drive, replies in pairs:
         topo = ("`%s` x 2 `%s` (drive '%s')" % (srv.name, cli.name, drive))
         fair_res, full_res, events = verify_pair(srv, cli, drive, replies)
         if fair_res.capped or full_res.capped:
             continue  # out of budget: bounded checking promises nothing
         for cex in fair_res.counterexamples + full_res.counterexamples:
-            _emit_counterexample(emit, cex, topo)
+            emit_cex(cex, topo)
         _emit_events(emit, events, fl142_seen, fl143_seen, topo)
     for coord, relay, leaf, down, up in discover_two_tier(specs):
         topo = ("two-tier `%s` <- `%s` relay <- `%s` leaves"
@@ -1061,8 +1368,26 @@ def check_model(index, emit):
         if fair_res.capped or full_res.capped:
             continue
         for cex in fair_res.counterexamples + full_res.counterexamples:
-            _emit_counterexample(emit, cex, topo)
+            emit_cex(cex, topo)
         _emit_events(emit, events, fl142_seen, fl143_seen, topo)
+        # the same tuple stacks the relay under itself: edges-of-edges
+        # (topology/'s fanout=(2, 2) process tree), one tier deeper
+        topo3 = ("three-tier `%s` <- `%s` <- `%s` relays <- `%s` leaves"
+                 % (coord.name, relay.name, relay.name, leaf.name))
+        events3 = set()
+        fair3 = ThreeTierModel(coord, relay, leaf, down, up, fair=True,
+                               budget=FaultBudget(0, 0, 0, 0))
+        fair3_res = explore_two_tier(fair3, MAX_STATES_TREE, "FL141",
+                                     events3)
+        full3 = ThreeTierModel(coord, relay, leaf, down, up, fair=False)
+        full3_res = explore_two_tier(full3, MAX_STATES_TREE, "FL140",
+                                     events3)
+        if fair3_res.capped or full3_res.capped:
+            continue
+        for cex in (fair3_res.counterexamples
+                    + full3_res.counterexamples):
+            emit_cex(cex, topo3)
+        _emit_events(emit, events3, fl142_seen, fl143_seen, topo3)
 
 
 def _emit_events(emit, events, fl142_seen, fl143_seen, topo):
@@ -1134,6 +1459,43 @@ def verify_two_tier(index, coordinator=None, lost_leaves=(),
                             leaves_per_edge=leaves_per_edge, fair=False,
                             lost_leaves=lost_leaves)
         fres = explore_two_tier(full, MAX_STATES_TIER, "FL140", events)
+        out["findings"].extend(fres.counterexamples)
+        out["full_states"] = fres.states
+    out["events"] = events
+    return out
+
+
+def verify_three_tier(index, coordinator=None, lost_leaves=(),
+                      edges=2, sub_edges=2, leaves_per_edge=1,
+                      fair_only=False):
+    """Public API for the edges-of-edges topology pinning tests:
+    :func:`verify_two_tier` one tier deeper -- the discovered relay
+    stacked under itself (the process tree's ``fanout=(2, 2)`` shape).
+    ``lost_leaves`` pre-seeds dead leaves by their three-tier id
+    (``100*(100*(e+1)+s)+j``).  -> same result dict shape."""
+    specs = compile_specs(index)
+    tiers = discover_two_tier(specs)
+    if coordinator is not None:
+        tiers = [t for t in tiers if t[0].name == coordinator]
+    if not tiers:
+        raise ValueError("no relay topology discoverable in fileset")
+    coord, relay, leaf, down, up = tiers[0]
+    events = set()
+    model = ThreeTierModel(coord, relay, leaf, down, up, edges=edges,
+                           sub_edges=sub_edges,
+                           leaves_per_edge=leaves_per_edge, fair=True,
+                           budget=FaultBudget(0, 0, 0, 0),
+                           lost_leaves=lost_leaves)
+    res = explore_two_tier(model, MAX_STATES_TREE, "FL141", events)
+    out = {"findings": list(res.counterexamples), "decided": res.decided,
+           "states": res.states, "coordinator": coord.name,
+           "relay": relay.name, "leaf": leaf.name}
+    if not fair_only:
+        full = ThreeTierModel(coord, relay, leaf, down, up, edges=edges,
+                              sub_edges=sub_edges,
+                              leaves_per_edge=leaves_per_edge,
+                              fair=False, lost_leaves=lost_leaves)
+        fres = explore_two_tier(full, MAX_STATES_TREE, "FL140", events)
         out["findings"].extend(fres.counterexamples)
         out["full_states"] = fres.states
     out["events"] = events

@@ -35,16 +35,22 @@ the event loop.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import threading
+import time
 from typing import Optional
 
 import numpy as np
 
+from fedml_tpu.compression.wire import (WIRE_DELTA_KEY, WIRE_SPEC_KEY,
+                                        CompressedUpdate, ef_step,
+                                        encode_rng, host_compressor)
 from fedml_tpu.core.comm.base import (MSG_TYPE_PEER_JOIN,
                                       MSG_TYPE_PEER_LOST)
 from fedml_tpu.core.managers import ClientManager, ServerManager
 from fedml_tpu.core.message import Message
+from fedml_tpu.observability.perfmon import get_perf_monitor
 from fedml_tpu.observability.tracing import get_tracer
 from fedml_tpu.resilience.integration import (MSG_C2S_REPORT, MSG_S2C_SYNC,
                                               ResilientFedAvgClient,
@@ -136,32 +142,70 @@ class EdgeAggregator:
     ``RoundPolicy`` (deadline => partial aggregation over the reporting
     subset, exactly the synchronous server's semantics), and forward one
     pre-aggregated report tagged with ``v`` upstream. An edge round
-    abandoned below quorum forwards nothing -- the coordinator's
-    flush deadline / staleness machinery absorbs the hole.
+    abandoned below quorum re-runs locally (attempt + 1, after the
+    abandon-backoff steering decision) up to ``max_round_retries``;
+    only an exhausted version forwards nothing -- the coordinator's
+    flush deadline / staleness machinery absorbs that hole, and it can
+    only absorb it if SOME tier-1 edge eventually reports (an async
+    coordinator re-syncs on flushes; the local re-run is what keeps a
+    fully-abandoned version from wedging the tree).
     """
 
     def __init__(self, edge_rank, uplink_comm, uplink_size, downlink_comm,
                  downlink_size, round_policy: Optional[RoundPolicy] = None,
-                 retry_policy: Optional[RetryPolicy] = None):
+                 retry_policy: Optional[RetryPolicy] = None,
+                 compressor=None, pace_controller=None, tier=1,
+                 program=None):
         self.edge_rank = int(edge_rank)
+        self.tier = int(tier)
         # one RoundProgram per edge: the edge's round policy is its
         # cohort leg, and the decided-round fold runs through the
         # program's jax-free host view -- the same fold the coordinator
-        # and the sim engine execute
-        self.program = RoundProgram(cohort=round_policy or CohortPolicy())
+        # and the sim engine execute. A topology tree passes the ONE
+        # shared program (TreeSpec.round_program) so every tier's
+        # status.json carries the same manifest.
+        if program is not None:
+            # the manifest's codec leg names the TREE's upstream wire;
+            # whether THIS edge's own uplink compresses stays the
+            # explicit ``compressor`` arg (only the coordinator-facing
+            # hop does -- the expensive one)
+            self.program = (program if round_policy is None
+                            else program.replace(cohort=round_policy))
+        else:
+            self.program = RoundProgram(
+                cohort=round_policy or CohortPolicy(),
+                codec=compressor or "none")
         self._host = self.program.host_view()
         self.round_policy = self.program.cohort
         self.retry_policy = retry_policy or RetryPolicy()
+        # upstream wire compression: the edge ships its fold as an
+        # EF-compressed DELTA against the params the coordinator synced
+        # (which is exactly the base the coordinator retains for this
+        # rank's born version -- async_agg._report_payload_locked)
+        self._comp = host_compressor(compressor)
+        self._ef_residual = None
+        self.pace = pace_controller  # per-tier steering (None = fixed)
         self.alive = set(range(1, downlink_size))
         self.rounds_forwarded = 0
         self.rounds_abandoned = 0
+        self.rounds_preempted = 0
+        self.rounds_retried = 0
         self.leaves_rejoined = 0
-        # edge round bookkeeping (version/attempt of the open round) is
-        # only touched inside the controller callbacks + open_round, all
-        # of which run on this edge's two dispatcher threads; the
-        # controller itself is the thread-safe piece
+        self.leaves_resumed = 0
+        self.leaf_reports = 0
+        # edge round bookkeeping (version/attempt/params of the open
+        # round): open_round and the controller callbacks run on this
+        # edge's two dispatcher threads plus the deadline timer; _lock
+        # serializes their shared state (the controller itself is the
+        # thread-safe piece)
         self._version = None
         self._attempt = 0
+        self._params = None     # the open round's broadcast base
+        self._open = False      # an armed attempt not yet decided
+        self._round_t0 = None
+        self._pending_round_dt = None
+        self._last_selected = 0
+        self._last_outcome = None
         self._lock = threading.Lock()  # guards alive + _version/_attempt
         self._controller = RoundController(
             self.round_policy, self._on_edge_complete,
@@ -175,7 +219,28 @@ class EdgeAggregator:
     def open_round(self, params, version, attempt):
         with self._lock:
             alive = sorted(self.alive)
+            # preemption: the coordinator's flush deadline can sync
+            # version v+1 while this edge's round v is still collecting
+            # (an async coordinator never waits for every edge). The
+            # stale attempt is cancelled -- its late leaf reports land
+            # in the controller's late counter -- and the new version
+            # opens immediately; begin() would otherwise raise on the
+            # still-open attempt and kill the dispatcher thread.
+            preempt = self._open
             self._version, self._attempt = version, attempt
+            self._params = params
+            self._open = bool(alive)
+            self._round_t0 = (time.time()
+                              if get_perf_monitor() is not None else None)
+            if preempt:
+                self.rounds_preempted += 1
+            if alive:
+                self._last_selected = len(alive)
+        if preempt:
+            logging.warning("edge %d: version %s preempts a still-open "
+                            "edge round -- cancelling it", self.edge_rank,
+                            version)
+            self._controller.cancel()
         if not alive:
             logging.warning("edge %d: no alive leaves -- nothing to "
                             "fan out", self.edge_rank)
@@ -198,10 +263,36 @@ class EdgeAggregator:
                 pass  # leaf-lost dispatch already told the controller
 
     def on_leaf_report(self, msg):
+        mon = get_perf_monitor()
+        if mon is not None:
+            with self._lock:
+                t0 = (self._round_t0
+                      if (int(msg.get("round")) == self._version
+                          and int(msg.get("attempt")) == self._attempt)
+                      else None)
+            if t0 is not None:
+                # feeds THIS tier's straggler tail -- the histogram this
+                # edge's own PaceController windows over (per-process
+                # registry = per-tier distributions)
+                mon.observe_report_latency(time.time() - t0)
+        self.leaf_reports += 1
         self._controller.report(
             msg.get("round"), msg.get("attempt"), msg.get_sender_id(),
-            msg.get("num_samples"),
-            {k: np.asarray(v) for k, v in msg.get("params").items()})
+            msg.get("num_samples"), self._leaf_payload(msg))
+
+    def _leaf_payload(self, msg):
+        """Plain leaf reports stay numpy param dicts; a compressed leaf
+        report (``cdelta``) decodes against the open round's broadcast
+        base at fold time -- acceptance (round/attempt match) guarantees
+        the captured base IS the model this edge fanned out, the same
+        invariant integration._report_payload documents."""
+        enc = msg.get(WIRE_DELTA_KEY)
+        if enc is None:
+            return {k: np.asarray(v) for k, v in msg.get("params").items()}
+        with self._lock:
+            base = self._params
+        return CompressedUpdate(enc=enc, spec=str(msg.get(WIRE_SPEC_KEY)),
+                                base=base, base_key=0)
 
     def on_leaf_lost(self, rank):
         with self._lock:
@@ -210,31 +301,78 @@ class EdgeAggregator:
 
     def on_leaf_join(self, rank):
         """Rejoin at the edge tier: a shed leaf's fresh HELLO re-admits
-        it to this edge's alive set, so the next ``open_round`` fans out
-        to it again (same contract as the coordinator tier's
-        ``_on_peer_join``: the in-flight edge round is untouched --
-        fedmc FL143 pins that a rejoined leaf cannot stay stranded
-        outside every future cohort)."""
+        it to this edge's alive set AND resumes it into the edge round
+        in flight (``RoundController.admit`` + a mid-round SYNC with the
+        open round's base and context), so it contributes this round
+        instead of idling to the next -- the same mid-round delta
+        resume the coordinator tier runs (fedmc FL143 pins that a
+        rejoined leaf cannot stay stranded outside every cohort)."""
+        rank = int(rank)
+        sync = None
         with self._lock:
-            if int(rank) in self.alive:
+            if rank in self.alive:
                 logging.info("edge %d: duplicate leaf-join for rank %s "
                              "(already alive)", self.edge_rank, rank)
                 return
-            self.alive.add(int(rank))
+            self.alive.add(rank)
             self.leaves_rejoined += 1
-        logging.warning("edge %d: leaf rank %s rejoined -- eligible from "
-                        "the next edge round", self.edge_rank, rank)
+            if (self._open and self._controller.admit(
+                    self._version, self._attempt, rank)):
+                self.leaves_resumed += 1
+                sync = Message(MSG_S2C_SYNC, 0, rank)
+                sync.add("params", self._params)
+                sync.add("round", self._version)
+                sync.add("attempt", self._attempt)
+                get_tracer().inject(sync)
+        if sync is not None:
+            logging.warning("edge %d: leaf rank %s rejoined -- resumed "
+                            "into the open edge round", self.edge_rank,
+                            rank)
+            try:  # delivered OUTSIDE the lock, as everywhere
+                send_with_retry(self.downlink.com_manager, sync,
+                                self.retry_policy)
+            except (ConnectionError, OSError):
+                pass  # leaf-lost dispatch already told the controller
+        else:
+            logging.warning("edge %d: leaf rank %s rejoined -- eligible "
+                            "from the next edge round", self.edge_rank,
+                            rank)
+        self._report_health()
 
     def _on_edge_complete(self, reports, outcome):
-        params, total = self._host.fold_reports(reports)
+        with self._lock:  # steering replaces _host on a pace decision
+            host = self._host
+        params, total = host.fold_reports(reports)
         with self._lock:
             version = self._version
+            base = self._params
+            ordinal = self.rounds_forwarded
             self.rounds_forwarded += 1
+            self._open = False
+            self._last_outcome = outcome
+            if self._round_t0 is not None:
+                self._pending_round_dt = time.time() - self._round_t0
         logging.info("edge %d: %s with %d leaf report(s) -> forwarding "
                      "n=%s upstream (version %s)", self.edge_rank, outcome,
                      len(reports), total, version)
         out = Message(MSG_C2S_REPORT, self.edge_rank, 0)
-        out.add("params", params)
+        if self._comp is None or base is None:
+            out.add("params", params)
+        else:
+            # the compressed upstream wire: EF-encode the fold's delta
+            # against the synced base, rng keyed (edge_rank, version,
+            # ordinal) so two runs over the same schedule encode
+            # bit-identically (ordinal = forwarded-report count; in a
+            # fault-free run it equals the edge-round index)
+            base32 = {k: np.asarray(v, np.float32)
+                      for k, v in base.items()}
+            delta = {k: np.asarray(params[k], np.float32) - base32[k]
+                     for k in base32}
+            enc, _decoded, self._ef_residual = ef_step(
+                self._comp, delta, self._ef_residual,
+                encode_rng((self.edge_rank, version, ordinal)))
+            out.add(WIRE_DELTA_KEY, enc)
+            out.add(WIRE_SPEC_KEY, self._comp.spec)
         out.add("num_samples", float(total))
         out.add("round", version)
         out.add("attempt", 0)
@@ -244,14 +382,112 @@ class EdgeAggregator:
         except (ConnectionError, OSError):
             logging.warning("edge %d: upstream report failed (coordinator "
                             "lost?)", self.edge_rank)
+        self._steer(outcome, len(reports))
+        self._report_health()
 
     def _on_edge_abandoned(self, reports):
         with self._lock:
             self.rounds_abandoned += 1
-        logging.warning("edge %d: round abandoned with %d report(s) -- "
-                        "forwarding nothing (coordinator staleness/"
-                        "deadline machinery absorbs it)", self.edge_rank,
-                        len(reports))
+            self._open = False
+            self._last_outcome = "abandoned"
+            version, attempt = self._version, self._attempt
+            params = self._params
+        logging.warning("edge %d: round abandoned with %d report(s)",
+                        self.edge_rank, len(reports))
+        # abandon-backoff FIRST: the re-run attempt opens with a longer
+        # deadline, not the one that just starved
+        self._steer("abandoned", len(reports))
+        with self._lock:
+            # re-run locally (the sync server's abandoned-round
+            # semantics, per tier): an async coordinator only re-syncs
+            # on a flush, and a flush needs SOME tier-1 report -- if
+            # every edge abandoned one version and forwarded nothing,
+            # the whole tree would wedge. Bounded by the policy's
+            # max_round_retries; a newer sync that arrived meanwhile
+            # owns the round instead.
+            retry = (not self._open and self._version == version
+                     and self._attempt == attempt
+                     and attempt < self.round_policy.max_round_retries)
+            if retry:
+                self.rounds_retried += 1
+        if retry:
+            self.open_round(params, version, attempt + 1)
+        else:
+            logging.warning("edge %d: forwarding nothing for version %s "
+                            "(coordinator staleness/deadline machinery "
+                            "absorbs it)", self.edge_rank, version)
+        self._report_health()
+
+    def _steer(self, outcome, n_reports):
+        """One per-tier pace decision per decided edge round: this
+        edge's controller reads its OWN process's histograms (the leaf
+        star it serves), and its bounds were intersected with the
+        coordinator's (``PaceBounds.intersect``) at construction -- a
+        tier steers its leaf-facing deadline/overselect inside the
+        root's envelope, never outside it (the two-level control
+        problem, Bonawitz S3)."""
+        if self.pace is None:
+            return
+        with self._lock:  # one decision point at a time, as the law asks
+            dec = self.pace.decide(
+                outcome=outcome, selected=self._last_selected,
+                reporting=min(n_reports, self._last_selected),
+                obs=self.pace.observe_registry())
+            if (dec.deadline_s != self.round_policy.deadline_s
+                    or dec.overselect != self.round_policy.overselect):
+                self.round_policy = dataclasses.replace(
+                    self.round_policy, deadline_s=dec.deadline_s,
+                    overselect=dec.overselect)
+                self.program = self.program.replace(
+                    cohort=self.round_policy)
+                self._host = self.program.host_view()
+                self._controller.policy = self.round_policy
+                logging.info("edge %d: pace steering -> deadline %.3fs, "
+                             "overselect %.3f (%s)", self.edge_rank,
+                             dec.deadline_s, dec.overselect, dec.reason)
+
+    def status_fields(self) -> dict:
+        """Per-tier status.json snapshot: which program this tier is
+        executing, where its round cursor is, and its counters --
+        written through the StatusWriter (sorted keys, FL135-clean)."""
+        with self._lock:
+            fields = {
+                "server": "edge",
+                "tier": self.tier,
+                "edge_rank": self.edge_rank,
+                "round": self._version,
+                "attempt": self._attempt,
+                "last_outcome": self._last_outcome,
+                "alive_leaves": sorted(self.alive),
+                "rounds_forwarded": self.rounds_forwarded,
+                "rounds_abandoned": self.rounds_abandoned,
+                "rounds_preempted": self.rounds_preempted,
+                "rounds_retried": self.rounds_retried,
+                "leaf_reports": self.leaf_reports,
+                "leaves_rejoined": self.leaves_rejoined,
+                "leaves_resumed": self.leaves_resumed,
+                "program": self.program.manifest(),
+            }
+            if self.pace is not None:
+                fields["pace"] = self.pace.status_fields()
+        return fields
+
+    def _report_health(self):
+        """Status.json + round-pace snapshot for THIS tier's perf
+        monitor (each edge process arms its own via
+        ``observability.enable``). No-op when the monitor is off."""
+        mon = get_perf_monitor()
+        if mon is None:
+            return
+        fields = self.status_fields()
+        with self._lock:
+            dt, self._pending_round_dt = self._pending_round_dt, None
+        if dt is not None:
+            mon.observe_round(dt)
+        rph = mon.rounds_per_hour()
+        if rph is not None:
+            fields["rounds_per_hour"] = rph
+        mon.status_update(force=True, **fields)
 
     def shutdown(self):
         self._controller.cancel()
@@ -278,16 +514,22 @@ class EdgeAggregator:
 def run_fanin_fedavg(n_edges, leaves_per_edge, total_updates, async_policy,
                      init_params, round_policy=None, trainer=None,
                      fault_plan=None, transport="tcp", metrics_logger=None,
-                     host="localhost", timeout=60.0, join_timeout=120.0):
-    """Drive a full two-tier fan-in scenario in one process: a buffered-
-    async coordinator over ``n_edges`` edge aggregators, each owning
-    ``leaves_per_edge`` unchanged ``ResilientFedAvgClient`` leaves.
+                     host="localhost", timeout=60.0, join_timeout=120.0,
+                     compressor=None, sub_edges=None):
+    """Drive a full two- or three-tier fan-in scenario in one process: a
+    buffered-async coordinator over ``n_edges`` edge aggregators, each
+    owning ``leaves_per_edge`` unchanged ``ResilientFedAvgClient``
+    leaves -- or, with ``sub_edges=E2``, each owning ``E2`` second-tier
+    edge aggregators (edges-of-edges) that own the leaves.
 
     Leaves get GLOBAL ids via :func:`round_robin_groups` over the flat
-    leaf population (the same slices ``HierarchicalFedAvgAPI`` would
-    train as its group axis), and the default trainer is the global-id-
-    keyed quadratic oracle -- so tests can replicate the exact two-tier
-    fold host-side. Returns ``(coordinator_server, edges)``.
+    leaf population, nested per tier (the same slices
+    ``HierarchicalFedAvgAPI`` would train as its group axis), and the
+    default trainer is the global-id-keyed quadratic oracle -- so tests
+    can replicate the exact multi-tier fold host-side. ``compressor``
+    arms the compressed upstream wire on the coordinator-facing edges
+    (the tree's expensive hop); inner wires stay plain. Returns
+    ``(coordinator_server, edges)``.
     """
     import socket
 
@@ -313,40 +555,67 @@ def run_fanin_fedavg(n_edges, leaves_per_edge, total_updates, async_policy,
                               metrics_logger=metrics)
 
     base_trainer = trainer or quadratic_trainer()
-    n_leaves = n_edges * leaves_per_edge
+    fan_below = (sub_edges or 1) * leaves_per_edge
+    n_leaves = n_edges * fan_below
     groups = round_robin_groups(range(1, n_leaves + 1), n_edges)
     coord_port = free_port()
     edge_ports = [free_port() for _ in range(n_edges)]
     edges, threads = [], []
 
-    def run_leaf(edge_idx, local_rank, global_id):
-        comm = make_comm(edge_ports[edge_idx], local_rank,
-                         leaves_per_edge + 1)
+    def run_leaf(port, world, local_rank, global_id):
+        comm = make_comm(port, local_rank, world)
         if fault_plan is not None:
             comm = fault_plan.wrap(comm, global_id)
 
         def train(params, round_idx, _local):
             return base_trainer(params, round_idx, global_id)
 
-        fsm = ResilientFedAvgClient(None, comm, local_rank,
-                                    leaves_per_edge + 1, train)
+        fsm = ResilientFedAvgClient(None, comm, local_rank, world, train)
         fsm.run()
 
-    def run_edge(edge_idx):
-        # leaves dial this edge's port with retry; start them first, then
-        # bring the downlink server up (its ctor waits for their HELLOs)
-        for local_rank, gid in enumerate(groups[edge_idx], start=1):
+    def start_leaves(port, gids):
+        # leaves dial their edge's port with retry; start them first,
+        # then bring the downlink server up (its ctor waits for HELLOs)
+        for local_rank, gid in enumerate(gids, start=1):
             t = threading.Thread(target=run_leaf,
-                                 args=(edge_idx, local_rank, gid),
-                                 daemon=True,
-                                 name=f"leaf-{edge_idx}-{local_rank}")
+                                 args=(port, len(gids) + 1, local_rank,
+                                       gid),
+                                 daemon=True, name=f"leaf-{port}-{gid}")
             t.start()
             threads.append(t)
-        down = make_comm(edge_ports[edge_idx], 0, leaves_per_edge + 1)
+
+    def run_sub_edge(parent_port, local_rank, gids):
+        # an edge-of-edges: leaf star below, a plain upstream report to
+        # its parent edge (only the coordinator-facing hop compresses)
+        port = free_port()
+        start_leaves(port, gids)
+        down = make_comm(port, 0, len(gids) + 1)
+        up = make_comm(parent_port, local_rank, sub_edges + 1)
+        edge = EdgeAggregator(local_rank, up, sub_edges + 1, down,
+                              len(gids) + 1, round_policy=round_policy,
+                              tier=2)
+        edges.append(edge)
+        edge.run()
+
+    def run_edge(edge_idx):
+        if sub_edges:
+            subgroups = round_robin_groups(groups[edge_idx], sub_edges)
+            for s, gids in enumerate(subgroups, start=1):
+                t = threading.Thread(
+                    target=run_sub_edge,
+                    args=(edge_ports[edge_idx], s, gids), daemon=True,
+                    name=f"subedge-{edge_idx}-{s}")
+                t.start()
+                threads.append(t)
+            down_world = len(subgroups) + 1
+        else:
+            start_leaves(edge_ports[edge_idx], groups[edge_idx])
+            down_world = leaves_per_edge + 1
+        down = make_comm(edge_ports[edge_idx], 0, down_world)
         up = make_comm(coord_port, edge_idx + 1, n_edges + 1)
         edge = EdgeAggregator(edge_idx + 1, up, n_edges + 1, down,
-                              leaves_per_edge + 1,
-                              round_policy=round_policy)
+                              down_world, round_policy=round_policy,
+                              compressor=compressor, tier=1)
         edges.append(edge)
         edge.run()
 

@@ -43,12 +43,14 @@ _HDR = struct.Struct("!I")
 class _SwarmClient:
     """One multiplexed soak client: rx framing state + tx queue."""
 
-    __slots__ = ("sock", "rank", "tx", "rx_hdr", "rx_buf", "rx_view",
-                 "rx_got", "reports", "want_write", "due", "residual")
+    __slots__ = ("sock", "rank", "gid", "tx", "rx_hdr", "rx_buf",
+                 "rx_view", "rx_got", "reports", "want_write", "due",
+                 "residual")
 
-    def __init__(self, sock, rank):
+    def __init__(self, sock, rank, gid=None):
         self.sock = sock
         self.rank = rank
+        self.gid = rank if gid is None else gid
         self.tx = deque()
         self.rx_hdr = memoryview(bytearray(_HDR.size))
         self.rx_buf = None
@@ -74,7 +76,8 @@ def _quadratic_step(params, rank, lr=0.25):
 
 def run_swarm(host, port, clients, world_size, rank_base=1, jitter_s=0.0,
               seed=0, connect_timeout=120.0, idle_timeout=600.0,
-              trace_path=None, compressor=None):
+              trace_path=None, compressor=None, gid_base=None,
+              gid_stride=1):
     """Drive ``clients`` soak clients over one selector loop until the
     server stops or disconnects every one of them. Returns a summary
     dict (connections made, reports sent, wall seconds).
@@ -93,7 +96,17 @@ def run_swarm(host, port, clients, world_size, rank_base=1, jitter_s=0.0,
     ``compressor`` report keys) through the same numpy-only
     :mod:`fedml_tpu.compression.wire` path the real client FSM uses --
     the swarm stays jax-free, and the async server folds the deltas
-    sparsely against each report's base version."""
+    sparsely against each report's base version.
+
+    ``gid_base``/``gid_stride`` shard one logical swarm across edge
+    processes of a federation tree: client ``i`` dials with LOCAL rank
+    ``rank_base + i`` (the leaf-star HELLO its edge expects) but keys
+    its oracle step, EF rng, and trace decisions by GLOBAL id
+    ``gid_base + i * gid_stride`` -- exactly the arithmetic slice
+    nested :func:`~fedml_tpu.net.fanin.round_robin_groups` assigns a
+    bottom edge, so a sharded tree run folds bitwise against the
+    single-tier host replication over the flat population. Default
+    (``gid_base=None``) keys by the transport rank, today's behavior."""
     from fedml_tpu.compression.codec import message_to_wire_views
     from fedml_tpu.compression.wire import ef_step, encode_rng, host_compressor
     from fedml_tpu.core.message import Message
@@ -123,7 +136,8 @@ def run_swarm(host, port, clients, world_size, rank_base=1, jitter_s=0.0,
         hello = json.dumps({"rank": rank}).encode()
         sock.sendall(_HDR.pack(len(hello)) + hello)
         sock.setblocking(False)
-        c = _SwarmClient(sock, rank)
+        gid = None if gid_base is None else gid_base + i * gid_stride
+        c = _SwarmClient(sock, rank, gid=gid)
         conns[rank] = c
         sel.register(sock, selectors.EVENT_READ, c)
     connected = len(conns)
@@ -184,13 +198,13 @@ def run_swarm(host, port, clients, world_size, rank_base=1, jitter_s=0.0,
             # seeded delay -- the realistic arrival curve. Trace time is
             # the generator's LAZY epoch (t=0 at the first reply), so the
             # connect burst of a big swarm cannot eat the first phases
-            action = gen.decide(c.rank, c.reports, gen.trace_time())
+            action = gen.decide(c.gid, c.reports, gen.trace_time())
             if action[0] == "drop":
                 dropped += 1
                 return
             delay = action[1]
         base = msg.get("params")
-        params, n = _quadratic_step(base, c.rank)
+        params, n = _quadratic_step(base, c.gid)
         version = int(msg.get("round"))
         out = Message("res_report", c.rank, 0)
         if comp is None:
@@ -205,7 +219,7 @@ def run_swarm(host, port, clients, world_size, rank_base=1, jitter_s=0.0,
                      - np.asarray(base[k], np.float32) for k in params}
             enc, _dec, c.residual = ef_step(
                 comp, delta, c.residual,
-                encode_rng((c.rank, version, c.reports)))
+                encode_rng((c.gid, version, c.reports)))
             out.add("cdelta", enc)
             out.add("compressor", comp.spec)
         out.add("num_samples", n)
@@ -381,14 +395,28 @@ def _main(argv=None):
                    help="wire-compression spec (qsgd/topk:R/signsgd): "
                         "ship compressed report deltas instead of "
                         "full params (compression.wire, numpy-only)")
+    p.add_argument("--rank_base", type=int, default=1,
+                   help="first LOCAL transport rank this shard dials "
+                        "with (an edge's leaf star expects 1..L)")
+    p.add_argument("--gid_base", type=int, default=None,
+                   help="first GLOBAL leaf id of this shard (tree "
+                        "sharding: keys the oracle/EF-rng/trace while "
+                        "the transport rank stays local)")
+    p.add_argument("--gid_stride", type=int, default=1,
+                   help="GLOBAL id stride between this shard's "
+                        "consecutive clients (the round-robin slice "
+                        "stride = the product of the tree's fan-outs)")
     args = p.parse_args(argv)
     if not args.swarm:
         p.error("only the --swarm role has a CLI; run_soak is the "
                 "parent-side API")
     logging.basicConfig(level=logging.INFO)
     summary = run_swarm(args.host, args.port, args.clients, args.world,
-                        jitter_s=args.jitter_s, seed=args.seed,
-                        trace_path=args.trace, compressor=args.compressor)
+                        rank_base=args.rank_base, jitter_s=args.jitter_s,
+                        seed=args.seed, trace_path=args.trace,
+                        compressor=args.compressor,
+                        gid_base=args.gid_base,
+                        gid_stride=args.gid_stride)
     sys.stdout.write(json.dumps(summary) + "\n")
     return 0
 

@@ -346,7 +346,7 @@ class ResilientFedAvgServer(ServerManager):
         self.reporting_log = []    # per-round sorted reporting ranks
         self.counters = {"rounds_degraded": 0, "rounds_abandoned": 0,
                          "clients_dropped": 0, "clients_rejoined": 0,
-                         "retries": 0, "resumes": 0}
+                         "clients_resumed": 0, "retries": 0, "resumes": 0}
         # closed-loop pace steering (resilience/steering.py): when armed,
         # every round decision re-derives deadline_s/overselect from the
         # windowed report-latency tail + observed loss fraction, within
@@ -645,10 +645,19 @@ class ResilientFedAvgServer(ServerManager):
     def _on_peer_join(self, msg):
         """Rejoin protocol: a previously shed/lost rank's fresh HELLO
         was accepted by the transport -- re-admit it to the alive set so
-        the next ``_open_round`` can sample it (mid-flight rounds are
-        untouched: the rank is not in the current cohort and a report
-        from it would land in the late counter)."""
+        the next ``_open_round`` can sample it, AND resume it into the
+        round in flight: the rank is admitted to the open attempt's
+        cohort (:meth:`RoundController.admit`) and handed the current
+        model with the round's (round, attempt) context, so it
+        contributes *this* round instead of idling to the next.
+        Re-admission shipped first (the alive-set half); this is the
+        work-resumption half -- ``clients_resumed`` counts the ranks
+        that actually got mid-round work. The resume never extends the
+        round: the target is unchanged, the deadline stays armed, and a
+        resumed rank that stays silent costs nothing over-selection
+        would not already cover."""
         rank = int(msg.get_sender_id())
+        sync = None
         with self._advance_lock:
             if self.failed is not None or rank in self.alive:
                 logging.info("server: peer-join for rank %d ignored "
@@ -656,8 +665,29 @@ class ResilientFedAvgServer(ServerManager):
                 return
             self.alive.add(rank)
             self.counters["clients_rejoined"] += 1
-        logging.warning("server: rank %d rejoined -- eligible from the "
-                        "next cohort", rank)
+            if self._controller.admit(self.round_idx, self.attempt, rank):
+                self.counters["clients_resumed"] += 1
+                m = Message(MSG_S2C_SYNC, 0, rank)
+                m.add("params", self.params)
+                m.add("round", self.round_idx)
+                m.add("attempt", self.attempt)
+                rspan = self._round_span
+                get_tracer().inject(
+                    m, None if rspan is None else rspan.context)
+                sync = m
+        if sync is not None:
+            logging.warning("server: rank %d rejoined -- resumed into "
+                            "round %d attempt %d", rank,
+                            int(sync.get("round")), int(sync.get("attempt")))
+            # delivered OUTSIDE the lock, same discipline as _send_syncs
+            try:
+                send_with_retry(self.com_manager, sync, self.retry_policy,
+                                counters=self.counters)
+            except (ConnectionError, OSError):
+                pass  # peer-lost dispatch already told the controller
+        else:
+            logging.warning("server: rank %d rejoined -- eligible from "
+                            "the next cohort", rank)
         self._report_health()
 
     def _report_health(self):
@@ -679,6 +709,7 @@ class ResilientFedAvgServer(ServerManager):
                 "outcome_counts": dict(self._outcomes),
                 "alive_ranks": sorted(self.alive),
                 "clients_dropped": self.counters["clients_dropped"],
+                "clients_resumed": self.counters["clients_resumed"],
             }
             if self.pace is not None:
                 fields["pace"] = self.pace.status_fields()

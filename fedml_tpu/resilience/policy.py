@@ -216,6 +216,26 @@ class RoundController:
             self._fire(decision)
         return True
 
+    def admit(self, round_idx, attempt, rank) -> bool:
+        """Mid-round cohort admission: add a rejoined rank to the OPEN
+        (round_idx, attempt) so its report is accepted into *this*
+        attempt instead of idling to the next round. The target is
+        unchanged -- the resumed rank fills in for a lost or straggling
+        cohort member rather than extending the round -- and a rank
+        counted lost is un-lost (its fresh report is the recovery the
+        resume exists for). Returns True when the rank was admitted;
+        False when nothing is open, the generation moved on, or the
+        rank is already in the cohort."""
+        rank = int(rank)
+        with self._lock:
+            if (self._decided or int(round_idx) != self._round
+                    or int(attempt) != self._attempt
+                    or rank in self._cohort):
+                return False
+            self._cohort.add(rank)
+            self._lost.discard(rank)
+            return True
+
     def peer_lost(self, rank) -> None:
         """A cohort member died mid-round. When everyone still outstanding
         is dead the attempt resolves immediately instead of burning the

@@ -723,4 +723,65 @@ echo "LM ledger gate: green on real runs, red on 2x MFU drop OK"
 rm -f "$LM_LEDGER"
 rm -rf "$WARM_CACHE"
 
+echo "== fedtree process-tree soak smoke (bench.py --tree_soak): 1,000"
+echo "   leaves sharded across 2 REAL edge processes (each edge: a"
+echo "   500-leaf eventloop star below, one qsgd-compressed EF wire"
+echo "   above), replaying the diurnal trace with one PaceController"
+echo "   per tier (edge bounds clamped inside the coordinator's)."
+echo "   Gates: (a) the coordinator completes every update with zero"
+echo "   zombie and zero force-killed processes; (b) every tier wrote"
+echo "   its own parseable status.json and all tiers agree on the"
+echo "   RoundProgram core (topology.tree.manifest_core -- steered"
+echo "   knobs excluded), asserted inside the bench and surfaced on"
+echo "   the record; (c) the throwaway ledger carries one reports/sec"
+echo "   row PER TIER MEMBER plus the tree headline, and"
+echo "   --check-regress fires both ways (green fresh, red on a"
+echo "   planted 2x throughput drop). The 10k+ tree is the slow-marked"
+echo "   tests/test_topology.py soak. fedlint (incl. the determinism"
+echo "   + fedmc model-checking passes) must stay at zero findings on"
+echo "   the new topology/ package =="
+python -m fedml_tpu.analysis fedml_tpu/topology/ > /dev/null \
+    && echo "fedlint on topology/: 0 findings"
+TREE_LEDGER=bench_results/ci_tree_ledger.jsonl
+rm -f "$TREE_LEDGER"
+timeout -k 10 600 python bench.py --tree_soak 1000 --tree_fanout 2 \
+    --soak_updates 3 --soak_trace diurnal --tree_steering \
+    --compressor qsgd --ledger "$TREE_LEDGER" \
+    > bench_results/bench_tree_smoke.json
+python - <<'EOF'
+import json
+rec = json.loads(open("bench_results/bench_tree_smoke.json").readline())
+assert rec["unit"] == "reports/sec" and rec["value"] > 0, rec
+assert rec["leaves"] == 1000 and rec["fanout"] == [2], rec
+assert rec["updates"] == 3, rec
+assert rec["zombies"] == 0 and rec["killed"] == 0, rec
+assert rec["statuses"] == 3 and rec["program_cores_match"] is True, rec
+led = [json.loads(l) for l in open("bench_results/ci_tree_ledger.jsonl")]
+tiers = [r for r in led if r["metric"].startswith("tree-edge")]
+head = [r for r in led if r["metric"].startswith("tree-soak")]
+assert len(tiers) == 2 and all(r["value"] > 0 for r in tiers), led
+assert len(head) == 1 and led[-1] is head[0], \
+    "the tree headline row must close the ledger"
+print("fedtree smoke:", rec["value"], "leaf reports/sec across the",
+      "process tree;", len(tiers), "per-tier ledger rows; statuses:",
+      rec["statuses"], "(program cores match)")
+EOF
+python bench.py --check-regress --ledger "$TREE_LEDGER"
+python - <<'EOF'
+import json
+from fedml_tpu.observability.perfmon import append_ledger
+led = [json.loads(l) for l in open("bench_results/ci_tree_ledger.jsonl")]
+head = [r for r in led if r["metric"].startswith("tree-soak")][-1]
+slow = dict(head)
+slow["value"] = head["value"] / 2.0  # the planted 2x throughput drop
+slow["injected_fixture"] = "2x-throughput-drop"
+append_ledger(slow, "bench_results/ci_tree_ledger.jsonl")
+EOF
+if python bench.py --check-regress --ledger "$TREE_LEDGER"; then
+    echo "tree perf-regression gate FAILED to fire on the 2x drop"
+    exit 1
+fi
+echo "fedtree ledger gate: green on the real record, red on 2x drop OK"
+rm -f "$TREE_LEDGER"
+
 echo "ci.sh: all green"

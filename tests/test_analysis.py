@@ -4095,9 +4095,71 @@ class TestModelCheck:
                 if c.code == "FL141"] == []
         assert [c.code for c in out["findings"]] == []
 
+    # -- three-tier edges-of-edges (topology/'s process tree) -------------
+    def test_three_tier_healthy_topology_verifies_clean(self):
+        # the relay stacked under itself: coordinator <- 2 edges <- 2
+        # sub-edges each <- leaves, fair + drops-only faulted runs
+        from fedml_tpu.analysis.modelcheck import verify_three_tier
+        out = verify_three_tier(self._two_tier_index(),
+                                coordinator="AsyncBufferedFedAvgServer")
+        assert out["decided"]
+        assert [c.code for c in out["findings"]] == []
+        assert out["relay"] == "_EdgeDownlink"
+
+    def test_three_tier_lost_leaf_abandon_cascade_clean(self):
+        # pre-seed sub-edge (0,1)'s only leaf dead: that tier-2 edge
+        # abandons and forwards nothing, its tier-1 parent's deadline
+        # absorbs the hole one tier up, the coordinator's one tier
+        # above that -- the cascade must still decide round 0
+        from fedml_tpu.analysis.modelcheck import verify_three_tier
+        out = verify_three_tier(self._two_tier_index(),
+                                coordinator="AsyncBufferedFedAvgServer",
+                                lost_leaves=(10100,), fair_only=True)
+        assert out["decided"]
+        assert [c.code for c in out["findings"]] == []
+
+    def test_acceptance_fl141_deleted_edge_report_registration(self):
+        # the ISSUE's revert fixture for the deeper tree: deleting the
+        # edge downlink's MSG_C2S_REPORT registration must yield
+        # exactly one FL141 naming the hung round and the report frame
+        # nobody folds (the per-site dedup collapses the per-client
+        # compositions onto the one defect)
+        import ast as ast_mod
+        from fedml_tpu.analysis.modelcheck import check_model
+        from fedml_tpu.analysis.protocol import ProtocolIndex
+        rel = "fedml_tpu/net/fanin.py"
+        with open(os.path.join(REPO_ROOT, rel), encoding="utf-8") as fh:
+            src = fh.read()
+        needle = ("        self.register_message_receive_handler("
+                  "MSG_C2S_REPORT,\n"
+                  "                                              "
+                  "self._on_report)\n")
+        assert needle in src, "fanin.py registration shape changed"
+
+        def run(fanin_src):
+            index = ProtocolIndex()
+            index.add_module(rel, ast_mod.parse(fanin_src))
+            for other in ("fedml_tpu/resilience/async_agg.py",
+                          "fedml_tpu/resilience/integration.py",
+                          "fedml_tpu/resilience/policy.py"):
+                with open(os.path.join(REPO_ROOT, other),
+                          encoding="utf-8") as fh:
+                    index.add_module(other, ast_mod.parse(fh.read()))
+            out = []
+            check_model(index,
+                        lambda m, n, c, msg: out.append((c, msg)))
+            return out
+
+        assert run(src) == []
+        found = run(src.replace(needle, ""))
+        assert [c for c, _m in found] == ["FL141"]
+        assert "round 0" in found[0][1]
+        assert "res_report" in found[0][1]
+
     def test_real_topologies_verify_clean(self):
-        # composed sync + async-buffered + two-tier fan-in: the whole
-        # resilience/net control plane under the model checker alone
+        # composed sync + async-buffered + two- and three-tier fan-in:
+        # the whole resilience/net control plane under the model
+        # checker alone
         found = lint_paths(
             [os.path.join(REPO_ROOT, "fedml_tpu/resilience"),
              os.path.join(REPO_ROOT, "fedml_tpu/net")],
