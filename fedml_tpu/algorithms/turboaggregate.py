@@ -32,7 +32,9 @@ class TurboAggregateAPI(FedAvgAPI):
             jax.vmap(make_client_update(spec, self.cfg),
                      in_axes=(None, 0, 0)))
         self.mpc_scale = getattr(args, "mpc_scale", 2 ** 16)
-        self._mpc_rng = np.random.default_rng(getattr(args, "seed", 0))
+        # the masking stream: derived from the run seed through the MPC
+        # salt (mpc.mask_rng), never an unseeded or constant default
+        self._mpc_rng = mpc.mask_rng(getattr(args, "seed", 0))
 
     def train_one_round(self):
         t0 = time.time()

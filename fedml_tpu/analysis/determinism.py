@@ -81,9 +81,14 @@ than a measurement idiom:
   built. Also an ``os.listdir``/``glob`` enumeration whose result is
   not normalized with ``sorted(``/``.sort()``.
 
-**Soundness limits (documented, deliberate).** Float folds with no
-syntactic ``float(`` evidence (a dict of floats summed raw) are
-invisible -- the pass has no type inference. FL132's taint is
+**Soundness limits (documented, deliberate).** Float evidence for
+FL131/FL134 is syntactic plus a light local inference: ``float(``
+calls, float literals, ``float``-annotated parameters/locals,
+literal propagation through assignments (to a fixpoint), and
+``@dataclass`` fields annotated ``float`` in the same module. A dict
+of floats summed raw, with none of that evidence anywhere in the
+function, is still invisible -- there is no interprocedural type
+inference, and int-only folds stay legal by construction. FL132's taint is
 intraprocedural plus the per-class attribute hop: a clock value
 laundered through a container element, a tuple unpack, or a method
 *return value* still escapes it. FL133 treats any non-constant
@@ -335,15 +340,93 @@ class DeterminismIndex:
 
 # -- rule implementations --------------------------------------------------
 
-def _float_evidence(expr):
-    """A ``float(...)`` call or float literal anywhere in ``expr``."""
+def _float_evidence(expr, env=frozenset(), float_attrs=frozenset()):
+    """Float-type evidence anywhere in ``expr``: a ``float(...)`` call,
+    a float literal, a local name the function-level inference proved
+    float (``env``, see :func:`_float_env`), or an attribute access
+    whose name is a dataclass ``float`` field in the same module
+    (``float_attrs``)."""
     for node in ast.walk(expr):
         if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
                 and node.func.id == "float":
             return True
         if isinstance(node, ast.Constant) and isinstance(node.value, float):
             return True
+        if isinstance(node, ast.Name) and node.id in env:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in float_attrs:
+            return True
     return False
+
+
+def _is_float_ann(ann):
+    """A ``float`` annotation (bare name or a string literal 'float')."""
+    if isinstance(ann, ast.Name) and ann.id == "float":
+        return True
+    return isinstance(ann, ast.Constant) and ann.value == "float"
+
+
+def _dataclass_float_fields(tree):
+    """Field names annotated ``float`` on ``@dataclass`` classes in this
+    module: accessing one (``self.lr``, ``cfg.deadline_s``) is float
+    evidence for FL131/FL134 regardless of receiver -- dataclass fields
+    are declared types, the strongest evidence this pass has."""
+    fields = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        names = set()
+        for dec in node.decorator_list:
+            d = dec.func if isinstance(dec, ast.Call) else dec
+            if isinstance(d, ast.Attribute):
+                names.add(d.attr)
+            elif isinstance(d, ast.Name):
+                names.add(d.id)
+        if "dataclass" not in names:
+            continue
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) \
+                    and isinstance(stmt.target, ast.Name) \
+                    and _is_float_ann(stmt.annotation):
+                fields.add(stmt.target.id)
+    return frozenset(fields)
+
+
+def _float_env(fn, float_attrs=frozenset()):
+    """Local names with float-type evidence in one function: parameters
+    annotated ``float``, ``x: float`` annotated assignments, and --
+    iterated to a fixpoint -- locals assigned an expression that already
+    carries evidence (literal propagation). Reassignment to a non-float
+    is not tracked (a name stays in the env once proven); int-only
+    folds never enter the env, which is the property the FL131/FL134
+    negative tests pin."""
+    env = set()
+    args = fn.args
+    for a in (args.posonlyargs + args.args + args.kwonlyargs):
+        if a.annotation is not None and _is_float_ann(a.annotation):
+            env.add(a.arg)
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            targets = None
+            if isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and _is_float_ann(node.annotation):
+                targets = [node.target]
+            elif isinstance(node, ast.Assign) and _float_evidence(
+                    node.value, env, float_attrs):
+                targets = [t for t in node.targets
+                           if isinstance(t, ast.Name)]
+            elif isinstance(node, ast.AugAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and _float_evidence(node.value, env, float_attrs):
+                targets = [node.target]
+            for tgt in targets or ():
+                if tgt.id not in env:
+                    env.add(tgt.id)
+                    changed = True
+    return frozenset(env)
 
 
 def _dict_iter_attr(expr):
@@ -380,17 +463,18 @@ def _target_names(target):
     return {n.id for n in ast.walk(target) if isinstance(n, ast.Name)}
 
 
-def _check_fl131(fi, add):
+def _check_fl131(fi, add, float_attrs=frozenset()):
     """Unordered-iteration float folds in an aggregation-reachable
     function."""
     fn = fi.node
+    env = _float_env(fn, float_attrs)
     for node in ast.walk(fn):
         # shape 1: sum(<genexp over unordered dict iteration>)
         if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
                 and node.func.id == "sum" and node.args \
                 and isinstance(node.args[0], ast.GeneratorExp):
             gen = node.args[0]
-            if not _float_evidence(node.args[0]):
+            if not _float_evidence(node.args[0], env, float_attrs):
                 continue
             for comp in gen.generators:
                 recv = _dict_iter_attr(comp.iter)
@@ -427,7 +511,10 @@ def _check_fl131(fi, add):
                 for sub in ast.walk(stmt):
                     if isinstance(sub, ast.AugAssign) \
                             and isinstance(sub.op, ast.Add) \
-                            and _float_evidence(sub.value):
+                            and (_float_evidence(sub.value, env,
+                                                 float_attrs)
+                                 or (isinstance(sub.target, ast.Name)
+                                     and sub.target.id in env)):
                         what = (f"`{bare}`" if bare is not None
                                 else f"`.{node.iter.func.attr}()`")
                         add(sub, "FL131",
@@ -685,7 +772,7 @@ def _dotted(func):
     return ".".join(reversed(parts))
 
 
-def _check_fl134(fi, add):
+def _check_fl134(fi, add, float_attrs=frozenset()):
     """Float accumulation in a handler-thread-reachable scope."""
     if fi.name in _FL134_EXEMPT_FUNCS \
             or fi.cls in _FL134_EXEMPT_CLASSES \
@@ -693,9 +780,10 @@ def _check_fl134(fi, add):
         return
     where = (f"`{fi.cls}.{fi.name}`" if fi.cls is not None
              else f"`{fi.name}`")
+    env = _float_env(fi.node, float_attrs)
     for node in ast.walk(fi.node):
         if isinstance(node, ast.AugAssign) and isinstance(node.op, ast.Add) \
-                and _float_evidence(node.value):
+                and _float_evidence(node.value, env, float_attrs):
             add(node, "FL134",
                 f"float `+=` accumulation in handler-thread-reachable "
                 f"{where} -- handlers run in network arrival order, so "
@@ -892,18 +980,19 @@ def check_determinism(index, emit):
         fl135_scope = _match(path, _FL135_JSON_PATHS)
         attr_taint = (_class_clock_attrs(rec, time_mods, clock_funcs)
                       if fl132_scope else {})
+        float_attrs = _dataclass_float_fields(tree)
 
         for key, fi in sorted(rec["funcs"].items(),
                               key=lambda kv: kv[1].node.lineno):
             if (mod, key) in agg_reach:
-                _check_fl131(fi, add)
+                _check_fl131(fi, add, float_attrs)
             if fl132_scope:
                 _check_fl132(fi, time_mods, clock_funcs, add,
                              attr_taint.get(fi.cls, frozenset()))
             if fl133_scope:
                 _check_fl133(fi, rec, add)
             if (mod, key) in handler_reach:
-                _check_fl134(fi, add)
+                _check_fl134(fi, add, float_attrs)
             if fl135_scope:
                 _check_fl135_json(fi.node, rec["funcs"], add)
             else:

@@ -296,6 +296,36 @@ RULES = {
         "alive rank still outside the cohort -- capacity that came back "
         "stays dead for the run. Register a PEER_JOIN handler that "
         "re-adds the rank and re-syncs it with the current model."),
+    "FL150": (
+        "raw client update material escapes to telemetry",
+        "taint from a material payload read (msg.get('params'/'cdelta'/"
+        "...), a payload-helper result) reaches logging/json.dump/"
+        "metrics/flight-recorder inside a server-role FSM method. "
+        "Telemetry and manifests cross the trust boundary: they must "
+        "carry sanitized aggregates (fold/privatize/encode outputs) or "
+        "scalar metadata only, never a single client's tensors."),
+    "FL151": (
+        "DP leg ordering/derivation defect",
+        "the differential-privacy sanitizer must clip FIRST (bounding "
+        "per-client sensitivity) and then add noise calibrated to that "
+        "bound, drawn from a keyed derived stream. Flagged: a clip call "
+        "consuming a noise result (noise-before-clip voids the epsilon "
+        "accounting), or a noise draw on an rng not bound from a "
+        "*_rng(...) derivation / non-constant default_rng key."),
+    "FL152": (
+        "secure-agg mask/codec commutation violated",
+        "masking only cancels in the finite field: field-encoding "
+        "(quantize) an already-masked value, or reconstructing from "
+        "dequantized (float-domain) partials, silently corrupts the "
+        "aggregate or voids share secrecy. Quantize -> share -> "
+        "reconstruct -> dequantize is the only valid order."),
+    "FL153": (
+        "declared DP leg bypassed on a send path",
+        "a client FSM that takes a dp policy adds update material to an "
+        "outbound message through a method whose self-call closure "
+        "never privatizes -- the sanitizer the round program declares "
+        "is skipped on that path. Privatize before .add() and before "
+        "the codec (noise must precede lossy compression)."),
 }
 
 #: SARIF rule metadata: which analysis pass owns each rule (rendered as
@@ -313,6 +343,8 @@ RULE_PASS = {
     "FL135": "fedcheck-determinism",
     "FL140": "fedcheck-model", "FL141": "fedcheck-model",
     "FL142": "fedcheck-model", "FL143": "fedcheck-model",
+    "FL150": "fedcheck-privacy", "FL151": "fedcheck-privacy",
+    "FL152": "fedcheck-privacy", "FL153": "fedcheck-privacy",
 }
 
 #: codes owned by each project-wide pass: a --select/--ignore set that
@@ -325,6 +357,7 @@ PASS_CODES = {
     "determinism": frozenset(
         ("FL131", "FL132", "FL133", "FL134", "FL135")),
     "modelcheck": frozenset(("FL140", "FL141", "FL142", "FL143")),
+    "privacy": frozenset(("FL150", "FL151", "FL152", "FL153")),
 }
 
 
@@ -1529,6 +1562,15 @@ def _modelcheck_findings(pindex, mod_info, select=None, ignore=None):
                              mod_info, select=select, ignore=ignore)
 
 
+def _privacy_findings(pindex, mod_info, select=None, ignore=None):
+    """Project-wide privacy information-flow pass (FL150-FL153): also
+    rides the ProtocolIndex -- sources/sinks live in the same FSM
+    classes the protocol pass already extracted."""
+    from fedml_tpu.analysis.privacy import check_privacy
+    return _emitted_findings(lambda emit: check_privacy(pindex, emit),
+                             mod_info, select=select, ignore=ignore)
+
+
 def lint_source(src, path="<string>", select=None, ignore=None):
     """Lint one module's source (project-wide rules see only this one
     module). Returns non-suppressed findings."""
@@ -1564,6 +1606,9 @@ def lint_source(src, path="<string>", select=None, ignore=None):
     if _pass_enabled("modelcheck", select, ignore):
         findings += _modelcheck_findings(pindex, mod_info, select=select,
                                          ignore=ignore)
+    if _pass_enabled("privacy", select, ignore):
+        findings += _privacy_findings(pindex, mod_info, select=select,
+                                      ignore=ignore)
     findings.sort(key=lambda f: (f.line, f.col, f.code))
     return findings
 
@@ -1587,8 +1632,9 @@ def lint_paths(paths, select=None, ignore=None):
     builder returns and imports; protocol constants and FSM classes
     through import edges); pass 2 runs the per-module rules with the jit
     index in scope, then the project-wide protocol (FL120-FL122,
-    FL127/FL128), cross-class concurrency (FL126), and determinism
-    (FL131-FL135) passes over the whole fileset."""
+    FL127/FL128), cross-class concurrency (FL126), determinism
+    (FL131-FL135), model-checking (FL140-FL143), and privacy
+    information-flow (FL150-FL153) passes over the whole fileset."""
     from fedml_tpu.analysis.crossclass import CrossClassIndex
     from fedml_tpu.analysis.dataflow import ProjectIndex
     from fedml_tpu.analysis.determinism import DeterminismIndex
@@ -1631,6 +1677,9 @@ def lint_paths(paths, select=None, ignore=None):
     if _pass_enabled("modelcheck", select, ignore):
         findings.extend(_modelcheck_findings(pindex, mod_info,
                                              select=select, ignore=ignore))
+    if _pass_enabled("privacy", select, ignore):
+        findings.extend(_privacy_findings(pindex, mod_info,
+                                          select=select, ignore=ignore))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
     return findings
 

@@ -64,7 +64,8 @@ verdict means "no counterexample within the budget", never a proof.
 """
 
 import ast
-from collections import deque
+import re
+from collections import Counter, deque
 
 from fedml_tpu.analysis.protocol import (
     FSM_ROOTS, PEER_LOST_NAME, PEER_LOST_VALUE, _RESERVED_PREFIX,
@@ -78,10 +79,16 @@ PEER_JOIN_VALUE = "__peer_join__"
 _DEADLINE_FRAGMENTS = ("deadline", "timer", "timeout")
 
 # exploration bounds: BFS abandons a composition (silently: bounded
-# checking promises nothing beyond its budget) past these
-MAX_STATES_PAIR = 20000
-MAX_STATES_TIER = 40000
-MAX_STATES_TREE = 250000
+# checking promises nothing beyond its budget) past these.  Measured
+# full-exploration sizes under the widened default FaultBudget
+# (drops=1, dups=1, kills=2, joins=1 for pairs; the two-tier default
+# adds an edge-tier kill transition): pair ~= 16.2k states, two-tier
+# ~= 43.4k, three-tier ~= 191k -- each cap keeps roughly 2x headroom
+# over the measured frontier so a capped result signals a genuinely
+# new state-space blowup, not the standing budget.
+MAX_STATES_PAIR = 40000
+MAX_STATES_TIER = 90000
+MAX_STATES_TREE = 400000
 MAX_DEPTH = 80
 MAX_CHANNEL = 7
 MAX_COMPOSITIONS = 16
@@ -342,9 +349,17 @@ def _module_deadline_evidence(info):
 
 
 class FaultBudget:
+    """Per-exploration fault allowance. The default pair budget allows
+    TWO kills: with two modeled clients, the whole cohort can die in one
+    round, which is exactly the regime where the fail-fast/deadline
+    split matters (a deadline server must resolve abandoned, a
+    deadline-less one must fail fast rather than hang). One-kill budgets
+    provably miss any defect that needs a second concurrent loss (e.g.
+    a quorum floor that only wedges at zero live reporters)."""
+
     __slots__ = ("drops", "dups", "kills", "joins")
 
-    def __init__(self, drops=1, dups=1, kills=1, joins=1):
+    def __init__(self, drops=1, dups=1, kills=2, joins=1):
         self.drops = drops
         self.dups = dups
         self.kills = kills
@@ -795,6 +810,27 @@ class TwoTierModel:
                            (cph, creps, aedges, edges, nl, nchan,
                             (drops, dups, kills - 1, joins), True))
                     break  # one representative per edge bounds the fan
+            # edge-tier kill: the relay PROCESS dies -- every leaf under
+            # it goes unreachable with it and the coordinator observes a
+            # single PEER_LOST from the edge plane. One representative
+            # (the lowest-id alive edge) bounds the fan like the leaf
+            # kills above; a sole surviving edge is never killed (an
+            # empty coordinator plane is topology death, not a protocol
+            # defect this model judges).
+            for e in sorted(aedges):
+                if len(aedges) <= 1:
+                    break
+                naedges = aedges - {e}
+                nl = leaves
+                for j in range(self.L):
+                    nl = _tset(nl, e * self.L + j, DEAD)
+                nedges = _tset(edges, e, (E_ABANDONED, edges[e][1]))
+                nchan = tuple(sorted(
+                    chan + ((PEER_LOST_VALUE, e, SERVER),)))
+                yield ("kill edge%d" % e,
+                       (cph, creps, naedges, nedges, nl, nchan,
+                        (drops, dups, kills - 1, joins), True))
+                break
         # edge deadlines: a below-quorum edge resolves abandoned and
         # forwards NOTHING (fanin._on_edge_abandoned)
         if faulted:
@@ -829,7 +865,13 @@ class TwoTierModel:
         if dst == SERVER:  # coordinator plane
             label = "deliver %s edge%s->coordinator" % (mtype, src)
             if mtype == PEER_LOST_VALUE:
-                yield (label, base)
+                # an edge-plane loss reaching the coordinator: the
+                # runtime _on_peer_lost re-cohorts, so the quorum the
+                # kill transition already shrank can decide the round
+                # here (the remaining edges' reports may all be folded)
+                ncph = DONE if creps and creps >= aedges else cph
+                yield (label, (ncph, creps, aedges, edges, leaves, rest,
+                               bud, faulted))
                 return
             spec = self.coord.handlers.get(mtype)
             if spec is None or spec.inert:
@@ -1288,6 +1330,88 @@ class ThreeTierModel:
                     bud, faulted))
             return
         yield (label + " (consumed)", base)
+
+
+# -- counterexample -> runtime fault plan ----------------------------------
+
+#: trace-label grammar fragments the compiler understands.
+_FAULT_STEP = re.compile(
+    r"^(?P<action>deliver|drop|duplicate)\s+(?P<mtype>\S+)\s+"
+    r"(?P<src>\S+?)->(?P<dst>\S+?)(\s+\(.*)?$")
+_KILL_STEP = re.compile(r"^kill\s+(?P<who>\S+)$")
+_REJOIN_STEP = re.compile(r"^rejoin\s+(?P<who>\S+)$")
+_WHO = re.compile(r"^(?P<plane>server|coordinator|client|leaf|edge|"
+                  r"tier1-edge|tier2-edge)(?P<id>\d*)$")
+
+
+def _runtime_rank(who):
+    """Model participant label -> runtime rank. Pair-model clients are
+    0-based where the tcp runner's client ranks are 1-based (the +1);
+    tier/tree planes keep their model ids (the process-tree spawner's
+    own id space)."""
+    m = _WHO.match(who)
+    if m is None:
+        return None
+    plane, num = m.group("plane"), m.group("id")
+    if plane in ("server", "coordinator"):
+        return 0
+    if plane == "client":
+        return int(num) + 1
+    return int(num)
+
+
+def trace_to_fault_plan(trace, seed=0, strict=False):
+    """Compile an FL140-FL143 counterexample trace into a seeded,
+    replayable :class:`resilience.faults.FaultPlan`.
+
+    Each ``drop``/``duplicate`` step becomes a deterministic ``nth``
+    rule against the sending rank's outbound stream of that message
+    type; ``kill <who>`` becomes a kill on that rank's next outbound
+    send. ``nth`` is recovered by counting the type's earlier wire
+    appearances from the same sender in the trace -- exact for the
+    round-0 scope the model explores (every (sender, type) appears at
+    most once per attempt), an approximation beyond it.
+
+    Inexpressible steps -- ``rejoin`` (a send-side wrapper cannot
+    restart a process; that needs the run driver) and pure deliveries/
+    deadlines (the transport's own behavior) -- are skipped, or raise
+    ``ValueError`` for rejoin under ``strict=True``.
+
+    The result drives ``run_tcp_fedavg(fault_plan=...)`` so a model
+    counterexample re-manifests as a wall-clock hang/TimeoutError --
+    tests/test_modelcheck.py replays FL141's inert-handler trace this
+    way."""
+    from fedml_tpu.resilience.faults import FaultPlan, FaultRule
+    rules = []
+    sent = Counter()  # (rank, mtype) -> wire appearances so far
+    for step in trace:
+        m = _FAULT_STEP.match(step)
+        if m is not None:
+            rank = _runtime_rank(m.group("src"))
+            mtype = m.group("mtype")
+            if mtype.startswith(_RESERVED_PREFIX):
+                continue  # transport-synthesized, never on a sender
+            sent[(rank, mtype)] += 1
+            if m.group("action") == "deliver" or rank is None:
+                continue
+            action = ("drop" if m.group("action") == "drop"
+                      else "duplicate")
+            rules.append(FaultRule(action=action, rank=rank,
+                                   msg_type=mtype,
+                                   nth=sent[(rank, mtype)]))
+            continue
+        m = _KILL_STEP.match(step)
+        if m is not None:
+            rank = _runtime_rank(m.group("who"))
+            if rank is not None:
+                rules.append(FaultRule(action="kill", rank=rank, nth=1))
+            continue
+        if strict and _REJOIN_STEP.match(step):
+            raise ValueError(
+                "trace step %r is not expressible as a send-side fault "
+                "rule: a rejoin needs the run driver to restart the "
+                "rank" % step)
+    return FaultPlan(seed=seed, rules=tuple(rules))
 
 
 # -- the lint pass ---------------------------------------------------------

@@ -823,6 +823,7 @@ def check_protocol(index, emit):
 
     _check_sequencing(index, fsms, emit)
     _check_payload_schema(index, fsms, emit)
+    _check_payload_types(fsms, emit)
 
 
 def _resolve_handler(index, cls, mod, name):
@@ -1011,6 +1012,61 @@ def _check_payload_schema(index, fsms, emit):
                      "dead wire bytes in every frame (and a likely "
                      "renamed key: the reader's half may be the FL128 "
                      "read-never-set finding next to this one)")
+
+
+#: value-expression kinds the wire codec's frame grammar provably cannot
+#: carry. The grammar (compression/codec.py `_extract`): ndarray/duck-
+#: array leaves go binary, dict/list/tuple recurse, JSON scalars pass
+#: through -- a set never JSON-serializes, bytes only travel framed as
+#: arrays, and a callable is never data.
+_UNFRAMABLE_CALLS = {"set", "frozenset", "bytearray", "memoryview"}
+
+
+def _unframable_kind(expr):
+    """Human-readable kind when ``expr`` is provably outside the codec
+    frame grammar, else None. Judgment is literal-only by design: a
+    call result or a name may well be a framable dict/array, so only
+    displays whose runtime type is certain are flagged."""
+    if isinstance(expr, (ast.Set, ast.SetComp)):
+        return "a set"
+    if isinstance(expr, ast.GeneratorExp):
+        return "a generator"
+    if isinstance(expr, ast.Lambda):
+        return "a lambda"
+    if isinstance(expr, ast.Constant) and isinstance(expr.value,
+                                                     (bytes, bytearray)):
+        return "a bytes literal"
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name) \
+            and expr.func.id in _UNFRAMABLE_CALLS:
+        return f"a {expr.func.id}()"
+    return None
+
+
+def _check_payload_types(fsms, emit):
+    """FL128 (type half): every ``add(key, value)`` value expression is
+    checked against the codec frame grammar -- the schema half above
+    pairs *keys* across the wire; this half rejects *values* that can
+    never cross it at all."""
+    seen = set()
+    for cls, mod, _role, _handled, _reg in fsms:
+        for b in cls.builds:
+            nodes = list(b.keys.items())
+            nodes += [(kref.name, kref.node) for kref in b.named_keys]
+            for key, node in nodes:
+                if len(node.args) < 2 or id(node) in seen:
+                    continue
+                kind = _unframable_kind(node.args[1])
+                if kind is None:
+                    continue
+                seen.add(id(node))
+                label = f"'{key}'" if key is not None else "<computed>"
+                emit(mod, node, "FL128",
+                     f"payload key {label} is assigned {kind} -- outside "
+                     "the wire codec's frame grammar (framable: ndarray/"
+                     "duck-array leaves, dict/list/tuple containers, "
+                     "JSON scalars). encode_tree/to_json raises at send "
+                     "time on the first real frame; carry a sorted list "
+                     "or a framed array instead")
 
 
 def _merge_role(a, b):

@@ -18,6 +18,32 @@ import numpy as np
 
 DEFAULT_PRIME = 2 ** 31 - 1  # Mersenne prime fits int64 products via Python int
 
+#: domain-separation salt for the masking streams (distinct from the
+#: codec's 0x5EED and the DP leg's 0xD1FF -- three independent derived
+#: stream families over the same (rank, round, attempt) keys).
+MASK_SEED_SALT = 0x3A5C
+
+
+def mask_rng(*key):
+    """The derived masking stream for the share/encode helpers, keyed
+    per use site (e.g. ``mask_rng(rank, round_idx)``). The sharing
+    functions REQUIRE an explicit rng: an unseeded default would make
+    masked runs unreplayable, and a constant default (the historical
+    ``default_rng(0)`` in :func:`secure_aggregate`) reuses the exact
+    same masks every call -- reused masks cancel, which voids the
+    secrecy the sharing exists to provide. fedcheck's privacy pass
+    (FL151's derived-stream rule) keeps new call sites honest."""
+    return np.random.default_rng((MASK_SEED_SALT, *map(int, key)))
+
+
+def _require_rng(rng, fn_name):
+    if rng is None:
+        raise ValueError(
+            f"{fn_name} needs an explicit rng -- derive one per use via "
+            "mask_rng(rank, round_idx, ...) so masks are replayable and "
+            "never silently reused across calls")
+    return rng
+
 
 def quantize(x, scale=2 ** 16, p=DEFAULT_PRIME):
     """Float array -> field elements (two's-complement style embedding)."""
@@ -38,7 +64,7 @@ def modular_inverse(a, p=DEFAULT_PRIME):
 
 def additive_shares(secret, n_shares, p=DEFAULT_PRIME, rng=None):
     """Split field array into n uniformly random additive shares."""
-    rng = rng or np.random.default_rng()
+    rng = _require_rng(rng, "additive_shares")
     shares = [rng.integers(0, p, size=np.shape(secret), dtype=np.int64)
               for _ in range(n_shares - 1)]
     last = np.mod(np.asarray(secret, np.int64) - sum(np.int64(0) + s for s in shares), p)
@@ -71,7 +97,7 @@ def lagrange_coefficients(eval_points, target=0, p=DEFAULT_PRIME):
 def bgw_encode(secret, eval_points, t, p=DEFAULT_PRIME, rng=None):
     """Shamir/BGW degree-t polynomial shares of a field array: share_k =
     secret + sum_{d=1..t} r_d * x_k^d (reference BGW_encoding)."""
-    rng = rng or np.random.default_rng()
+    rng = _require_rng(rng, "bgw_encode")
     secret = np.asarray(secret, np.int64)
     coeffs = [rng.integers(0, p, size=secret.shape, dtype=np.int64)
               for _ in range(t)]
@@ -101,7 +127,7 @@ def secure_aggregate(client_updates, p=DEFAULT_PRIME, scale=2 ** 16, rng=None):
     and the sum is dequantized -- the server never sees an individual update.
     Semantics of TurboAggregate's aggregation result (``TA_Aggregator.py:
     56-85`` computes the same weighted sum in the clear)."""
-    rng = rng or np.random.default_rng(0)
+    rng = _require_rng(rng, "secure_aggregate")
     n = len(client_updates)
     q = [quantize(u, scale, p) for u in client_updates]
     all_shares = [additive_shares(qi, n, p, rng) for qi in q]

@@ -62,6 +62,49 @@ def norm_diff_clipping(local_state, global_state, norm_bound):
     return clipped
 
 
+def coordinate_median(states):
+    """Per-coordinate median over a list of state pytrees (the jax twin
+    of ``program.privacy.RobustPolicy``'s ``coordinate_median`` host
+    fold). BN stats pass through from the FIRST state unmedianed,
+    matching the defense-vector exclusion above."""
+    weights = [split_weights(s)[0] for s in states]
+    _, rest = split_weights(states[0])
+    med = jax.tree.map(lambda *xs: jnp.median(jnp.stack(xs), axis=0),
+                       *weights)
+    from collections.abc import Mapping
+    if isinstance(states[0], Mapping):
+        out = dict(med)
+        out.update(rest)
+        return out
+    return med
+
+
+def trimmed_mean(states, trim_ratio):
+    """Per-coordinate trimmed mean over a list of state pytrees: sort
+    along the client axis, drop ``floor(trim_ratio * m)`` values at
+    each end, average the rest (host twin:
+    ``RobustPolicy(mode="trimmed_mean")``)."""
+    m = len(states)
+    t = int(trim_ratio * m)
+    if 2 * t >= m:
+        t = (m - 1) // 2
+    weights = [split_weights(s)[0] for s in states]
+    _, rest = split_weights(states[0])
+
+    def _trim(*xs):
+        v = jnp.sort(jnp.stack(xs), axis=0)
+        kept = v[t:m - t] if t else v
+        return jnp.mean(kept, axis=0)
+
+    out_w = jax.tree.map(_trim, *weights)
+    from collections.abc import Mapping
+    if isinstance(states[0], Mapping):
+        out = dict(out_w)
+        out.update(rest)
+        return out
+    return out_w
+
+
 def add_gaussian_noise(state, stddev, rng_key):
     """Weak-DP Gaussian noise on weight parameters only."""
     weights, rest = split_weights(state)

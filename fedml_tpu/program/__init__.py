@@ -19,6 +19,8 @@ from fedml_tpu.program.aggregation import (
 from fedml_tpu.program.cohort import (
     CohortPolicy, attempt_seed, client_sampling, sample_ranks)
 from fedml_tpu.program.codec import CodecSpec, WIRE_CODEC_NAMES, wire_codecs
+from fedml_tpu.program.privacy import (
+    DPPolicy, ROBUST_MODES, RobustPolicy)
 from fedml_tpu.program.round import HostProgram, RoundProgram
 from fedml_tpu.program.sim import compile_bucketed, compile_sim
 
@@ -29,5 +31,6 @@ __all__ = [
     "FlushResult", "aggregate_reports", "fold_entries_fp64",
     "staleness_weight",
     "CodecSpec", "WIRE_CODEC_NAMES", "wire_codecs",
+    "DPPolicy", "RobustPolicy", "ROBUST_MODES",
     "compile_sim", "compile_bucketed",
 ]

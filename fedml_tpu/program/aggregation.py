@@ -234,8 +234,13 @@ class BufferedAggregator:
     the older update trained on strictly staler params) and is counted.
     """
 
-    def __init__(self, policy: AggregationPolicy):
+    def __init__(self, policy: AggregationPolicy, fold_fn=None):
         self.policy = policy
+        # the flush fold; None = the canonical fold_entries_fp64. The
+        # RoundProgram's robust leg hands its order-statistic variant
+        # here (HostProgram.make_aggregator) -- same (entries) ->
+        # (params, weight) contract, still sorted-key deterministic.
+        self._fold_fn = fold_fn
         self._lock = audited_lock()
         self._entries = {}        # key -> (weight, payload, scale)
         self._entry_clients = {}  # key -> client count
@@ -375,7 +380,7 @@ class BufferedAggregator:
         with get_tracer().span("buffer-flush", reason=reason,
                                entries=len(entries), clients=clients,
                                version=version):
-            params, weight = fold_entries_fp64(entries)
+            params, weight = (self._fold_fn or fold_entries_fp64)(entries)
         reg = get_registry()
         if reg is not None:
             reg.set_gauge("fed_buffer_depth", 0,

@@ -1,0 +1,295 @@
+"""DP + robust-aggregation legs of the :class:`RoundProgram`.
+
+The privacy boundary's *subject* side (fedpriv's verified code): two
+frozen pure-data policies that slot into ``RoundProgram`` next to the
+cohort/aggregation/codec legs.
+
+- :class:`DPPolicy` -- client-side differential privacy on the update
+  delta: L2 clip to ``clip_norm`` **then** Gaussian noise at
+  ``noise_multiplier * clip_norm``, drawn from an rng derived per
+  ``(rank, round, attempt)`` (the same keyed-stream rule as
+  ``wire.encode_rng`` -- two runs over the same schedule privatize
+  bit-identically, and fedcheck FL151 statically rejects the reversed
+  order or an underived rng). ``epsilon()`` carries the Gaussian
+  mechanism's accounting onto round records.
+- :class:`RobustPolicy` -- server-side poisoning defenses as fold
+  variants over the canonical sorted-key fp64 fold: ``norm_clip``
+  (clip each report's delta from the round base, then the ordinary
+  weighted fold), ``coordinate_median`` and ``trimmed_mean``
+  (per-coordinate order statistics; unweighted by construction).
+
+Both legs are numpy-only (the jax-free ``host_view()`` requirement);
+the one device accessor (:meth:`DPPolicy.device_privatize`) lazily
+imports :mod:`fedml_tpu.core.robust` exactly like ``CodecSpec.device``.
+Robust order-statistic folds densify compressed reports -- a median is
+not linear, so the O(k) sparse fold cannot apply; the densification is
+per flush, never per report retained.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+#: domain-separation salt for the DP noise stream: the draw for
+#: (rank, round, attempt) must never collide with the codec's encode
+#: stream (``wire.encode_rng``'s 0x5EED) over the same key tuple.
+DP_SEED_SALT = 0xD1FF
+
+#: RobustPolicy.mode vocabulary.
+ROBUST_MODES = ("norm_clip", "coordinate_median", "trimmed_mean")
+
+
+@dataclass(frozen=True)
+class DPPolicy:
+    """Client-side (local) DP knobs for one ``RoundProgram``.
+
+    Args:
+      clip_norm: L2 bound C on the client's update delta (the Gaussian
+        mechanism's sensitivity).
+      noise_multiplier: sigma/C -- noise stddev is
+        ``noise_multiplier * clip_norm``. ``0`` = clip-only (no noise,
+        epsilon is infinite; still a defense, not privacy).
+      delta: the (epsilon, delta)-DP failure probability used by
+        :meth:`epsilon`.
+    """
+
+    clip_norm: float = 1.0
+    noise_multiplier: float = 0.0
+    delta: float = 1e-5
+
+    def __post_init__(self):
+        if not self.clip_norm > 0:
+            raise ValueError(f"clip_norm must be > 0, got {self.clip_norm}")
+        if self.noise_multiplier < 0:
+            raise ValueError("noise_multiplier must be >= 0, got "
+                             f"{self.noise_multiplier}")
+        if not 0 < self.delta < 1:
+            raise ValueError(f"delta must be in (0, 1), got {self.delta}")
+
+    @property
+    def sigma(self) -> float:
+        """Noise stddev in update units (``noise_multiplier * clip_norm``)."""
+        return float(self.noise_multiplier) * float(self.clip_norm)
+
+    def noise_rng(self, rank, round_idx, attempt=0):
+        """The per-(rank, round, attempt) derived noise stream -- the
+        FL133/FL151-recognized keyed idiom (`wire.encode_rng`'s rule
+        under a distinct salt). NEVER a constant or process-global rng:
+        replayability and cross-rank independence both hang on this."""
+        return np.random.default_rng(
+            (DP_SEED_SALT, int(rank), int(round_idx), int(attempt)))
+
+    def clip(self, delta) -> dict:
+        """L2-clip a numpy delta pytree to ``clip_norm`` (global norm
+        over every leaf, sorted-key traversal). Reference scale rule:
+        ``delta / max(1, ||delta|| / C)`` (core/robust.py's
+        ``norm_diff_clipping`` on host)."""
+        sq = 0.0
+        for k in sorted(delta):
+            x = np.asarray(delta[k], np.float64)
+            sq += float(np.sum(x * x))
+        scale = 1.0 / max(1.0, math.sqrt(sq) / float(self.clip_norm))
+        return {k: np.asarray(delta[k], np.float32) * np.float32(scale)
+                for k in sorted(delta)}
+
+    def noise(self, delta, rank, round_idx, attempt=0) -> dict:
+        """Add seeded Gaussian noise at :attr:`sigma` to every leaf.
+        Draw order is the sorted-key order -- part of the bitwise
+        contract (both the client and the conformance twin replay the
+        identical stream)."""
+        rng = self.noise_rng(rank, round_idx, attempt)
+        out = {}
+        for k in sorted(delta):
+            x = np.asarray(delta[k], np.float32)
+            out[k] = x + np.float32(self.sigma) * rng.standard_normal(
+                x.shape, dtype=np.float32)
+        return out
+
+    def privatize(self, delta, rank, round_idx, attempt=0) -> dict:
+        """THE mechanism order: clip first, then noise -- the noise is
+        calibrated to the *clipped* sensitivity, so noising the unclipped
+        delta (or clipping after noising) silently voids the epsilon
+        claim. fedcheck FL151 pins this order statically."""
+        clipped = self.clip(delta)
+        if self.noise_multiplier == 0:
+            return clipped
+        return self.noise(clipped, rank, round_idx, attempt)
+
+    def privatize_params(self, base, params, rank, round_idx, attempt=0):
+        """Client-report form: ``base + privatize(params - base)`` --
+        what a client ships instead of its raw trained params (and what
+        the uplink codec then encodes: DP before codec, always)."""
+        base = {k: np.asarray(v, np.float32) for k, v in base.items()}
+        delta = {k: np.asarray(params[k], np.float32) - base[k]
+                 for k in sorted(base)}
+        priv = self.privatize(delta, rank, round_idx, attempt)
+        return {k: base[k] + priv[k] for k in sorted(base)}
+
+    def epsilon(self, rounds=1) -> float:
+        """Gaussian-mechanism epsilon at ``delta`` after ``rounds``
+        releases (classic analytic bound ``sqrt(2 ln(1.25/delta)) /
+        noise_multiplier`` per release, naive linear composition --
+        deliberately the conservative textbook accountant, not RDP).
+        Infinite when the noise leg is off."""
+        if self.noise_multiplier <= 0:
+            return math.inf
+        per_round = (math.sqrt(2.0 * math.log(1.25 / float(self.delta)))
+                     / float(self.noise_multiplier))
+        return float(rounds) * per_round
+
+    def record(self, rounds_completed) -> dict:
+        """The epsilon-accounting fragment every round record carries
+        when the DP leg is armed (metrics.jsonl's ``dp/*`` family)."""
+        eps = self.epsilon(rounds_completed)
+        return {"dp/clip_norm": float(self.clip_norm),
+                "dp/noise_multiplier": float(self.noise_multiplier),
+                "dp/delta": float(self.delta),
+                "dp/rounds": int(rounds_completed),
+                "dp/epsilon": eps if math.isfinite(eps) else -1.0}
+
+    def device_privatize(self, local_state, global_state, rng_key):
+        """The jax twin (lazy import, like ``CodecSpec.device``): clip
+        the local-minus-global delta on device, then add Gaussian noise
+        under ``rng_key``. Sim-side consumers must derive ``rng_key``
+        per (client, round) -- the host twin's keyed-stream rule."""
+        from fedml_tpu.core.robust import (add_gaussian_noise,
+                                           norm_diff_clipping)
+        clipped = norm_diff_clipping(local_state, global_state,
+                                     self.clip_norm)
+        if self.noise_multiplier == 0:
+            return clipped
+        return add_gaussian_noise(clipped, self.sigma, rng_key)
+
+
+def _dense_payload(payload):
+    """A report payload as a dense f64 pytree: plain dicts cast; a
+    ``CompressedUpdate`` reconstructs ``base + decode(enc)``. Order
+    statistics are not linear, so the robust folds pay this
+    densification per flush (documented in the module docstring)."""
+    from fedml_tpu.compression.wire import CompressedUpdate
+    if isinstance(payload, CompressedUpdate):
+        dec = payload.compressor().decode(payload.enc)
+        return {k: np.asarray(payload.base[k], np.float64)
+                + np.asarray(dec[k], np.float64)
+                for k in sorted(payload.base)}
+    return {k: np.asarray(payload[k], np.float64) for k in sorted(payload)}
+
+
+@dataclass(frozen=True)
+class RobustPolicy:
+    """Server-side robust-aggregation fold selection.
+
+    Args:
+      mode: ``norm_clip`` (clip each report's delta from the round base
+        to ``clip_bound``, then the canonical weighted fold),
+        ``coordinate_median`` (per-coordinate median over reports), or
+        ``trimmed_mean`` (per-coordinate mean after dropping
+        ``floor(trim_ratio * m)`` low and high values).
+      clip_bound: L2 ball for ``norm_clip``.
+      trim_ratio: per-end trim fraction for ``trimmed_mean`` (in
+        ``[0, 0.5)``; 0 degenerates to the plain unweighted mean).
+    """
+
+    mode: str = "norm_clip"
+    clip_bound: float = 10.0
+    trim_ratio: float = 0.1
+
+    def __post_init__(self):
+        if self.mode not in ROBUST_MODES:
+            raise ValueError(f"robust mode must be one of {ROBUST_MODES}, "
+                             f"got {self.mode!r}")
+        if not self.clip_bound > 0:
+            raise ValueError(f"clip_bound must be > 0, got {self.clip_bound}")
+        if not 0 <= self.trim_ratio < 0.5:
+            raise ValueError("trim_ratio must be in [0, 0.5), got "
+                             f"{self.trim_ratio}")
+
+    def fold_reports(self, reports, base=None) -> tuple:
+        """Robust drop-in for ``aggregate_reports`` over ``{rank: (n,
+        payload)}``. Returns ``(params_f32, total_n)`` -- the returned
+        total is always the reporters' sample sum (the quorum/steering
+        denominator), even for the unweighted order-statistic folds.
+        Deterministic by the same rule as the canonical fold: every
+        traversal is sorted (ranks, then keys), never arrival order."""
+        from fedml_tpu.program.aggregation import (aggregate_reports,
+                                                   fold_entries_fp64)
+        if not reports:
+            raise ValueError("robust fold over an empty reporting subset "
+                             "(abandon the round instead)")
+        total = float(sum(float(reports[r][0]) for r in sorted(reports)))
+        if self.mode == "norm_clip":
+            if base is None:
+                raise ValueError("norm_clip folds need the round base "
+                                 "params (the model the cohort trained on)")
+            base64 = {k: np.asarray(base[k], np.float64)
+                      for k in sorted(base)}
+            entries = []
+            for r in sorted(reports):
+                n, payload = reports[r]
+                dense = _dense_payload(payload)
+                clipped = self._clip_to_base(dense, base64)
+                entries.append((r, float(n), clipped, float(n)))
+            params, fold_total = fold_entries_fp64(entries)
+            assert fold_total == total
+            return params, total
+        stacked = self._stacked(reports)
+        if self.mode == "coordinate_median":
+            params = {k: np.median(v, axis=0).astype(np.float32)
+                      for k, v in stacked.items()}
+            return params, total
+        # trimmed_mean
+        m = len(reports)
+        t = int(math.floor(float(self.trim_ratio) * m))
+        if 2 * t >= m:  # degenerate cohort: keep at least one value
+            t = (m - 1) // 2
+        params = {}
+        for k, v in stacked.items():
+            v = np.sort(v, axis=0)
+            kept = v[t:m - t] if t else v
+            params[k] = np.mean(kept, axis=0).astype(np.float32)
+        return params, total
+
+    def fold_entries(self, entries) -> tuple:
+        """Robust drop-in for ``fold_entries_fp64`` (the
+        ``BufferedAggregator`` flush hook). Order-statistic modes only:
+        ``norm_clip`` needs the round base, which the barrier-free
+        buffer does not carry -- arm it on the sync leg instead."""
+        if self.mode == "norm_clip":
+            raise ValueError("norm_clip is a sync-leg fold (the buffered "
+                             "async aggregator has no round base to clip "
+                             "against); use coordinate_median or "
+                             "trimmed_mean on the async leg")
+        entries = sorted(entries, key=lambda e: e[0])
+        if not entries:
+            raise ValueError("robust fold over an empty entry set")
+        reports = {key: (weight, payload)
+                   for key, weight, payload, _scale in entries}
+        return self.fold_reports(reports)
+
+    def _clip_to_base(self, dense64, base64):
+        """``base + delta / max(1, ||delta|| / bound)`` in f64 (the
+        host twin of core/robust.py's ``norm_diff_clipping``)."""
+        delta = {k: dense64[k] - base64[k] for k in sorted(base64)}
+        sq = 0.0
+        for k in sorted(delta):
+            sq += float(np.sum(delta[k] * delta[k]))
+        scale = 1.0 / max(1.0, math.sqrt(sq) / float(self.clip_bound))
+        return {k: (base64[k] + delta[k] * scale).astype(np.float32)
+                for k in sorted(base64)}
+
+    def _stacked(self, reports):
+        """``{key: [m, ...leaf shape] f64 array}`` over sorted ranks."""
+        ranks = sorted(reports)
+        first = _dense_payload(reports[ranks[0]][1])
+        stacked = {k: [first[k]] for k in first}
+        for r in ranks[1:]:
+            dense = _dense_payload(reports[r][1])
+            for k in stacked:
+                stacked[k].append(dense[k])
+        return {k: np.stack(v) for k, v in stacked.items()}
+
+
+__all__ = ["DPPolicy", "RobustPolicy", "ROBUST_MODES", "DP_SEED_SALT"]

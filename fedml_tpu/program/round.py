@@ -45,6 +45,7 @@ from fedml_tpu.program.aggregation import (
 from fedml_tpu.program.cohort import (
     CohortPolicy, client_sampling, sample_ranks)
 from fedml_tpu.program.codec import CodecSpec
+from fedml_tpu.program.privacy import DPPolicy, RobustPolicy
 
 
 @dataclass(frozen=True)
@@ -61,6 +62,10 @@ class RoundProgram:
     aggregation: AggregationPolicy = field(
         default_factory=AggregationPolicy.sync)
     codec: CodecSpec = field(default_factory=CodecSpec)
+    # privacy legs (program/privacy.py): None = off, exactly like a
+    # disabled codec -- the default program is bitwise the historical one
+    dp: Optional[DPPolicy] = None
+    robust: Optional[RobustPolicy] = None
     client_update: Any = field(default=None, compare=False)
 
     def __post_init__(self):
@@ -103,6 +108,12 @@ class RoundProgram:
             "aggregation": dataclasses.asdict(self.aggregation),
             "codec": {"spec": self.codec.spec,
                       "enabled": self.codec.enabled},
+            # privacy legs serialize as null when off so an operator can
+            # see at a glance that a run carried NO dp/robust defense
+            "dp": (dataclasses.asdict(self.dp)
+                   if self.dp is not None else None),
+            "robust": (dataclasses.asdict(self.robust)
+                       if self.robust is not None else None),
         }
 
     @classmethod
@@ -111,11 +122,15 @@ class RoundProgram:
         :meth:`manifest` output. Unknown keys are rejected by the
         dataclass constructors on purpose: a manifest that names a knob
         this build doesn't know is a version skew worth surfacing."""
+        dp = data.get("dp")
+        robust = data.get("robust")
         return cls(
             cohort=CohortPolicy(**data.get("cohort", {})),
             aggregation=AggregationPolicy(**data.get("aggregation", {})),
             codec=CodecSpec(spec=data.get("codec", {}).get("spec",
-                                                           "none")))
+                                                           "none")),
+            dp=DPPolicy(**dp) if dp else None,
+            robust=RobustPolicy(**robust) if robust else None)
 
     def replace(self, **changes) -> "RoundProgram":
         return dataclasses.replace(self, **changes)
@@ -180,9 +195,14 @@ class HostProgram:
     def aggregation(self) -> AggregationPolicy:
         return self.program.aggregation
 
-    def fold_reports(self, reports) -> tuple:
+    def fold_reports(self, reports, base=None) -> tuple:
         """Sync partial aggregation over the reporting subset
-        (:func:`~fedml_tpu.program.aggregation.aggregate_reports`)."""
+        (:func:`~fedml_tpu.program.aggregation.aggregate_reports`).
+        With the robust leg armed the fold is the leg's variant instead
+        (norm-clip needs ``base`` = the round's broadcast params); the
+        default program stays bitwise the historical fold."""
+        if self.program.robust is not None:
+            return self.program.robust.fold_reports(reports, base=base)
         return aggregate_reports(reports)
 
     def fold_entries(self, entries) -> tuple:
@@ -199,8 +219,14 @@ class HostProgram:
                         ) -> BufferedAggregator:
         """The program's buffered aggregator (async leg). ``policy``
         overrides the program's (pace steering hands the steered policy
-        to the same aggregator class)."""
-        return BufferedAggregator(policy or self.program.aggregation)
+        to the same aggregator class). An armed robust leg swaps the
+        flush fold for the leg's order-statistic variant
+        (:meth:`~fedml_tpu.program.privacy.RobustPolicy.fold_entries`;
+        norm_clip is sync-only and raises there)."""
+        robust = self.program.robust
+        return BufferedAggregator(
+            policy or self.program.aggregation,
+            fold_fn=robust.fold_entries if robust is not None else None)
 
     # -- codec -----------------------------------------------------------
     @property
@@ -211,6 +237,26 @@ class HostProgram:
         """The numpy wire twin for this program's spec (None when the
         codec leg is disabled)."""
         return self.program.codec.host()
+
+    # -- privacy ---------------------------------------------------------
+    @property
+    def dp(self) -> Optional[DPPolicy]:
+        return self.program.dp
+
+    @property
+    def robust(self) -> Optional[RobustPolicy]:
+        return self.program.robust
+
+    def privatize_update(self, base, params, rank, round_idx, attempt=0):
+        """Client-side DP application: ``base + noise(clip(params -
+        base))`` under the per-(rank, round, attempt) derived stream.
+        Identity when the DP leg is off. This runs BEFORE the codec
+        encodes the uplink -- DP then codec, never the reverse (the
+        codec is lossy on the raw delta, not a privacy mechanism)."""
+        if self.program.dp is None:
+            return params
+        return self.program.dp.privatize_params(base, params, rank,
+                                                round_idx, attempt)
 
 
 __all__ = ["RoundProgram", "HostProgram"]

@@ -12,6 +12,53 @@ modules; the module itself stays importable host-side.
 from __future__ import annotations
 
 
+def _clipped_payload(inner, bound):
+    """Per-client norm-clip as a ``payload_fn`` wrapper -- the engine's
+    documented robust-FedAvg hook (clip ``local - global`` to an L2
+    ball on device, then the inner payload transform)."""
+    def fn(local_state, global_state, aux):
+        from fedml_tpu.core.robust import norm_diff_clipping
+        clipped = norm_diff_clipping(local_state, global_state, bound)
+        if inner is None:
+            return clipped
+        return inner(clipped, global_state, aux)
+    return fn
+
+
+def _apply_privacy_legs(program, payload_fn):
+    """Lower the program's dp/robust legs onto the jit round's
+    per-client payload hook, or reject the combinations the vmapped
+    weighted-average round cannot express:
+
+    - DP clip (``noise_multiplier == 0``) and robust ``norm_clip`` are
+      per-client transforms before averaging -- exactly what
+      ``payload_fn`` exists for (engine.py's aggregator hooks).
+    - DP *noise* needs a per-(client, round) derived stream the payload
+      hook does not carry; the order-statistic robust folds
+      (coordinate_median / trimmed_mean) are not weighted averages at
+      all. Both run on the host plane (``host_view()`` + the
+      distributed servers); asking the jit lowering for them is an
+      error, not a silent downgrade.
+    """
+    dp, robust = program.dp, program.robust
+    if dp is not None:
+        if dp.noise_multiplier:
+            raise ValueError(
+                "compile_sim cannot lower the DP noise leg (the vmapped "
+                "round has no per-client noise stream); drive the "
+                "program's host_view / the distributed plane, or set "
+                "noise_multiplier=0 for clip-only")
+        payload_fn = _clipped_payload(payload_fn, dp.clip_norm)
+    if robust is not None:
+        if robust.mode != "norm_clip":
+            raise ValueError(
+                f"compile_sim cannot lower the {robust.mode!r} robust "
+                "fold (order statistics are not a weighted average); "
+                "drive the program's host_view / the distributed plane")
+        payload_fn = _clipped_payload(payload_fn, robust.clip_bound)
+    return payload_fn
+
+
 def compile_sim(program, spec, cfg, payload_fn=None, server_fn=None,
                 mesh=None, compressed=None, compressor=None):
     """Program -> compiled simulation round function.
@@ -32,6 +79,7 @@ def compile_sim(program, spec, cfg, payload_fn=None, server_fn=None,
     callers that already resolved one pass it through so instance-level
     configuration survives).
     """
+    payload_fn = _apply_privacy_legs(program, payload_fn)
     if mesh is not None:
         from fedml_tpu.parallel.engine import make_sharded_round
         return make_sharded_round(spec, cfg, mesh, payload_fn, server_fn)
@@ -58,6 +106,7 @@ def compile_bucketed(program, spec, cfg, payload_fn=None, server_fn=None,
     ``compressor`` overrides the device compressor instance exactly as
     in :func:`compile_sim`."""
     from fedml_tpu.parallel.engine import BucketedStreamRunner
+    payload_fn = _apply_privacy_legs(program, payload_fn)
     comp = compressor if compressor is not None else program.codec.device()
     return BucketedStreamRunner(spec, cfg, payload_fn, server_fn,
                                 compressor=comp, **kwargs)

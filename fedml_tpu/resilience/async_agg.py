@@ -148,7 +148,7 @@ class AsyncBufferedFedAvgServer(ServerManager):
                  async_policy: AsyncAggPolicy,
                  retry_policy: Optional[RetryPolicy] = None,
                  metrics_logger=None, timer_factory=threading.Timer,
-                 pace_controller=None):
+                 pace_controller=None, dp=None, robust=None):
         super().__init__(args, comm, rank=0, size=size)
         self.params = {k: np.asarray(v) for k, v in init_params.items()}
         self.total_updates = int(total_updates)
@@ -160,7 +160,12 @@ class AsyncBufferedFedAvgServer(ServerManager):
         # plus every fold go through its jax-free host view (the sim
         # engine lowers the same program via compile_sim -- the
         # conformance suite pins the two consumers equal)
-        self.program = RoundProgram(aggregation=async_policy)
+        # dp rides the program for the manifest + epsilon accounting
+        # (the mechanism is client-side); an armed robust leg swaps the
+        # aggregator's flush fold (make_aggregator wires it through --
+        # norm_clip is sync-only and rejected there).
+        self.program = RoundProgram(aggregation=async_policy, dp=dp,
+                                    robust=robust)
         self._host = self.program.host_view()
         self.agg = self._host.make_aggregator()
         self.alive = set(range(1, size))
@@ -541,6 +546,10 @@ class AsyncBufferedFedAvgServer(ServerManager):
             rec = {"update": res.version, "async/flush_reason": reason,
                    "async/flush_clients": res.clients,
                    "async/flush_degraded": int(degraded)}
+            if self.program.dp is not None:
+                # epsilon accounting per server release (each flush is
+                # one composition step of the Gaussian mechanism)
+                rec.update(self.program.dp.record(res.version))
             rec.update(self.agg.record())
             if self.pace is not None:
                 rec.update(self.pace.record())
@@ -629,7 +638,8 @@ def run_async_tcp_fedavg(world_size, total_updates, async_policy,
                          host="localhost", port=None, timeout=60.0,
                          join_timeout=90.0, transport="tcp",
                          pace_controller=None, late_clients=(),
-                         decode_workers=1, compressor=None):
+                         decode_workers=1, compressor=None, dp=None,
+                         robust=None):
     """Drive a multi-rank TCP buffered-async FedAvg scenario in one
     process (the async analog of ``integration.run_tcp_fedavg``; clients
     are the unchanged :class:`ResilientFedAvgClient`). ``transport``
@@ -679,7 +689,7 @@ def run_async_tcp_fedavg(world_size, total_updates, async_policy,
         if faulted and fault_plan is not None:
             comm = fault_plan.wrap(comm, rank)
         fsm = ResilientFedAvgClient(None, comm, rank, world_size, trainer,
-                                    compressor=compressor)
+                                    compressor=compressor, dp=dp)
         fsm.run()
 
     threads = [threading.Thread(target=run_client, args=(r,), daemon=True,
@@ -701,7 +711,7 @@ def run_async_tcp_fedavg(world_size, total_updates, async_policy,
     server = AsyncBufferedFedAvgServer(
         None, comm, world_size, init_params, total_updates, async_policy,
         retry_policy=retry_policy, metrics_logger=metrics_logger,
-        pace_controller=pace_controller)
+        pace_controller=pace_controller, dp=dp, robust=robust)
     server.register_message_receive_handlers()
     server.start()
     if server.agg.version < server.total_updates and server.failed is None:

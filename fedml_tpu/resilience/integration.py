@@ -224,11 +224,16 @@ class ResilientFedAvgClient(ClientManager):
 
     def __init__(self, args, comm, rank, size, local_train_fn,
                  retry_policy: Optional[RetryPolicy] = None,
-                 compressor=None):
+                 compressor=None, dp=None):
         super().__init__(args, comm, rank=rank, size=size)
         self.local_train_fn = local_train_fn
         self.retry_policy = retry_policy
         self.compressor = host_compressor(compressor)
+        # client-side DP leg (program/privacy.py DPPolicy or None): the
+        # trained params are privatized (clip -> seeded noise on the
+        # delta) BEFORE anything touches the report -- the raw update
+        # never crosses the trust boundary
+        self.dp = dp
         self._ef_residual = None  # zero accumulator until first report
         self.counters = {"retries": 0}
 
@@ -249,6 +254,13 @@ class ResilientFedAvgClient(ClientManager):
         with tracer.span("report", rank=self.rank, round=rnd):
             out = Message(MSG_C2S_REPORT, self.rank, 0)
             attempt = int(msg.get("attempt"))
+            if self.dp is not None:
+                # DP before codec, always: the mechanism's clip->noise
+                # runs on the raw delta, then the (lossy, NON-private)
+                # uplink encode sees only the privatized update --
+                # fedcheck FL153 pins this order statically
+                params = self.dp.privatize_params(
+                    msg.get("params"), params, self.rank, rnd, attempt)
             if self.compressor is None:
                 out.add("params", params)
             else:
@@ -320,7 +332,8 @@ class ResilientFedAvgServer(ServerManager):
                  round_policy: RoundPolicy,
                  retry_policy: Optional[RetryPolicy] = None,
                  cohort_target: Optional[int] = None, cohort_override=None,
-                 recovery=None, metrics_logger=None, pace_controller=None):
+                 recovery=None, metrics_logger=None, pace_controller=None,
+                 dp=None, robust=None):
         super().__init__(args, comm, rank=0, size=size)
         self.params = {k: np.asarray(v) for k, v in init_params.items()}
         self.rounds = int(rounds)
@@ -330,7 +343,10 @@ class ResilientFedAvgServer(ServerManager):
         # lowers the same program via compile_sim -- the conformance
         # suite pins the two consumers equal). round_policy stays the
         # live steered attribute; _steer_locked re-replaces the program.
-        self.program = RoundProgram(cohort=round_policy)
+        # dp rides the program for the manifest + epsilon accounting
+        # (the mechanism itself is client-side); robust swaps the fold.
+        self.program = RoundProgram(cohort=round_policy, dp=dp,
+                                    robust=robust)
         self._host = self.program.host_view()
         self.round_policy = round_policy
         self.retry_policy = retry_policy or RetryPolicy()
@@ -556,7 +572,12 @@ class ResilientFedAvgServer(ServerManager):
                     "aggregate",
                     parent=None if rspan is None else rspan.context,
                     reports=len(reports)):
-                self.params, _total = self._host.fold_reports(reports)
+                # base = the params this round broadcast (read before
+                # the assignment rebinds them): the robust norm-clip
+                # fold clips each report's delta against exactly the
+                # model the cohort trained on
+                self.params, _total = self._host.fold_reports(
+                    reports, base=self.params)
             if rspan is not None:
                 rspan.set(outcome=outcome, reports=len(reports)).end()
             self.history.append(dict(self.params))
@@ -733,6 +754,10 @@ class ResilientFedAvgServer(ServerManager):
             return
         rec = {"round": self.round_idx, "res/reports": n_reports,
                "res/degraded": int(degraded)}
+        if self.program.dp is not None:
+            # epsilon accounting rides every round record: the round
+            # being logged is the (round_idx + 1)-th completed release
+            rec.update(self.program.dp.record(self.round_idx + 1))
         rec.update({f"res/{k}": v for k, v in self.counters.items()})
         rec.update({f"res/{k}": v
                     for k, v in self._controller.counters.items()})
@@ -790,7 +815,8 @@ def run_tcp_fedavg(world_size, rounds, round_policy, init_params,
                    metrics_logger=None, host="localhost", port=None,
                    timeout=60.0, join_timeout=90.0, transport="tcp",
                    pace_controller=None, late_clients=(),
-                   decode_workers=1, compressor=None):
+                   decode_workers=1, compressor=None, dp=None,
+                   robust=None):
     """Drive a full multi-rank TCP FedAvg scenario in one process.
 
     Clients run in daemon threads (rank r wrapped by ``fault_plan`` when
@@ -805,7 +831,12 @@ def run_tcp_fedavg(world_size, rounds, round_policy, init_params,
     arms wire compression on every client: reports ship compressed
     deltas (error feedback on the biased compressors) and the server
     folds them sparsely against the round's base (``None``/``"none"`` =
-    today's plain reports, byte-identical).
+    today's plain reports, byte-identical). ``dp`` (a
+    ``program.DPPolicy``) privatizes every client's update delta
+    (clip -> per-(rank, round, attempt) seeded noise) before the uplink
+    encode, and rides the server's program for manifest + epsilon
+    accounting; ``robust`` (a ``program.RobustPolicy``) swaps the
+    server fold for the leg's robust variant.
     Returns the server (``.history``, ``.reporting_log``, ``.counters``,
     ``.failed``). Used by the ci.sh chaos/steering/compression smokes
     and test_resilience.py / test_net.py / test_steering.py.
@@ -846,7 +877,7 @@ def run_tcp_fedavg(world_size, rounds, round_policy, init_params,
         if faulted and fault_plan is not None:
             comm = fault_plan.wrap(comm, rank)
         fsm = ResilientFedAvgClient(None, comm, rank, world_size, trainer,
-                                    compressor=compressor)
+                                    compressor=compressor, dp=dp)
         fsm.run()
 
     threads = [threading.Thread(target=run_client, args=(r,), daemon=True,
@@ -869,7 +900,8 @@ def run_tcp_fedavg(world_size, rounds, round_policy, init_params,
         None, comm, world_size, init_params, rounds, round_policy,
         retry_policy=retry_policy, cohort_target=cohort_target,
         cohort_override=cohort_override, recovery=recovery,
-        metrics_logger=metrics_logger, pace_controller=pace_controller)
+        metrics_logger=metrics_logger, pace_controller=pace_controller,
+        dp=dp, robust=robust)
     server.register_message_receive_handlers()
     server.start()
     if server.round_idx < server.rounds and server.failed is None:

@@ -67,9 +67,18 @@ assert rules["FL136"]["properties"]["tags"][0] == "fedcheck-concurrency", \
 for code in ("FL140", "FL141", "FL142", "FL143"):
     tags = rules[code]["properties"]["tags"]
     assert tags == ["fedcheck-model"], (code, tags)
+# the privacy information-flow pass (FL150-FL153) is gated at zero on
+# the tree -- no raw-update telemetry leak, no reversed clip/noise
+# ordering or underived noise rng, no mask/codec commutation, no
+# declared-but-bypassed DP leg -- and its rules carry the
+# fedcheck-privacy tag
+for code in ("FL150", "FL151", "FL152", "FL153"):
+    tags = rules[code]["properties"]["tags"]
+    assert tags == ["fedcheck-privacy"], (code, tags)
 print("fedlint gate: 0 findings (incl. FL126-FL128, the determinism "
-      "pass FL131-FL135, and the fedmc model-checking pass FL140-FL143 "
-      "at zero), baseline empty, sarif rules carry fedcheck metadata")
+      "pass FL131-FL135, the fedmc model-checking pass FL140-FL143, "
+      "and the fedpriv privacy pass FL150-FL153 at zero), baseline "
+      "empty, sarif rules carry fedcheck metadata")
 EOF
 echo "-- fedmc mutation fixture (deleting the MSG_C2S_REPORT"
 echo "   registration must yield exactly one FL141 naming the hung"
@@ -91,6 +100,99 @@ assert "round 0" in found[0].message and "res_report" in found[0].message, \
 print("fedmc mutation fixture: FL141 fires exactly once on the deleted "
       "registration (trace names the hung round), clean tree verifies "
       "clean")
+EOF
+echo "-- fedpriv mutation fixtures (un-fixing each privacy invariant in"
+echo "   the real tree must yield exactly one finding of exactly its"
+echo "   rule; the unmutated modules must verify clean -- all four rules"
+echo "   gated both ways) --"
+python - <<'EOF'
+from fedml_tpu.analysis.linter import lint_source
+
+def both_ways(rel, needle, mutation, code):
+    src = open(rel, encoding="utf-8").read()
+    assert needle in src, (code, rel, "needle shape changed")
+    assert lint_source(src, path=rel, select={code}) == [], \
+        (code, "unmutated must verify clean")
+    found = lint_source(src.replace(needle, mutation, 1), path=rel,
+                        select={code})
+    assert [f.code for f in found] == [code], (code, found)
+
+# FL150: a payload log planted beside the real server's controller
+# handoff is a raw per-client tensor crossing into a telemetry sink
+both_ways(
+    "fedml_tpu/resilience/integration.py",
+    '            self._controller.report(\n'
+    '                msg.get("round"), msg.get("attempt"),'
+    ' msg.get_sender_id(),\n'
+    '                msg.get("num_samples"), self._report_payload(msg))',
+    '            payload = self._report_payload(msg)\n'
+    '            logging.info("report from %d: %r",\n'
+    '                         msg.get_sender_id(), payload)\n'
+    '            self._controller.report(\n'
+    '                msg.get("round"), msg.get("attempt"),'
+    ' msg.get_sender_id(),\n'
+    '                msg.get("num_samples"), payload)',
+    "FL150")
+# FL151: reversing DPPolicy.privatize's clip->noise order voids the
+# sensitivity bound the epsilon accountant depends on
+both_ways(
+    "fedml_tpu/program/privacy.py",
+    "        clipped = self.clip(delta)\n"
+    "        if self.noise_multiplier == 0:\n"
+    "            return clipped\n"
+    "        return self.noise(clipped, rank, round_idx, attempt)",
+    "        noised = self.noise(delta, rank, round_idx, attempt)\n"
+    "        return self.clip(noised)",
+    "FL151")
+# FL151 (rng half): a constant-seeded noise stream replays the same
+# noise every round -- averaging cancels it
+both_ways(
+    "fedml_tpu/program/privacy.py",
+    "        rng = self.noise_rng(rank, round_idx, attempt)",
+    "        rng = np.random.default_rng(0)",
+    "FL151")
+# FL152: dequantizing shares before reconstruction commutes a float op
+# inside the mask -- the field arithmetic no longer cancels the masks
+both_ways(
+    "fedml_tpu/core/mpc.py",
+    "    total_q = reconstruct_additive(partials, p)\n"
+    "    return dequantize(total_q, scale, p)",
+    "    total = reconstruct_additive(\n"
+    "        [dequantize(s, scale, p) for s in partials], p)\n"
+    "    return total",
+    "FL152")
+# FL153: deleting the client's privatize block leaves the declared DP
+# leg bypassed on the material send path
+both_ways(
+    "fedml_tpu/resilience/integration.py",
+    '            if self.dp is not None:\n'
+    '                # DP before codec, always: the mechanism\'s'
+    ' clip->noise\n'
+    '                # runs on the raw delta, then the (lossy,'
+    ' NON-private)\n'
+    '                # uplink encode sees only the privatized'
+    ' update --\n'
+    '                # fedcheck FL153 pins this order statically\n'
+    '                params = self.dp.privatize_params(\n'
+    '                    msg.get("params"), params, self.rank,'
+    ' rnd, attempt)\n',
+    '',
+    "FL153")
+print("fedpriv mutation fixtures: FL150-FL153 each fire exactly once "
+      "on their un-fixed invariant, clean tree verifies clean")
+EOF
+echo "-- fedpriv pass isolation (--select FL150 must run ONLY the"
+echo "   privacy pass: zero findings on the tree, and the report names"
+echo "   no other pass's rules) --"
+python -m fedml_tpu.analysis $LINT_SCOPE --select FL150 --format json \
+    --max-seconds "$FEDLINT_BUDGET_S" \
+    > bench_results/fedlint_privacy_select.json
+python - <<'EOF'
+import json
+rep = json.load(open("bench_results/fedlint_privacy_select.json"))
+assert rep["summary"]["new"] == 0, rep["summary"]
+assert all(f["code"] == "FL150" for f in rep["findings"]), rep["findings"]
+print("fedpriv --select FL150: privacy pass runs in isolation, 0 findings")
 EOF
 echo "-- fedlint --fix idempotence (clean tree => empty diff; same"
 echo "   wall-time budget -- the fixer's FL110 simulation is budgeted too) --"

@@ -1,0 +1,240 @@
+"""fedmc counterexample -> runtime fault-plan compilation (ISSUE 20).
+
+``modelcheck.trace_to_fault_plan`` closes the loop between the bounded
+model checker's message-sequence traces and ``resilience.faults``'
+seeded FaultPlans: a model counterexample re-manifests as a real
+wall-clock fault (or, for the fault-free FL141 liveness traces, the
+mutated protocol itself hangs a real TCP round into a TimeoutError).
+Also pins the widened default FaultBudget (two concurrent kills; the
+two-tier composition's edge-tier kill) staying inside the raised
+exploration caps.
+"""
+
+import ast
+
+import numpy as np
+import pytest
+
+from fedml_tpu.analysis import modelcheck as mc
+from fedml_tpu.analysis.protocol import ProtocolIndex
+from fedml_tpu.resilience.faults import FaultPlan, FaultRule
+from fedml_tpu.resilience.integration import run_tcp_fedavg
+from fedml_tpu.resilience.policy import RoundPolicy
+
+W0 = {"w": np.zeros((2, 3), np.float32), "b": np.ones(3, np.float32)}
+
+
+class TestTraceCompiler:
+    def test_drop_and_duplicate_become_nth_rules(self):
+        plan = mc.trace_to_fault_plan([
+            "deliver sync server->client0",
+            "deliver sync server->client1",
+            "deliver report client0->server",
+            "drop report client0->server",
+            "duplicate report client1->server (re-queued)",
+        ], seed=9)
+        assert isinstance(plan, FaultPlan) and plan.seed == 9
+        assert plan.rules == (
+            # 2nd report appearance from model client0 (runtime rank 1)
+            FaultRule(action="drop", rank=1, msg_type="report", nth=2),
+            FaultRule(action="duplicate", rank=2, msg_type="report",
+                      nth=1),
+        )
+
+    def test_deliver_only_trace_compiles_empty(self):
+        # FL141 traces are fault-free by construction (the fair path):
+        # nothing to inject -- the hang is the protocol's own defect
+        plan = mc.trace_to_fault_plan([
+            "deliver sync server->client0",
+            "deliver report client0->server (handler _on_report inert)",
+        ])
+        assert plan.rules == ()
+
+    def test_kill_maps_model_client_to_runtime_rank(self):
+        plan = mc.trace_to_fault_plan(["kill client2"])
+        assert plan.rules == (FaultRule(action="kill", rank=3, nth=1),)
+        # server/coordinator-plane labels are rank 0; tier planes keep
+        # the model's own id space
+        assert mc._runtime_rank("server") == 0
+        assert mc._runtime_rank("coordinator") == 0
+        assert mc._runtime_rank("client0") == 1
+        assert mc._runtime_rank("edge2") == 2
+        assert mc._runtime_rank("leaf101") == 101
+
+    def test_reserved_transport_frames_are_skipped(self):
+        # __-prefixed types are transport-synthesized: a sender-side
+        # wrapper can never fault them
+        plan = mc.trace_to_fault_plan([
+            "drop __peer_lost__ server->client0",
+            "drop report client0->server",
+        ])
+        assert plan.rules == (
+            FaultRule(action="drop", rank=1, msg_type="report", nth=1),)
+
+    def test_rejoin_is_inexpressible_under_strict(self):
+        trace = ["deliver sync server->client0", "rejoin client0"]
+        assert mc.trace_to_fault_plan(trace).rules == ()  # lax: skipped
+        with pytest.raises(ValueError, match="rejoin"):
+            mc.trace_to_fault_plan(trace, strict=True)
+
+    def test_unparseable_steps_are_ignored(self):
+        plan = mc.trace_to_fault_plan(
+            ["deadline server: round abandoned", "", "kill client0"])
+        assert plan.rules == (FaultRule(action="kill", rank=1, nth=1),)
+
+
+# the minimal server x 2 clients protocol test_analysis.py's fedmc
+# fixtures compose, reused here as the FL141 replay subject
+_BASE = (
+    "import logging\n"
+    "from fedml_tpu.core.managers import ClientManager, ServerManager\n"
+    "from fedml_tpu.core.comm.base import MSG_TYPE_PEER_LOST\n"
+    "from fedml_tpu.core.message import Message\n"
+    "MSG_SYNC = 'sync'\n"
+    "MSG_REPORT = 'report'\n"
+    "class Srv(ServerManager):\n"
+    "    def register_message_receive_handlers(self):\n"
+    "        self.register_message_receive_handler(MSG_REPORT,\n"
+    "                                              self._on_report)\n"
+    "        self.register_message_receive_handler(MSG_TYPE_PEER_LOST,\n"
+    "                                              self._on_lost)\n"
+    "    def open_round(self):\n"
+    "        self.send_message(Message(MSG_SYNC, 0, 1))\n"
+    "    def _on_report(self, msg):\n"
+    "        logging.debug('report from %s', msg.get_sender_id())\n"
+    "    def _on_lost(self, msg):\n"
+    "        logging.warning('rank %s lost', msg.get_sender_id())\n"
+    "        self.cohort.discard(msg.get_sender_id())\n"
+    "class Cli(ClientManager):\n"
+    "    def register_message_receive_handlers(self):\n"
+    "        self.register_message_receive_handler(MSG_SYNC,\n"
+    "                                              self._on_sync)\n"
+    "        self.register_message_receive_handler(MSG_TYPE_PEER_LOST,\n"
+    "                                              self._on_cli_lost)\n"
+    "    def _on_sync(self, msg):\n"
+    "        self.send_message(Message(MSG_REPORT, 1, 0))\n"
+    "    def _on_cli_lost(self, msg):\n"
+    "        self.finish()\n")
+
+
+def _pair_counterexamples(src):
+    index = ProtocolIndex()
+    index.add_module("fedml_tpu/core/fsm_fake.py", ast.parse(src))
+    out = []
+    for server, client, drive, replies in mc.discover_pairs(
+            mc.compile_specs(index)):
+        fair_res, full_res, _events = mc.verify_pair(server, client,
+                                                     drive, replies)
+        out.extend(fair_res.counterexamples + full_res.counterexamples)
+    return out
+
+
+class TestFl141Replay:
+    """The ISSUE's acceptance leg: the FL141 fixture's counterexample,
+    compiled and replayed against the real TCP control plane."""
+
+    def test_model_trace_replays_as_runtime_hang(self, monkeypatch):
+        # 1. the model side: the inert-report mutation's fair run hangs
+        #    round 0 -- a fault-free FL141 counterexample
+        cexs = [c for c in _pair_counterexamples(_BASE)
+                if c.code == "FL141"]
+        assert len(cexs) == 1
+        trace = cexs[0].trace
+        assert any("inert" in step for step in trace)
+        # 2. compile it: fault-free traces need NO injected faults (the
+        #    hang is the protocol's, not the network's)
+        plan = mc.trace_to_fault_plan(trace)
+        assert plan.rules == ()
+        # 3. replay: the same mutation (an inert report handler) on the
+        #    real server, under the compiled (empty) plan -- round 0
+        #    never folds, the run wedges into the driver's TimeoutError
+        from fedml_tpu.resilience import integration
+
+        def inert_on_report(self, msg):  # mirrors ci.sh's FL141 fixture
+            return None
+
+        monkeypatch.setattr(integration.ResilientFedAvgServer,
+                            "_on_report", inert_on_report)
+        with pytest.raises(TimeoutError, match="hung"):
+            run_tcp_fedavg(3, 1, RoundPolicy(), dict(W0),
+                           fault_plan=plan, join_timeout=4.0)
+
+    def test_healthy_protocol_has_no_counterexample_to_compile(self):
+        healthy = _BASE.replace(
+            "        logging.debug('report from %s', msg.get_sender_id())\n",
+            "        logging.debug('report from %s', msg.get_sender_id())\n"
+            "        self.folded.add(msg.get_sender_id())\n")
+        assert _pair_counterexamples(healthy) == []
+
+    def test_compiled_kill_manifests_at_runtime(self):
+        # a faulted-path trace step drives a REAL fault: the compiled
+        # kill takes out rank 2's reports and the (correctly shedding)
+        # server completes degraded -- the fault injection is live, the
+        # recovery policy is what the model proved adequate
+        plan = mc.trace_to_fault_plan(
+            ["deliver sync server->client1", "kill client1"], seed=5)
+        assert plan.rules == (FaultRule(action="kill", rank=2, nth=1),)
+        srv = run_tcp_fedavg(3, 2,
+                             RoundPolicy(deadline_s=1.0, quorum=0.3),
+                             dict(W0), fault_plan=plan, join_timeout=60)
+        assert srv.failed is None and len(srv.history) == 2
+        assert srv.counters["clients_dropped"] == 1
+
+
+class TestWidenedFaultBudget:
+    """ISSUE 20 satellite: two concurrent kills + the edge-tier kill."""
+
+    def test_default_pair_budget_carries_two_kills(self):
+        assert mc.FaultBudget().kills == 2
+
+    def test_pair_exploration_stays_inside_the_raised_caps(self):
+        index = ProtocolIndex()
+        index.add_module("fedml_tpu/core/fsm_fake.py", ast.parse(_BASE))
+        pairs = mc.discover_pairs(mc.compile_specs(index))
+        assert pairs
+        for server, client, drive, replies in pairs:
+            _fair, full_res, _ev = mc.verify_pair(server, client, drive,
+                                                  replies)
+            assert not full_res.capped
+            # both kills are spent somewhere in the explored space
+            assert full_res.states > 0
+
+    def test_two_tier_edge_kill_is_explored_and_survivable(self):
+        # the real composed topology: an edge-tier kill must appear in
+        # the full exploration's label alphabet, and the coordinator's
+        # peer-lost shed policy must keep the composition deadlock-free
+        # (zero counterexamples, uncapped, inside the raised cap)
+        import os
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        index = ProtocolIndex()
+        for rel in ("fedml_tpu/resilience/integration.py",
+                    "fedml_tpu/resilience/async_agg.py",
+                    "fedml_tpu/resilience/policy.py",
+                    "fedml_tpu/net/fanin.py"):
+            with open(os.path.join(repo, rel), encoding="utf-8") as fh:
+                index.add_module(rel, ast.parse(fh.read()))
+        specs = mc.compile_specs(index)
+        tiers = mc.discover_two_tier(specs)
+        assert tiers
+        coord, relay, leaf, down, up = tiers[0]
+        events = set()
+        full = mc.TwoTierModel(coord, relay, leaf, down, up, fair=False)
+        res = mc.explore_two_tier(full, mc.MAX_STATES_TIER, "FL140",
+                                  events)
+        assert not res.capped and res.decided
+        assert res.counterexamples == []
+        assert res.states <= mc.MAX_STATES_TIER
+        # the edge-tier kill transition is genuinely in the explored
+        # alphabet (a bounded frontier walk sees its label)
+        seen, labels = {full.initial()}, set()
+        frontier = [full.initial()]
+        for _ in range(2000):
+            if not frontier:
+                break
+            st = frontier.pop()
+            for label, nxt in full.successors(st, events):
+                labels.add(label.split(" (")[0])
+                if nxt not in seen and len(seen) < 2000:
+                    seen.add(nxt)
+                    frontier.append(nxt)
+        assert any(lab.startswith("kill edge") for lab in labels), labels

@@ -1,0 +1,438 @@
+"""fedpriv: the privacy information-flow pass (ISSUE 20).
+
+FL150-FL153 over the trust boundary -- raw-update telemetry leaks,
+DP mechanism ordering, secure-agg mask/codec commutation, declared-but-
+bypassed DP legs -- plus this PR's satellite widenings of neighbor
+passes: FL128's payload *type* half (values outside the wire codec's
+frame grammar) and FL131/FL134's float-type inference (annotations,
+literal propagation, dataclass float fields).
+
+Every rule gets synthetic positive/negative snippets AND a real-tree
+revert-mutation fixture: un-fixing the shipped code yields exactly one
+finding of exactly its rule (select-isolated), while the unmutated tree
+stays at zero -- the same zero-baseline discipline scripts/ci.sh gates.
+"""
+
+import os
+
+from fedml_tpu.analysis import lint_source
+from fedml_tpu.analysis.linter import (PASS_CODES, RULES, lint_paths,
+                                       rule_tags)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FSM_PATH = "fedml_tpu/core/fake.py"
+PRIV_PATH = "fedml_tpu/program/privacy_fake.py"
+MPC_PATH = "fedml_tpu/core/mpc_fake.py"
+
+
+def _real(rel):
+    with open(os.path.join(REPO_ROOT, rel), encoding="utf-8") as fh:
+        return fh.read()
+
+
+class TestPrivacyCatalog:
+    def test_rules_catalog_and_sarif_tags(self):
+        for code in ("FL150", "FL151", "FL152", "FL153"):
+            assert code in RULES
+            assert rule_tags(code) == ["fedcheck-privacy"]
+        assert PASS_CODES["privacy"] == frozenset(
+            ("FL150", "FL151", "FL152", "FL153"))
+
+    def test_zero_baseline_on_the_real_tree(self):
+        # the acceptance gate, scoped to the privacy-relevant packages
+        # (scripts/ci.sh holds the full-tree zero)
+        found = lint_paths(
+            [os.path.join(REPO_ROOT, d)
+             for d in ("fedml_tpu/program", "fedml_tpu/resilience",
+                       "fedml_tpu/core", "fedml_tpu/algorithms",
+                       "fedml_tpu/observability")],
+            select={"FL150", "FL151", "FL152", "FL153"})
+        assert [f.code for f in found] == []
+
+
+class TestFl150TelemetryLeak:
+    """A raw per-client tensor crossing into a log/telemetry/manifest
+    sink on the server side of the trust boundary."""
+
+    def test_logged_params_flagged(self):
+        src = (
+            "import logging\n"
+            "from fedml_tpu.core.managers import ServerManager\n"
+            "class Srv(ServerManager):\n"
+            "    def _on_report(self, msg):\n"
+            "        params = msg.get('params')\n"
+            "        logging.info('got %r', params)\n")
+        found = lint_source(src, path=FSM_PATH, select={"FL150"})
+        assert [f.code for f in found] == ["FL150"]
+        assert "telemetry" in found[0].message \
+            or "log" in found[0].message
+
+    def test_telemetry_sink_flagged(self):
+        src = (
+            "import json\n"
+            "from fedml_tpu.core.managers import ServerManager\n"
+            "class Srv(ServerManager):\n"
+            "    def _on_report(self, msg):\n"
+            "        update = msg.get('update')\n"
+            "        self.status.set('last', json.dumps(update))\n")
+        assert [f.code for f in lint_source(src, path=FSM_PATH,
+                                            select={"FL150"})] == ["FL150"]
+
+    def test_summary_statistic_clean(self):
+        # a derived scalar (len/shape/a counter) is NOT the update: the
+        # taint deliberately dies at arbitrary call results
+        src = (
+            "import logging\n"
+            "from fedml_tpu.core.managers import ServerManager\n"
+            "class Srv(ServerManager):\n"
+            "    def _on_report(self, msg):\n"
+            "        params = msg.get('params')\n"
+            "        logging.info('%d keys', len(params))\n")
+        assert lint_source(src, path=FSM_PATH, select={"FL150"}) == []
+
+    def test_client_logging_its_own_update_clean(self):
+        # the boundary is the server: a client's own tensors are its own
+        src = (
+            "import logging\n"
+            "from fedml_tpu.core.managers import ClientManager\n"
+            "class Cli(ClientManager):\n"
+            "    def _on_sync(self, msg):\n"
+            "        params = msg.get('params')\n"
+            "        logging.info('got %r', params)\n")
+        assert lint_source(src, path=FSM_PATH, select={"FL150"}) == []
+
+    def test_mutation_report_payload_logged(self):
+        # planting a payload log beside the controller handoff in the
+        # real server handler is exactly one FL150
+        rel = "fedml_tpu/resilience/integration.py"
+        src = _real(rel)
+        needle = (
+            "            self._controller.report(\n"
+            "                msg.get(\"round\"), msg.get(\"attempt\"),"
+            " msg.get_sender_id(),\n"
+            "                msg.get(\"num_samples\"),"
+            " self._report_payload(msg))")
+        assert needle in src, "_on_report controller handoff changed"
+        mutated = src.replace(needle, (
+            "            payload = self._report_payload(msg)\n"
+            "            logging.info(\"report from %d: %r\",\n"
+            "                         msg.get_sender_id(), payload)\n"
+            "            self._controller.report(\n"
+            "                msg.get(\"round\"), msg.get(\"attempt\"),"
+            " msg.get_sender_id(),\n"
+            "                msg.get(\"num_samples\"), payload)"), 1)
+        assert lint_source(src, path=rel, select={"FL150"}) == []
+        found = lint_source(mutated, path=rel, select={"FL150"})
+        assert [f.code for f in found] == ["FL150"]
+        # and it is the ONLY finding even under every rule at once
+        assert sorted({f.code for f in lint_source(mutated, path=rel)}) \
+            == ["FL150"]
+
+
+class TestFl151DpOrdering:
+    """Noise before clip (sensitivity voided), or an underived noise
+    stream, inside *privacy* modules."""
+
+    def test_noise_before_clip_flagged(self):
+        src = (
+            "class Mech:\n"
+            "    def privatize(self, delta, rank, rnd):\n"
+            "        noised = self.noise(delta, rank, rnd)\n"
+            "        return self.clip(noised)\n")
+        found = lint_source(src, path=PRIV_PATH, select={"FL151"})
+        assert [f.code for f in found] == ["FL151"]
+
+    def test_clip_then_noise_clean(self):
+        src = (
+            "class Mech:\n"
+            "    def privatize(self, delta, rank, rnd):\n"
+            "        clipped = self.clip(delta)\n"
+            "        return self.noise(clipped, rank, rnd)\n")
+        assert lint_source(src, path=PRIV_PATH, select={"FL151"}) == []
+
+    def test_constant_rng_flagged_derived_clean(self):
+        underived = (
+            "import numpy as np\n"
+            "class Mech:\n"
+            "    def noise(self, delta, rank, rnd):\n"
+            "        rng = np.random.default_rng(0)\n"
+            "        return delta + rng.standard_normal(delta.shape)\n")
+        assert [f.code for f in lint_source(underived, path=PRIV_PATH,
+                                            select={"FL151"})] == ["FL151"]
+        derived = underived.replace(
+            "np.random.default_rng(0)",
+            "np.random.default_rng((0xD1FF, rank, rnd))")
+        assert lint_source(derived, path=PRIV_PATH,
+                           select={"FL151"}) == []
+
+    def test_outside_privacy_scope_clean(self):
+        # a region rule: the same shape in an unscoped module is not
+        # a DP mechanism
+        src = (
+            "class Mech:\n"
+            "    def privatize(self, delta, rank, rnd):\n"
+            "        noised = self.noise(delta, rank, rnd)\n"
+            "        return self.clip(noised)\n")
+        assert lint_source(src, path=FSM_PATH, select={"FL151"}) == []
+
+    def test_mutation_privatize_noise_first(self):
+        rel = "fedml_tpu/program/privacy.py"
+        src = _real(rel)
+        needle = (
+            "        clipped = self.clip(delta)\n"
+            "        if self.noise_multiplier == 0:\n"
+            "            return clipped\n"
+            "        return self.noise(clipped, rank, round_idx, attempt)")
+        assert needle in src, "DPPolicy.privatize shape changed"
+        mutated = src.replace(needle, (
+            "        noised = self.noise(delta, rank, round_idx, attempt)\n"
+            "        return self.clip(noised)"), 1)
+        assert lint_source(src, path=rel, select={"FL151"}) == []
+        found = lint_source(mutated, path=rel, select={"FL151"})
+        assert [f.code for f in found] == ["FL151"]
+        assert sorted({f.code for f in lint_source(mutated, path=rel)}) \
+            == ["FL151"]
+
+    def test_mutation_noise_rng_underived(self):
+        rel = "fedml_tpu/program/privacy.py"
+        src = _real(rel)
+        needle = "        rng = self.noise_rng(rank, round_idx, attempt)"
+        assert needle in src, "DPPolicy.noise rng binding changed"
+        mutated = src.replace(needle,
+                              "        rng = np.random.default_rng(0)", 1)
+        assert lint_source(src, path=rel, select={"FL151"}) == []
+        found = lint_source(mutated, path=rel, select={"FL151"})
+        assert [f.code for f in found] == ["FL151"]
+
+
+class TestFl152MaskCommutation:
+    """Field-codec steps commuted across the mask boundary in secure-agg
+    modules: encode over masked values, or unmask over decoded floats."""
+
+    def test_quantize_of_shares_flagged(self):
+        src = (
+            "def agg(update, p, rng):\n"
+            "    shares = additive_shares(update, 3, p, rng)\n"
+            "    return [quantize(s, 2 ** 16, p) for s in shares]\n")
+        found = lint_source(src, path=MPC_PATH, select={"FL152"})
+        assert [f.code for f in found] == ["FL152"]
+
+    def test_quantize_then_share_clean(self):
+        src = (
+            "def agg(update, p, rng):\n"
+            "    q = quantize(update, 2 ** 16, p)\n"
+            "    return additive_shares(q, 3, p, rng)\n")
+        assert lint_source(src, path=MPC_PATH, select={"FL152"}) == []
+
+    def test_reconstruct_of_dequantized_flagged(self):
+        src = (
+            "def reveal(partials, p, scale):\n"
+            "    return reconstruct_additive(\n"
+            "        [dequantize(s, scale, p) for s in partials], p)\n")
+        found = lint_source(src, path=MPC_PATH, select={"FL152"})
+        assert [f.code for f in found] == ["FL152"]
+
+    def test_reconstruct_then_dequantize_clean(self):
+        src = (
+            "def reveal(partials, p, scale):\n"
+            "    total_q = reconstruct_additive(partials, p)\n"
+            "    return dequantize(total_q, scale, p)\n")
+        assert lint_source(src, path=MPC_PATH, select={"FL152"}) == []
+
+    def test_mutation_secure_aggregate_dequantizes_shares(self):
+        rel = "fedml_tpu/core/mpc.py"
+        src = _real(rel)
+        needle = (
+            "    total_q = reconstruct_additive(partials, p)\n"
+            "    return dequantize(total_q, scale, p)")
+        assert needle in src, "secure_aggregate reveal shape changed"
+        mutated = src.replace(needle, (
+            "    total = reconstruct_additive(\n"
+            "        [dequantize(s, scale, p) for s in partials], p)\n"
+            "    return total"), 1)
+        assert lint_source(src, path=rel, select={"FL152"}) == []
+        found = lint_source(mutated, path=rel, select={"FL152"})
+        assert [f.code for f in found] == ["FL152"]
+        assert sorted({f.code for f in lint_source(mutated, path=rel)}) \
+            == ["FL152"]
+
+
+class TestFl153DeclaredDpBypass:
+    """A client FSM that declares the DP leg but ships a material
+    payload no privatize call can reach."""
+
+    POS = (
+        "from fedml_tpu.core.managers import ClientManager\n"
+        "from fedml_tpu.core.message import Message\n"
+        "class Cli(ClientManager):\n"
+        "    def __init__(self, comm, dp=None):\n"
+        "        self.dp = dp\n"
+        "    def _on_sync(self, msg):\n"
+        "        out = Message('report', 1, 0)\n"
+        "        out.add('params', self.train(msg))\n"
+        "        self.send_message(out)\n")
+
+    def test_declared_dp_bypassed_flagged(self):
+        found = lint_source(self.POS, path=FSM_PATH, select={"FL153"})
+        assert [f.code for f in found] == ["FL153"]
+        assert "privatize" in found[0].message
+
+    def test_privatized_send_path_clean(self):
+        src = self.POS.replace(
+            "        out.add('params', self.train(msg))\n",
+            "        params = self.train(msg)\n"
+            "        if self.dp is not None:\n"
+            "            params = self.dp.privatize_params(\n"
+            "                msg.get('params'), params, 1, 0, 0)\n"
+            "        out.add('params', params)\n")
+        assert lint_source(src, path=FSM_PATH, select={"FL153"}) == []
+
+    def test_no_dp_declaration_clean(self):
+        # a DP-less client owes nothing: the rule fires on the declared-
+        # but-bypassed contract, never on plain FedAvg
+        src = self.POS.replace(
+            "    def __init__(self, comm, dp=None):\n"
+            "        self.dp = dp\n", "")
+        assert lint_source(src, path=FSM_PATH, select={"FL153"}) == []
+
+    def test_mutation_client_drops_the_privatize_block(self):
+        rel = "fedml_tpu/resilience/integration.py"
+        src = _real(rel)
+        needle = (
+            "            if self.dp is not None:\n"
+            "                # DP before codec, always: the mechanism's"
+            " clip->noise\n"
+            "                # runs on the raw delta, then the (lossy,"
+            " NON-private)\n"
+            "                # uplink encode sees only the privatized"
+            " update --\n"
+            "                # fedcheck FL153 pins this order statically\n"
+            "                params = self.dp.privatize_params(\n"
+            "                    msg.get(\"params\"), params, self.rank,"
+            " rnd, attempt)\n")
+        assert needle in src, "client _on_sync privatize block changed"
+        mutated = src.replace(needle, "", 1)
+        assert lint_source(src, path=rel, select={"FL153"}) == []
+        found = lint_source(mutated, path=rel, select={"FL153"})
+        assert [f.code for f in found] == ["FL153"]
+        assert sorted({f.code for f in lint_source(mutated, path=rel)}) \
+            == ["FL153"]
+
+
+class TestFl128PayloadTypes:
+    """ISSUE 20 satellite: payload values outside the wire codec's
+    frame grammar (ndarray/duck-array leaves, dict/list/tuple
+    containers, JSON scalars) -- FL128's type half."""
+
+    def _client(self, add_line):
+        return (
+            "from fedml_tpu.core.managers import ClientManager\n"
+            "from fedml_tpu.core.message import Message\n"
+            "class Cli(ClientManager):\n"
+            "    def _on_sync(self, msg):\n"
+            "        out = Message('report', 1, 0)\n"
+            f"        {add_line}\n"
+            "        self.send_message(out)\n")
+
+    def test_set_bytes_lambda_flagged(self):
+        for add_line, kind in (
+                ("out.add('ranks', {1, 2, 3})", "set"),
+                ("out.add('blob', b'abc')", "bytes"),
+                ("out.add('fn', lambda x: x)", "lambda")):
+            found = lint_source(self._client(add_line), path=FSM_PATH,
+                                select={"FL128"})
+            assert [f.code for f in found] == ["FL128"], kind
+            assert "frame grammar" in found[0].message, kind
+
+    def test_framable_literals_clean(self):
+        for add_line in (
+                "out.add('params', {'w': [1.0, 2.0]})",
+                "out.add('round', 3)",
+                "out.add('tag', 'sync')"):
+            assert lint_source(self._client(add_line), path=FSM_PATH,
+                               select={"FL128"}) == []
+
+
+class TestFloatTypeInference:
+    """ISSUE 20 satellite: FL131/FL134 float evidence beyond the
+    syntactic float() call -- annotations, literal propagation, and
+    dataclass float fields."""
+
+    def test_fl131_float_annotated_param(self):
+        src = (
+            "def fold_reports(reports, scale: float):\n"
+            "    return sum(scale * v for v in reports.values())\n")
+        found = lint_source(src, path=FSM_PATH, select={"FL131"})
+        assert [f.code for f in found] == ["FL131"]
+
+    def test_fl131_literal_propagation(self):
+        src = (
+            "def fold_entries(entries):\n"
+            "    lr = 0.25\n"
+            "    scale = lr\n"
+            "    return sum(scale * v for v in entries.values())\n")
+        assert [f.code for f in lint_source(src, path=FSM_PATH,
+                                            select={"FL131"})] == ["FL131"]
+
+    def test_fl131_float_accumulator(self):
+        # the accumulator itself carries the float evidence: += of
+        # opaque values into a float local is still an ordered fold
+        src = (
+            "def fold_entries(entries):\n"
+            "    acc = 0.5\n"
+            "    for k in entries:\n"
+            "        acc += entries[k]\n"
+            "    return acc\n")
+        assert [f.code for f in lint_source(src, path=FSM_PATH,
+                                            select={"FL131"})] == ["FL131"]
+
+    def test_fl131_dataclass_float_field(self):
+        src = (
+            "from dataclasses import dataclass\n"
+            "@dataclass\n"
+            "class Stat:\n"
+            "    weight: float\n"
+            "    count: int\n"
+            "def fold_reports(reports):\n"
+            "    return sum(s.weight for s in reports.values())\n")
+        assert [f.code for f in lint_source(src, path=FSM_PATH,
+                                            select={"FL131"})] == ["FL131"]
+
+    def test_int_only_folds_stay_legal(self):
+        # the negative half the ISSUE pins: int tallies commute exactly
+        for src in (
+                "def fold_reports(reports, scale: int):\n"
+                "    return sum(scale * v for v in reports.values())\n",
+                "def fold_entries(entries):\n"
+                "    acc = 0\n"
+                "    for k in entries:\n"
+                "        acc += entries[k]\n"
+                "    return acc\n",
+                "from dataclasses import dataclass\n"
+                "@dataclass\n"
+                "class Stat:\n"
+                "    weight: float\n"
+                "    count: int\n"
+                "def fold_reports(reports):\n"
+                "    return sum(s.count for s in reports.values())\n"):
+            assert lint_source(src, path=FSM_PATH,
+                               select={"FL131"}) == []
+
+    def test_fl134_annotated_and_literal_evidence(self):
+        ann = (
+            "class AggServer:\n"
+            "    def handle_receive_message(self, msg):\n"
+            "        self._fold_in(msg, 0.25)\n"
+            "    def _fold_in(self, msg, lr: float):\n"
+            "        self.total += lr * msg.get('weight')\n")
+        lit = (
+            "class AggServer:\n"
+            "    def handle_receive_message(self, msg):\n"
+            "        w = 0.5\n"
+            "        self.total += w * msg.get('weight')\n")
+        for src in (ann, lit):
+            found = lint_source(src, path=FSM_PATH, select={"FL134"})
+            assert [f.code for f in found] == ["FL134"]
+        intv = lit.replace("w = 0.5", "w = 2")
+        assert lint_source(intv, path=FSM_PATH, select={"FL134"}) == []

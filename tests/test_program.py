@@ -146,7 +146,8 @@ class TestProgramContract:
             '"staleness_decay": 0.25}, '
             '"codec": {"enabled": true, "spec": "qsgd:4"}, '
             '"cohort": {"deadline_s": 2.0, "max_round_retries": 3, '
-            '"overselect": 0.5, "quorum": 0.4}}')
+            '"overselect": 0.5, "quorum": 0.4}, '
+            '"dp": null, "robust": null}')
         back = RoundProgram.from_manifest(
             json.loads(json.dumps(m, sort_keys=True)))
         assert back == p.replace(client_update=None)
@@ -355,3 +356,179 @@ class TestDistributedConsumer:
         assert srv.failed is None and len(srv.history) == 2
         assert srv.program.is_async
         assert srv.agg.policy is srv.program.aggregation
+
+
+class TestPrivacyProgramLegs:
+    """DPPolicy/RobustPolicy -- the fedpriv-verified legs (ISSUE 20).
+
+    Mechanism pins (clip THEN keyed noise, epsilon accounting, robust
+    folds' sorted-traversal determinism), the widened manifest byte pin,
+    and the {dp} x {robust} x {codec} conformance matrix: every round a
+    dp/robust-armed TCP server folds re-derives bitwise through the
+    program's host twin (privatize -> EF-encode -> fold, all keyed).
+    """
+
+    W0 = {"w": np.zeros((2, 3), np.float32), "b": np.ones(3, np.float32)}
+
+    def _delta(self, seed=3):
+        rng = np.random.default_rng(seed)
+        return {"b": rng.standard_normal(5).astype(np.float32) * 4,
+                "w": rng.standard_normal((4, 5)).astype(np.float32) * 4}
+
+    def test_dp_clip_then_noise_order_pinned(self):
+        from fedml_tpu.program import DPPolicy
+        delta = self._delta()
+        clip_only = DPPolicy(clip_norm=0.5, noise_multiplier=0.0)
+        out = clip_only.privatize(delta, rank=1, round_idx=0)
+        norm = np.sqrt(sum(float(np.sum(np.asarray(v, np.float64) ** 2))
+                           for v in out.values()))
+        assert norm <= 0.5 * (1 + 1e-6)
+        for k in delta:  # clip-only == clip (no noise leg at sigma 0)
+            np.testing.assert_array_equal(out[k], clip_only.clip(delta)[k])
+        dp = DPPolicy(clip_norm=0.5, noise_multiplier=1.0)
+        got = dp.privatize(delta, rank=1, round_idx=0)
+        want = dp.noise(dp.clip(delta), rank=1, round_idx=0)
+        for k in delta:  # THE order: noise over the CLIPPED delta
+            np.testing.assert_array_equal(got[k], want[k])
+
+    def test_dp_noise_stream_keyed_and_replayable(self):
+        from fedml_tpu.program import DPPolicy
+        delta = self._delta()
+        dp = DPPolicy(clip_norm=1.0, noise_multiplier=0.7)
+        a = dp.privatize(delta, rank=2, round_idx=5, attempt=1)
+        b = dp.privatize(delta, rank=2, round_idx=5, attempt=1)
+        for k in delta:  # same (rank, round, attempt) -> same bytes
+            np.testing.assert_array_equal(a[k], b[k])
+        for other in (dict(rank=3, round_idx=5, attempt=1),
+                      dict(rank=2, round_idx=6, attempt=1),
+                      dict(rank=2, round_idx=5, attempt=2)):
+            c = dp.privatize(delta, **other)
+            assert any(not np.array_equal(a[k], c[k]) for k in delta)
+        # domain separation from the codec stream over the same key
+        from fedml_tpu.program.privacy import DP_SEED_SALT
+        assert dp.noise_rng(1, 0).integers(0, 2 ** 31) \
+            != encode_rng((1, 0)).integers(0, 2 ** 31)
+        assert DP_SEED_SALT != 0x5EED
+
+    def test_dp_epsilon_accounting(self):
+        import math
+        from fedml_tpu.program import DPPolicy
+        off = DPPolicy(clip_norm=1.0, noise_multiplier=0.0)
+        assert off.epsilon(10) == math.inf
+        assert off.record(10)["dp/epsilon"] == -1.0
+        dp = DPPolicy(clip_norm=1.0, noise_multiplier=1.2, delta=1e-5)
+        assert dp.epsilon(5) == pytest.approx(5 * dp.epsilon(1))
+        rec = dp.record(3)
+        assert rec["dp/rounds"] == 3
+        assert rec["dp/epsilon"] == pytest.approx(dp.epsilon(3))
+
+    def test_robust_folds_deterministic_and_correct(self):
+        from fedml_tpu.program import RobustPolicy
+        rng = np.random.default_rng(0)
+        reports = {r: (10.0 + r,
+                       {"w": rng.standard_normal(4).astype(np.float32)})
+                   for r in range(5)}
+        med = RobustPolicy(mode="coordinate_median")
+        params, total = med.fold_reports(reports)
+        assert total == float(sum(n for n, _ in reports.values()))
+        stacked = np.stack([reports[r][1]["w"] for r in sorted(reports)])
+        np.testing.assert_array_equal(
+            params["w"], np.median(stacked, axis=0).astype(np.float32))
+        # arrival order never reaches the fold: reversed dict == sorted
+        rev = dict(sorted(reports.items(), reverse=True))
+        params2, _ = med.fold_reports(rev)
+        np.testing.assert_array_equal(params["w"], params2["w"])
+        with pytest.raises(ValueError):  # base is the norm_clip anchor
+            RobustPolicy(mode="norm_clip").fold_reports(reports)
+        with pytest.raises(ValueError):  # empty cohort: abandon instead
+            med.fold_reports({})
+        with pytest.raises(ValueError):
+            RobustPolicy(mode="krum")
+
+    def test_manifest_roundtrip_dp_robust_pinned(self):
+        import json
+        from fedml_tpu.program import DPPolicy, RobustPolicy
+        p = RoundProgram(
+            dp=DPPolicy(clip_norm=0.5, noise_multiplier=1.1, delta=1e-6),
+            robust=RobustPolicy(mode="trimmed_mean", trim_ratio=0.2))
+        m = json.dumps(p.manifest(), sort_keys=True)
+        assert ('"dp": {"clip_norm": 0.5, "delta": 1e-06, '
+                '"noise_multiplier": 1.1}') in m
+        assert ('"robust": {"clip_bound": 10.0, "mode": "trimmed_mean", '
+                '"trim_ratio": 0.2}') in m
+        assert RoundProgram.from_manifest(json.loads(m)) == p
+        # the unarmed legs stay explicit nulls (a run with NO defense
+        # must say so in its manifest, not omit the keys)
+        bare = RoundProgram().manifest()
+        assert bare["dp"] is None and bare["robust"] is None
+
+    def test_sim_lowering_gates_the_inexpressible_legs(self):
+        from fedml_tpu.program import DPPolicy, RobustPolicy
+        from fedml_tpu.program.sim import _apply_privacy_legs
+        # clip-only DP and norm_clip lower onto the payload hook
+        fn = _apply_privacy_legs(
+            RoundProgram(dp=DPPolicy(clip_norm=1.0),
+                         robust=RobustPolicy(mode="norm_clip")), None)
+        assert callable(fn)
+        with pytest.raises(ValueError):  # noise needs a derived stream
+            _apply_privacy_legs(
+                RoundProgram(dp=DPPolicy(noise_multiplier=1.0)), None)
+        with pytest.raises(ValueError):  # order statistics != weighted avg
+            _apply_privacy_legs(
+                RoundProgram(robust=RobustPolicy(mode="trimmed_mean")),
+                None)
+
+    @pytest.mark.parametrize("codec", [None, "qsgd"])
+    @pytest.mark.parametrize("robust_mode",
+                             [None, "norm_clip", "coordinate_median"])
+    @pytest.mark.parametrize("with_dp", [False, True])
+    def test_conformance_matrix_distributed_equals_host_twin(
+            self, with_dp, robust_mode, codec):
+        from fedml_tpu.compression.wire import ef_step
+        from fedml_tpu.program import DPPolicy, RobustPolicy
+        from fedml_tpu.resilience.integration import (quadratic_trainer,
+                                                      run_tcp_fedavg)
+        dp = DPPolicy(clip_norm=0.5, noise_multiplier=0.8) if with_dp \
+            else None
+        robust = None
+        if robust_mode == "norm_clip":
+            robust = RobustPolicy(mode="norm_clip", clip_bound=0.3)
+        elif robust_mode is not None:
+            robust = RobustPolicy(mode=robust_mode)
+        trainer = quadratic_trainer()
+        srv = run_tcp_fedavg(4, 2, CohortPolicy(), dict(self.W0),
+                             trainer=trainer, join_timeout=60,
+                             compressor=codec, dp=dp, robust=robust)
+        assert srv.failed is None and len(srv.history) == 2
+        prog = RoundProgram(cohort=CohortPolicy(),
+                            codec=codec or "none", dp=dp, robust=robust)
+        host = prog.host_view()
+        comp = prog.codec.host() if prog.codec.enabled else None
+        expected = dict(self.W0)
+        residuals = {}
+        for rnd, subset in enumerate(srv.reporting_log):
+            reports = {}
+            base32 = {k: np.asarray(expected[k], np.float32)
+                      for k in expected}
+            for r in subset:
+                p, n = trainer(expected, rnd, r)
+                if dp is not None:
+                    p = dp.privatize_params(expected, p, r, rnd, 0)
+                if comp is not None:
+                    delta = {k: np.asarray(p[k], np.float32) - base32[k]
+                             for k in base32}
+                    enc, _dec, residuals[r] = ef_step(
+                        comp, delta, residuals.get(r, {}),
+                        encode_rng((r, rnd, 0)))
+                    p = CompressedUpdate(enc=enc, spec=prog.codec.spec,
+                                         base=expected)
+                reports[r] = (n, p)
+            if robust is None:
+                expected, _ = host.fold_reports(reports)
+            else:
+                expected, _ = host.fold_reports(reports, base=expected)
+            for k in expected:
+                np.testing.assert_array_equal(
+                    expected[k], srv.history[rnd][k],
+                    err_msg=f"dp={with_dp}/{robust_mode}/{codec}/"
+                            f"round{rnd}/{k}")

@@ -231,6 +231,32 @@ class TestMPC:
         agg = mpc.secure_aggregate(updates, rng=rng)
         np.testing.assert_allclose(agg, sum(updates), atol=1e-3)
 
+    def test_masking_requires_an_explicit_rng(self):
+        # the historical constant default_rng(0) reused the exact same
+        # masks every call (reused masks cancel -- no secrecy); every
+        # masking entry point now refuses to run without a derived rng
+        secret = mpc.quantize(np.array([1.0, 2.0]))
+        with pytest.raises(ValueError, match="explicit rng"):
+            mpc.additive_shares(secret, 3)
+        with pytest.raises(ValueError, match="explicit rng"):
+            mpc.bgw_encode(secret, [1, 2, 3], t=1)
+        with pytest.raises(ValueError, match="explicit rng"):
+            mpc.secure_aggregate([np.array([1.0])])
+
+    def test_mask_rng_is_keyed_and_domain_separated(self):
+        # same key -> same stream (replayable); different key or a
+        # different salt domain (codec 0x5EED / dp 0xD1FF) -> disjoint
+        a = mpc.mask_rng(1, 4).integers(0, 2 ** 31, size=8)
+        b = mpc.mask_rng(1, 4).integers(0, 2 ** 31, size=8)
+        np.testing.assert_array_equal(a, b)
+        c = mpc.mask_rng(2, 4).integers(0, 2 ** 31, size=8)
+        assert not np.array_equal(a, c)
+        from fedml_tpu.compression.wire import encode_rng
+        from fedml_tpu.program.privacy import DP_SEED_SALT
+        assert mpc.MASK_SEED_SALT not in (0x5EED, DP_SEED_SALT)
+        d = encode_rng((1, 4)).integers(0, 2 ** 31, size=8)
+        assert not np.array_equal(a, d)
+
     def test_turboaggregate_matches_fedavg(self):
         ds = load_synthetic_federated(client_num=4, n_train=400, n_test=80,
                                       alpha=0.0, beta=0.0, seed=0)
